@@ -1,0 +1,34 @@
+"""pipe_tpu — TPU-native synchronous pipeline parallelism.
+
+A brand-new framework with the capabilities of
+``torch.distributed.pipeline.sync.Pipe`` (torchgpipe lineage), re-designed for
+TPU: one compiled JAX/XLA program under a ``(stage, data)`` mesh, where
+``lax.ppermute`` over ICI replaces CUDA-stream P2P copies, the compiled
+clock-cycle schedule replaces worker threads and autograd-embedded
+Wait/Copy/Fork/Join nodes, and ``jax.checkpoint`` replaces the
+Checkpoint/Recompute machinery. See SURVEY.md for the structural analysis of
+the reference and the capability map.
+"""
+
+from .core import microbatch
+from .core.microbatch import Batch, NoChunk, gather, scatter
+from .core.partition import BalanceError, Stage, StageCtx
+from .core.schedule import (GPipeSchedule, InterleavedSchedule,
+                            OneFOneBSchedule, clock_cycles, get_schedule)
+from .ops.layers import (Decoder, Dropout, Embedding, Lambda, LayerNorm,
+                         Linear, Module, MultiHeadAttention,
+                         PositionalEncoding, Sequential,
+                         TransformerEncoderLayer)
+from .pipe import Pipe
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Pipe", "NoChunk", "Batch", "BalanceError", "Stage", "StageCtx",
+    "scatter", "gather", "microbatch",
+    "GPipeSchedule", "OneFOneBSchedule", "InterleavedSchedule",
+    "clock_cycles", "get_schedule",
+    "Module", "Sequential", "Lambda", "Linear", "Embedding", "LayerNorm",
+    "Dropout", "MultiHeadAttention", "TransformerEncoderLayer",
+    "PositionalEncoding", "Decoder",
+]

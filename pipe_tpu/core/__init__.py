@@ -1,0 +1,1 @@
+"""Core: microbatch, partition, schedule, remat."""

@@ -1,0 +1,330 @@
+"""Micro-batch scatter/gather: split a mini-batch into micro-batches and back.
+
+Capability parity with ``torch.distributed.pipeline.sync.microbatch`` (cited via its
+import sites, reference ``pipe.py:17,452-464,477-490`` and the quoted module at
+``README.md:316-322``), redesigned for JAX:
+
+* ``scatter`` follows ``torch.chunk`` semantics on dim 0 — chunk size is
+  ``ceil(n / chunks)`` so the call may yield *fewer* than ``chunks`` micro-batches
+  and the last one may be smaller (the off-by-one interaction with
+  ``checkpoint_stop`` flagged at reference ``README.md:398`` is handled by the
+  caller recomputing ``checkpoint_stop`` against ``len(batches)``).
+* Non-array leaves and arrays wrapped in :class:`NoChunk` are replicated into every
+  micro-batch rather than split (reference ``pipe.py:462-464``).
+* ``gather`` concatenates arrays per position; non-array positions are taken from
+  the first micro-batch (they were replicated by ``scatter``).
+
+For the *compiled* SPMD pipeline path there are also stacked forms,
+:func:`stack_scatter` / :func:`stack_gather`, which produce a single
+``[chunks, mb, ...]`` leading-axis layout (static shapes, XLA-friendly) with an
+explicit validity count for non-divisible batches.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "NoChunk",
+    "Batch",
+    "check",
+    "scatter",
+    "gather",
+    "stack_scatter",
+    "stack_gather",
+]
+
+ArrayTypes = (jax.Array, np.ndarray)
+
+
+def is_array(value: Any) -> bool:
+    """True for concrete or traced JAX arrays and numpy arrays."""
+    return isinstance(value, ArrayTypes) or isinstance(value, jax.core.Tracer)
+
+
+class NoChunk:
+    """Wrap an array to exclude it from scatter's dim-0 split.
+
+    The wrapped array is replicated to every micro-batch whole (reference
+    ``pipe.py:462-464``). The wrapper exists only at the API boundary; inside a
+    :class:`Batch` the raw array is stored.
+    """
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        if not is_array(value):
+            raise TypeError(f"NoChunk expects an array, got {type(value).__name__}")
+        self._value = value
+
+    @property
+    def value(self):
+        return self._value
+
+    def __repr__(self) -> str:
+        return f"NoChunk({self._value!r})"
+
+
+class Batch:
+    """One micro-batch: an immutable tuple of values with helpers.
+
+    Mirrors the reference ``Batch`` container (``README.md:316-322``): ``atomic``
+    marks the single-tensor fast path, :meth:`call` applies a function to the
+    payload, and indexing/slicing address positional values.
+    """
+
+    __slots__ = ("_values", "atomic", "replicated")
+
+    def __init__(self, values: Union[Any, Tuple[Any, ...]], atomic: bool = False,
+                 replicated: Tuple[int, ...] = ()):
+        if atomic:
+            self._values = (values,)
+        else:
+            self._values = tuple(values)
+        self.atomic = atomic
+        # Positions holding replicated (NoChunk / non-array) values: gather
+        # takes them from one micro-batch instead of concatenating.
+        self.replicated = tuple(replicated)
+
+    @property
+    def values(self) -> Tuple[Any, ...]:
+        return self._values
+
+    @property
+    def tensor(self):
+        """The sole array of an atomic batch (reference Batch.tensor)."""
+        if not self.atomic:
+            raise AttributeError("not an atomic batch; use .values / .tensors")
+        return self._values[0]
+
+    @property
+    def tensors(self) -> Tuple[Any, ...]:
+        if self.atomic:
+            raise AttributeError("atomic batch; use .tensor")
+        return self._values
+
+    def call(self, function: Callable) -> "Batch":
+        """Apply ``function`` to the payload, preserving atomicity when possible.
+
+        Atomic batches call ``function(tensor)``; non-atomic call
+        ``function(*values)``. A tuple/list result becomes a non-atomic batch, a
+        single value an atomic one — matching the reference's partition-call
+        contract (``README.md:316-322``).
+        """
+        if self.atomic:
+            result = function(self._values[0])
+        else:
+            result = function(*self._values)
+        if isinstance(result, (tuple, list)):
+            # Replication marks do NOT survive a transform: a stage may permute
+            # or overwrite positions, so carrying marks forward could make
+            # gather silently drop real per-microbatch outputs. Marks only
+            # matter for a direct scatter -> gather round trip.
+            return Batch(tuple(result), atomic=False)
+        return Batch(result, atomic=True)
+
+    def find_tensor_idx(self) -> int:
+        """Index of the first array value (reference Batch.find_tensor_idx)."""
+        for i, v in enumerate(self._values):
+            if is_array(v):
+                return i
+        raise ValueError("no array in batch")
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def __getitem__(self, index):
+        if isinstance(index, slice):
+            return Batch(self._values[index], atomic=False)
+        return self._values[index]
+
+    def with_value(self, index: int, value) -> "Batch":
+        """Functional update of position ``index`` (JAX-style, no mutation)."""
+        values = list(self._values)
+        values[index] = value
+        return Batch(tuple(values), atomic=self.atomic and len(values) == 1)
+
+    def __repr__(self) -> str:
+        return f"Batch({self._values!r}, atomic={self.atomic})"
+
+
+def check(*inputs: Any) -> None:
+    """Validate pipeline inputs: at least one array among them.
+
+    Mirrors reference ``microbatch.check`` called from ``Pipe.forward``
+    (``pipe.py:476-477``). Device checking is meaningless under SPMD/jit and is
+    intentionally dropped.
+    """
+    if not inputs:
+        raise TypeError("no input provided")
+    for x in inputs:
+        if is_array(x) or isinstance(x, NoChunk):
+            return
+    raise TypeError("expected at least one array as input")
+
+
+def _chunk_sizes(n: int, chunks: int) -> List[int]:
+    """``torch.chunk`` split sizes: ceil-sized chunks, possibly fewer than asked."""
+    if chunks <= 0:
+        raise ValueError("number of chunks must be positive")
+    size = math.ceil(n / chunks)
+    if size == 0:
+        return [n]
+    sizes = []
+    remaining = n
+    while remaining > 0:
+        take = min(size, remaining)
+        sizes.append(take)
+        remaining -= take
+    return sizes or [0]
+
+
+def scatter(inputs: Sequence[Any], chunks: int) -> List[Batch]:
+    """Split each array input along dim 0 into micro-batches.
+
+    Reference semantics (``pipe.py:484``; ``README.md:316-322``): array inputs are
+    split with ``torch.chunk`` sizing; ``NoChunk``-wrapped arrays and non-array
+    values are replicated whole. All split inputs must agree on batch size.
+    Returns a list of :class:`Batch`; its length may be < ``chunks``.
+    """
+    if isinstance(inputs, Batch):
+        raise TypeError("scatter takes raw inputs, not a Batch")
+    inputs = tuple(inputs)
+    check(*inputs)
+
+    batch_size = None
+    for x in inputs:
+        if is_array(x):
+            if x.ndim == 0:
+                raise ValueError("cannot scatter a 0-d array; wrap it in NoChunk")
+            if batch_size is None:
+                batch_size = x.shape[0]
+            elif x.shape[0] != batch_size:
+                raise ValueError(
+                    f"inconsistent batch sizes: {batch_size} vs {x.shape[0]}"
+                )
+    if batch_size is None:
+        # Only NoChunk/non-array inputs: replicate into exactly `chunks` batches.
+        sizes = [None] * chunks
+    else:
+        sizes = _chunk_sizes(batch_size, chunks)
+
+    atomic = len(inputs) == 1 and is_array(inputs[0])
+
+    per_chunk: List[List[Any]] = [[] for _ in sizes]
+    replicated: List[int] = []
+    for pos, x in enumerate(inputs):
+        if isinstance(x, NoChunk):
+            replicated.append(pos)
+            for vals in per_chunk:
+                vals.append(x.value)
+        elif is_array(x):
+            offset = 0
+            for k, sz in enumerate(sizes):
+                per_chunk[k].append(jax.lax.slice_in_dim(x, offset, offset + sz, axis=0)
+                                    if isinstance(x, jax.core.Tracer)
+                                    else x[offset:offset + sz])
+                offset += sz
+        else:
+            replicated.append(pos)
+            for vals in per_chunk:
+                vals.append(x)
+
+    if atomic:
+        return [Batch(vals[0], atomic=True) for vals in per_chunk]
+    rep = tuple(replicated)
+    return [Batch(tuple(vals), atomic=False, replicated=rep)
+            for vals in per_chunk]
+
+
+def gather(batches: Sequence[Batch]):
+    """Concatenate micro-batches back into a mini-batch (reference ``pipe.py:490``).
+
+    Array positions are concatenated along dim 0; non-array positions (replicated
+    by scatter) are taken from the first batch. Returns a single value for atomic
+    batches, else a tuple.
+    """
+    if not batches:
+        raise ValueError("no batches to gather")
+    first = batches[0]
+    if first.atomic:
+        return jnp.concatenate([b.tensor for b in batches], axis=0)
+    outputs = []
+    for i in range(len(first)):
+        if is_array(first[i]) and i not in first.replicated:
+            outputs.append(jnp.concatenate([b[i] for b in batches], axis=0))
+        else:
+            outputs.append(first[i])
+    return tuple(outputs)
+
+
+# ---------------------------------------------------------------------------
+# Stacked (compiled-path) forms
+# ---------------------------------------------------------------------------
+
+def stack_scatter(tree: Any, chunks: int) -> Tuple[Any, int]:
+    """Reshape every array leaf ``[n, ...] -> [chunks, n/chunks, ...]``.
+
+    The XLA-friendly scatter used inside compiled pipelines: one static-shaped
+    stacked layout instead of a Python list of slices. Leaves whose dim 0 is not
+    divisible by ``chunks`` are right-padded with zeros; the caller receives the
+    true batch size to mask with. ``NoChunk`` leaves are broadcast to a leading
+    ``chunks`` axis.
+    """
+    if chunks <= 0:
+        raise ValueError("number of chunks must be positive")
+
+    batch_size = None
+    for leaf in jax.tree_util.tree_leaves(tree, is_leaf=lambda x: isinstance(x, NoChunk)):
+        if isinstance(leaf, NoChunk):
+            continue
+        if is_array(leaf):
+            batch_size = leaf.shape[0] if batch_size is None else batch_size
+            if leaf.shape[0] != batch_size:
+                raise ValueError("inconsistent batch sizes in stack_scatter")
+    if batch_size is None:
+        raise TypeError("stack_scatter needs at least one splittable array leaf")
+
+    mb = math.ceil(batch_size / chunks)
+    padded = mb * chunks
+
+    def split(leaf):
+        if isinstance(leaf, NoChunk):
+            return jnp.broadcast_to(leaf.value, (chunks,) + leaf.value.shape)
+        if not is_array(leaf):
+            return leaf
+        x = leaf
+        if padded != batch_size:
+            pad = [(0, padded - batch_size)] + [(0, 0)] * (x.ndim - 1)
+            x = jnp.pad(x, pad)
+        return jnp.reshape(x, (chunks, mb) + x.shape[1:])
+
+    stacked = jax.tree_util.tree_map(
+        split, tree, is_leaf=lambda x: isinstance(x, NoChunk)
+    )
+    return stacked, batch_size
+
+
+def stack_gather(tree: Any, batch_size: int) -> Any:
+    """Inverse of :func:`stack_scatter`: ``[chunks, mb, ...] -> [n, ...]``.
+
+    Drops any zero padding introduced for non-divisible batch sizes.
+    """
+
+    def merge(leaf):
+        if not is_array(leaf):
+            return leaf
+        merged = jnp.reshape(leaf, (leaf.shape[0] * leaf.shape[1],) + leaf.shape[2:])
+        if merged.shape[0] != batch_size:
+            merged = jax.lax.slice_in_dim(merged, 0, batch_size, axis=0)
+        return merged
+
+    return jax.tree_util.tree_map(merge, tree)

@@ -1,0 +1,226 @@
+"""Stage partitioning and fail-fast validation.
+
+Capability parity with the reference partitioner (``_split_module``,
+``_retrieve_device``, ``_assemble_partition``, ``_verify_module``,
+``_verify_splitting`` — reference ``pipe.py:61-87,94-118,181-218``), re-idiomized:
+on TPU there are no per-module device tags to cut partitions at, so stage
+placement is explicit — a stage count plus an optional ``balance`` list (the
+ceil-split default mirrors the tutorial's ``nn.Sequential`` split,
+``main.py:139-140``) — and device inference is replaced by mesh sharding at the
+executor layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import math
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+
+__all__ = [
+    "BalanceError",
+    "StageCtx",
+    "Stage",
+    "verify_stages",
+    "verify_splitting",
+    "split_balance",
+    "partition_sequence",
+]
+
+
+class BalanceError(ValueError):
+    """Raised when layers cannot be split into the requested stages.
+
+    Name kept for API parity with reference ``BalanceError`` (``pipe.py:36-39``).
+    The reference's ``_recommend_auto_balance`` advertises a ``balance_by_time``
+    that was never shipped (``pipe.py:42-58``); here :func:`split_balance` is the
+    real, shipped equivalent (uniform by default, cost-weighted optional).
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class StageCtx:
+    """Per-invocation context threaded to stage bodies.
+
+    Replaces the reference's implicit runtime state: the RNG fork/restore of the
+    checkpointing layer (``README.md:528-537``) becomes an explicit ``key``
+    (bit-identical dropout under recompute is free by construction — the same
+    key is simply passed again), and (microbatch, stage) indices feed profiler
+    scope names (the ``chunk%d-part%d`` spans of ``pipeline.py:205-210``).
+    """
+
+    key: Optional[jax.Array] = None
+    train: bool = False
+    microbatch: int = 0
+    stage: int = 0
+
+    def fold(self, *data: int) -> "StageCtx":
+        """Derive a ctx with a key folded over the given integers."""
+        if self.key is None:
+            return self
+        key = self.key
+        for d in data:
+            key = jax.random.fold_in(key, d)
+        return dataclasses.replace(self, key=key)
+
+
+def _accepts_ctx(fn: Callable) -> bool:
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_KEYWORD or p.name == "ctx":
+            return True
+    return False
+
+
+@dataclasses.dataclass
+class Stage:
+    """One pipeline stage: a pure function plus its parameter pytree.
+
+    ``fn(params, *inputs, ctx=StageCtx)`` maps the micro-batch payload to the
+    stage output (the reference's "partition forward", ``README.md:291-314``).
+    Plain functions without a ``ctx`` parameter are adapted automatically.
+    """
+
+    fn: Callable
+    params: Any = None
+    name: str = "stage"
+
+    def __post_init__(self):
+        self._takes_ctx = _accepts_ctx(self.fn)
+
+    def __call__(self, params, *inputs, ctx: Optional[StageCtx] = None):
+        if self._takes_ctx:
+            return self.fn(params, *inputs, ctx=ctx or StageCtx())
+        return self.fn(params, *inputs)
+
+
+def verify_stages(stages: Sequence[Any]) -> None:
+    """No duplicate stage objects (reference ``_verify_module``, ``pipe.py:61-67``)."""
+    if len(stages) == 0:
+        raise ValueError("pipeline needs at least one stage")
+    seen = set()
+    for s in stages:
+        if id(s) in seen:
+            raise ValueError("module with duplicate stages is not supported")
+        seen.add(id(s))
+
+
+def verify_splitting(params_per_stage: Sequence[Any]) -> None:
+    """No parameter array shared across stages.
+
+    Reference ``_verify_splitting`` (``pipe.py:70-87``) rejects one parameter
+    living on two devices; the SPMD analogue is one buffer appearing in two
+    stages' pytrees, which would double-count its gradient.
+    """
+    seen: dict[int, int] = {}
+    for j, params in enumerate(params_per_stage):
+        for leaf in jax.tree_util.tree_leaves(params):
+            if isinstance(leaf, (jax.Array,)) and leaf.ndim > 0:
+                key = id(leaf)
+                if key in seen and seen[key] != j:
+                    raise ValueError(
+                        "module with duplicate parameters on distinct stages is "
+                        "not supported"
+                    )
+                seen[key] = j
+
+
+def split_balance(n_layers: int, n_stages: int,
+                  balance: Optional[Sequence[int]] = None,
+                  costs: Optional[Sequence[float]] = None) -> List[int]:
+    """Layers-per-stage. Uniform ceil-split default (tutorial ``main.py:139-140``).
+
+    ``balance`` pins the split explicitly (torchgpipe-style). ``costs`` enables
+    the profiling-based balancing the reference only advertised
+    (``pipe.py:42-58``): a greedy partition equalizing per-stage cost.
+    """
+    if n_stages <= 0:
+        raise BalanceError("number of stages must be positive")
+    if balance is not None:
+        balance = list(balance)
+        if len(balance) != n_stages:
+            raise BalanceError(
+                f"balance length {len(balance)} != number of stages {n_stages}")
+        if sum(balance) != n_layers:
+            raise BalanceError(
+                f"balance {balance} does not sum to the layer count {n_layers}")
+        if any(b <= 0 for b in balance):
+            raise BalanceError("all balance entries must be positive")
+        return balance
+    if n_stages > n_layers:
+        raise BalanceError(
+            f"cannot split {n_layers} layers into {n_stages} stages")
+    if costs is not None:
+        if len(costs) != n_layers:
+            raise BalanceError("costs length must equal layer count")
+        # Greedy contiguous partition: target equal cumulative cost per stage.
+        total = float(sum(costs))
+        out, acc, taken = [], 0.0, 0
+        remaining_stages = n_stages
+        for i, c in enumerate(costs):
+            acc += c
+            taken += 1
+            remaining_layers = n_layers - i - 1
+            if (acc >= total / n_stages and remaining_stages > 1
+                    and remaining_layers >= remaining_stages - 1):
+                out.append(taken)
+                total -= acc
+                n_stages_done = len(out)
+                remaining_stages = n_stages - n_stages_done
+                acc, taken = 0.0, 0
+        out.append(taken)
+        while len(out) < n_stages:
+            out.append(0)
+        if any(b <= 0 for b in out):
+            raise BalanceError("cost-based split produced an empty stage")
+        return out
+    # Fair split: first (n_layers % n_stages) stages take one extra layer, so
+    # any n_layers >= n_stages is feasible (e.g. 4 layers / 3 stages -> [2,1,1]).
+    base, rem = divmod(n_layers, n_stages)
+    return [base + 1 if j < rem else base for j in range(n_stages)]
+
+
+def partition_sequence(layer_fns: Sequence[Callable],
+                       layer_params: Sequence[Any],
+                       n_stages: int,
+                       balance: Optional[Sequence[int]] = None,
+                       costs: Optional[Sequence[float]] = None,
+                       ) -> Tuple[List[Stage], List[Any]]:
+    """Compose consecutive layers into stage functions.
+
+    The reference's ``_assemble_partition`` wraps children in ``nn.Sequential``
+    (``pipe.py:181-188``); here a stage fn is the composition of its layers'
+    fns, with the ctx key folded per layer so dropout masks differ layer to
+    layer.
+    """
+    if len(layer_fns) != len(layer_params):
+        raise ValueError("layer_fns and layer_params must align")
+    bal = split_balance(len(layer_fns), n_stages, balance, costs)
+    stages: List[Stage] = []
+    params_per_stage: List[Any] = []
+    offset = 0
+    for j, width in enumerate(bal):
+        fns = list(layer_fns[offset:offset + width])
+        params = list(layer_params[offset:offset + width])
+        offset += width
+
+        def stage_fn(stage_params, *inputs, ctx: StageCtx = StageCtx(),
+                     _fns=tuple(fns)):
+            out = inputs
+            for li, f in enumerate(_fns):
+                lctx = ctx.fold(li)
+                sub = Stage(f)
+                result = sub(stage_params[li], *out, ctx=lctx)
+                out = result if isinstance(result, tuple) else (result,)
+            return out if len(out) > 1 else out[0]
+
+        stages.append(Stage(stage_fn, name=f"stage{j}"))
+        params_per_stage.append(params)
+    verify_stages(stages)
+    verify_splitting(params_per_stage)
+    return stages, params_per_stage

@@ -1,0 +1,77 @@
+"""Activation-checkpoint (rematerialization) policy.
+
+Capability parity with the reference checkpoint machinery — the
+``Checkpointing``/``Checkpoint``/``Recompute`` autograd pair with RNG
+save/restore and the phony-with-grad trick (reference ``pipeline.py:16,195-214,
+256-260``; quoted module at ``README.md:450-537``; pptx slides 2–3) — collapsed
+to its TPU-native essence: ``jax.checkpoint`` applied per micro-batch. The
+entire runtime mechanism (deque handoff between Checkpoint.backward and
+Recompute.backward, fork/join splicing, RNG state capture) disappears because
+
+* recompute *ordering* is compiled: XLA places the rematerialized forward
+  directly before its consuming backward ops;
+* bit-identical dropout is free: the same explicit PRNG key is passed to the
+  remat'd forward (reference needed ``save_rng_states``/``restore_rng_states``,
+  ``README.md:528-537``);
+* no phony tensors: ``jax.checkpoint`` differentiates fine with or without
+  inputs that require gradients.
+
+Three modes, same knob as reference ``pipe.py:255-260,354``:
+``always`` / ``except_last`` / ``never`` → remat micro-batches
+``[0, m)`` / ``[0, m-1)`` / ``[]``. Eval mode disables checkpointing entirely
+(reference ``pipeline.py:153-155``). ``checkpoint_stop`` is computed against the
+*actual* number of scattered micro-batches, fixing the non-divisible-chunks
+off-by-one the reference README flags (``README.md:398``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+__all__ = [
+    "CHECKPOINT_MODES",
+    "checkpoint_stop",
+    "apply_remat",
+]
+
+CHECKPOINT_MODES = ("always", "except_last", "never")
+
+
+def validate_mode(checkpoint: str) -> str:
+    if checkpoint not in CHECKPOINT_MODES:
+        raise ValueError(
+            f"checkpoint is not one of {' | '.join(CHECKPOINT_MODES)!r}: "
+            f"{checkpoint!r}")
+    return checkpoint
+
+
+def checkpoint_stop(checkpoint: str, num_microbatches: int, train: bool) -> int:
+    """First micro-batch index NOT rematerialized.
+
+    Reference map ``pipe.py:354`` (always → chunks, except_last → chunks-1,
+    never → 0) evaluated against the realized micro-batch count, with the
+    eval-mode off-switch of ``pipeline.py:153-155`` folded in.
+    """
+    validate_mode(checkpoint)
+    if not train:
+        return 0
+    m = num_microbatches
+    return {"always": m, "except_last": max(m - 1, 0), "never": 0}[checkpoint]
+
+
+def apply_remat(fn: Callable, *, enabled: bool,
+                policy=None) -> Callable:
+    """Wrap a stage body in ``jax.checkpoint`` when enabled.
+
+    ``policy`` optionally forwards a ``jax.checkpoint_policies`` member for
+    selective remat (e.g. ``dots_saveable``) — a capability beyond the
+    reference's all-or-nothing Checkpoint, kept because on TPU the
+    FLOPs-vs-HBM tradeoff is the whole point of remat.
+    """
+    if not enabled:
+        return fn
+    if policy is not None:
+        return jax.checkpoint(fn, policy=policy)
+    return jax.checkpoint(fn)

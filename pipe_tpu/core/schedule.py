@@ -1,0 +1,141 @@
+"""Pipeline schedules as data.
+
+The reference's only schedule is the GPipe fill–drain clock-cycle wavefront
+(``_clock_cycles``, reference ``pipeline.py:63-79``): at cycle ``k`` every pair
+``(i, j)`` with ``i + j == k`` runs, for micro-batch ``i`` of ``m`` on stage ``j``
+of ``n``, giving ``m + n - 1`` cycles and a bubble fraction of
+``(n - 1) / (m + n - 1)``.
+
+Here a schedule is a first-class object producing that wavefront as *data*, so
+the same (i, j) contract drives both the serial emulator and the compiled SPMD
+executor, and so alternative schedules (1F1B, interleaved 1F1B — BASELINE.json
+configs #4) slot in without touching the executors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Tuple
+
+__all__ = [
+    "clock_cycles",
+    "bubble_fraction",
+    "Schedule",
+    "GPipeSchedule",
+    "OneFOneBSchedule",
+    "InterleavedSchedule",
+    "get_schedule",
+]
+
+
+def clock_cycles(m: int, n: int) -> Iterator[List[Tuple[int, int]]]:
+    """Anti-diagonal wavefront: cycle k runs {(i, j) : i + j == k}.
+
+    Direct capability match of reference ``pipeline.py:63-79``.
+    m micro-batches over n stages in m + n - 1 cycles.
+    """
+    for k in range(m + n - 1):
+        yield [(k - j, j) for j in range(max(0, k - m + 1), min(n, k + 1))]
+
+
+def bubble_fraction(m: int, n: int) -> float:
+    """GPipe analytical bubble: (n-1)/(m+n-1) of cycles are idle fill/drain."""
+    return (n - 1) / (m + n - 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """Base schedule: maps (micro-batches m, stages n) to an ordered cycle list.
+
+    ``cycles(m, n)[k]`` is the list of (microbatch, stage) pairs that may run
+    concurrently at cycle k. Executors rely only on this contract.
+    """
+
+    name: str = "base"
+
+    def cycles(self, m: int, n: int) -> List[List[Tuple[int, int]]]:
+        raise NotImplementedError
+
+    def num_cycles(self, m: int, n: int) -> int:
+        return len(self.cycles(m, n))
+
+    def bubble(self, m: int, n: int) -> float:
+        total = self.num_cycles(m, n) * n
+        busy = m * n
+        return (total - busy) / total
+
+
+@dataclasses.dataclass(frozen=True)
+class GPipeSchedule(Schedule):
+    """Synchronous fill–drain (the reference's schedule, ``pipeline.py:63-79``)."""
+
+    name: str = "gpipe"
+
+    def cycles(self, m: int, n: int) -> List[List[Tuple[int, int]]]:
+        return [list(c) for c in clock_cycles(m, n)]
+
+
+@dataclasses.dataclass(frozen=True)
+class OneFOneBSchedule(Schedule):
+    """1F1B forward ordering.
+
+    Forward cycles are identical to GPipe's wavefront (the forward pass of 1F1B
+    is the same fill); the memory win comes from interleaving backward
+    micro-batches, which in this framework is realized by the remat policy and
+    the compiled backward of the SPMD executor rather than a runtime queue.
+    Kept as a distinct schedule so the executor can cap in-flight activations at
+    ``n`` instead of ``m``.
+    """
+
+    name: str = "1f1b"
+
+    def cycles(self, m: int, n: int) -> List[List[Tuple[int, int]]]:
+        return [list(c) for c in clock_cycles(m, n)]
+
+    def max_live_microbatches(self, m: int, n: int) -> int:
+        return min(m, n)
+
+
+@dataclasses.dataclass(frozen=True)
+class InterleavedSchedule(Schedule):
+    """Interleaved 1F1B: each device hosts ``v`` non-contiguous stage chunks.
+
+    With v virtual stages per device the fill bubble shrinks by ~v
+    (BASELINE.json config #4: 8-stage BERT-large, interleaved).
+
+    Contract note: ``cycles(m, n)`` takes ``n`` = the TOTAL number of stages
+    the executor holds (already virtual), same as every other schedule — the
+    interleaving changes *placement* (``device_of``: virtual stage s lives on
+    device ``s % n_devices``) and the per-device bubble model, not the
+    wavefront over stages.
+    """
+
+    name: str = "interleaved"
+    v: int = 2
+
+    def cycles(self, m: int, n: int) -> List[List[Tuple[int, int]]]:
+        return [list(c) for c in clock_cycles(m, n)]
+
+    def virtual_stages(self, n_devices: int) -> int:
+        return n_devices * self.v
+
+    def device_of(self, virtual_stage: int, n_devices: int) -> int:
+        return virtual_stage % n_devices
+
+    def device_bubble(self, m: int, n_devices: int) -> float:
+        """Per-device fill/drain bubble ≈ (d-1)/(m·v + d-1): v× smaller fill."""
+        d = n_devices
+        return (d - 1) / (m * self.v + d - 1)
+
+
+_SCHEDULES = {
+    "gpipe": GPipeSchedule,
+    "1f1b": OneFOneBSchedule,
+    "interleaved": InterleavedSchedule,
+}
+
+
+def get_schedule(name: str, **kwargs) -> Schedule:
+    if name not in _SCHEDULES:
+        raise ValueError(f"unknown schedule {name!r}; options: {sorted(_SCHEDULES)}")
+    return _SCHEDULES[name](**kwargs)

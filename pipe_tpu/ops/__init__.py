@@ -1,0 +1,1 @@
+"""Functional layer library (MXU-friendly jnp/einsum ops)."""

@@ -1,0 +1,354 @@
+"""Minimal functional module system + transformer building blocks.
+
+The reference stages are ``nn.Sequential`` children whose math bottoms out in
+cuDNN/cuBLAS (``main.py:148``; SURVEY §2 native table). Here layers are pure
+``(params, x) -> y`` functions grouped in lightweight Module objects — the
+TPU-native equivalent is XLA:TPU codegen onto the MXU, so the "kernel library"
+is jnp/einsum with bfloat16-friendly shapes; attention can later swap in a
+Pallas flash kernel without changing this interface.
+
+Init is shape-driven: ``module.init(key, x_spec)`` consumes only
+``shape``/``dtype`` (arrays or ``jax.ShapeDtypeStruct`` both work), so whole
+models initialize without running data through them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.partition import StageCtx
+
+__all__ = [
+    "Module", "Sequential", "Lambda", "Linear", "Embedding", "LayerNorm",
+    "Dropout", "MultiHeadAttention", "TransformerEncoderLayer",
+    "PositionalEncoding", "Decoder",
+]
+
+
+def _spec(x) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+class Module:
+    """A pure-function layer: ``init`` makes params, ``apply`` runs the math."""
+
+    name: str = "module"
+
+    def init(self, key: jax.Array, *example_inputs) -> Any:
+        raise NotImplementedError
+
+    def apply(self, params, *inputs, ctx: StageCtx = StageCtx()):
+        raise NotImplementedError
+
+    def __call__(self, params, *inputs, ctx: StageCtx = StageCtx()):
+        return self.apply(params, *inputs, ctx=ctx)
+
+    def out_spec(self, params, *input_specs):
+        """Abstract output spec, used to chain shape-driven inits."""
+        def f(*xs):
+            return self.apply(params, *xs, ctx=StageCtx())
+        return jax.eval_shape(f, *[_spec(x) for x in input_specs])
+
+
+class Lambda(Module):
+    """Wrap a parameterless function as a Module."""
+
+    def __init__(self, fn: Callable, name: str = "lambda"):
+        self.fn = fn
+        self.name = name
+
+    def init(self, key, *example_inputs):
+        return {}
+
+    def apply(self, params, *inputs, ctx: StageCtx = StageCtx()):
+        return self.fn(*inputs)
+
+
+class Sequential(Module):
+    """Ordered composition — the analogue of the ``nn.Sequential`` the reference
+    requires as Pipe input (``pipe.py:332`` via ``_verify_module``)."""
+
+    def __init__(self, layers: Sequence[Module], name: str = "sequential"):
+        self.layers = list(layers)
+        self.name = name
+
+    def __len__(self):
+        return len(self.layers)
+
+    def __iter__(self):
+        return iter(self.layers)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(self.layers[idx])
+        return self.layers[idx]
+
+    def init(self, key, *example_inputs):
+        params = []
+        specs = [_spec(x) for x in example_inputs]
+        for i, layer in enumerate(self.layers):
+            lkey = jax.random.fold_in(key, i)
+            p = layer.init(lkey, *specs)
+            params.append(p)
+            out = layer.out_spec(p, *specs)
+            specs = list(out) if isinstance(out, (tuple, list)) else [out]
+        return params
+
+    def apply(self, params, *inputs, ctx: StageCtx = StageCtx()):
+        if len(params) != len(self.layers):
+            raise ValueError(
+                f"Sequential got {len(params)} param entries for "
+                f"{len(self.layers)} layers")
+        out = inputs
+        for i, (layer, p) in enumerate(zip(self.layers, params)):
+            r = layer.apply(p, *out, ctx=ctx.fold(i))
+            out = r if isinstance(r, tuple) else (r,)
+        return out if len(out) > 1 else out[0]
+
+
+class Linear(Module):
+    def __init__(self, features: int, use_bias: bool = True,
+                 dtype=jnp.float32, name: str = "linear"):
+        self.features = features
+        self.use_bias = use_bias
+        self.dtype = dtype
+        self.name = name
+
+    def init(self, key, x):
+        in_features = jnp.shape(x)[-1]
+        bound = 1.0 / math.sqrt(in_features)
+        wkey, bkey = jax.random.split(key)
+        params = {
+            "w": jax.random.uniform(wkey, (in_features, self.features),
+                                    self.dtype, -bound, bound),
+        }
+        if self.use_bias:
+            params["b"] = jax.random.uniform(bkey, (self.features,),
+                                             self.dtype, -bound, bound)
+        return params
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        y = jnp.einsum("...i,io->...o", x, params["w"])
+        if self.use_bias:
+            y = y + params["b"]
+        return y
+
+
+class Embedding(Module):
+    """Token embedding with the tutorial's sqrt(d_model) scaling
+    (reference ``Encoder``, ``main.py:139-157`` vicinity)."""
+
+    def __init__(self, vocab: int, features: int, scale: bool = True,
+                 dtype=jnp.float32, name: str = "embedding"):
+        self.vocab = vocab
+        self.features = features
+        self.scale = scale
+        self.dtype = dtype
+        self.name = name
+
+    def init(self, key, x):
+        table = jax.random.normal(key, (self.vocab, self.features), self.dtype)
+        return {"table": table}
+
+    def apply(self, params, tokens, ctx: StageCtx = StageCtx()):
+        y = jnp.take(params["table"], tokens, axis=0)
+        if self.scale:
+            y = y * jnp.asarray(math.sqrt(self.features), y.dtype)
+        return y
+
+
+class LayerNorm(Module):
+    def __init__(self, eps: float = 1e-5, dtype=jnp.float32, name: str = "ln"):
+        self.eps = eps
+        self.dtype = dtype
+        self.name = name
+
+    def init(self, key, x):
+        d = jnp.shape(x)[-1]
+        return {"g": jnp.ones((d,), self.dtype), "b": jnp.zeros((d,), self.dtype)}
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + self.eps)
+        return y * params["g"] + params["b"]
+
+
+class Dropout(Module):
+    """Inverted dropout driven by the explicit ctx key.
+
+    Under remat the identical key replays, so the recomputed forward is
+    bit-identical to the stored one — the property the reference bought with
+    CUDA RNG state capture (``README.md:528-537``).
+    """
+
+    def __init__(self, rate: float, name: str = "dropout"):
+        self.rate = rate
+        self.name = name
+
+    def init(self, key, x):
+        return {}
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        if not ctx.train or self.rate <= 0.0 or ctx.key is None:
+            return x
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(ctx.key, keep, jnp.shape(x))
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False,
+                          dropout_rate: float = 0.0,
+                          dropout_key: Optional[jax.Array] = None,
+                          train: bool = False):
+    """Softmax attention with float32 logits (MXU-friendly einsum form)."""
+    d = q.shape[-1]
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32)
+    logits = logits / math.sqrt(d)
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), bool))
+        logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if train and dropout_rate > 0.0 and dropout_key is not None:
+        keep = 1.0 - dropout_rate
+        m = jax.random.bernoulli(dropout_key, keep, weights.shape)
+        weights = jnp.where(m, weights / keep, jnp.zeros_like(weights))
+    return jnp.einsum("...hqk,...khd->...qhd", weights, v)
+
+
+class MultiHeadAttention(Module):
+    """Self-attention block (the math inside ``nn.TransformerEncoderLayer``,
+    reference ``main.py:148``), batch-first: x is [batch, seq, d_model]."""
+
+    def __init__(self, d_model: int, nhead: int, dropout: float = 0.0,
+                 causal: bool = True, dtype=jnp.float32, name: str = "mha"):
+        if d_model % nhead:
+            raise ValueError("nhead must divide d_model")
+        self.d_model = d_model
+        self.nhead = nhead
+        self.head_dim = d_model // nhead
+        self.dropout = dropout
+        self.causal = causal
+        self.dtype = dtype
+        self.name = name
+
+    def init(self, key, x):
+        keys = jax.random.split(key, 4)
+        bound = 1.0 / math.sqrt(self.d_model)
+
+        def mat(k):
+            return jax.random.uniform(k, (self.d_model, self.d_model),
+                                      self.dtype, -bound, bound)
+
+        return {
+            "wq": mat(keys[0]), "wk": mat(keys[1]), "wv": mat(keys[2]),
+            "wo": mat(keys[3]),
+            "bq": jnp.zeros((self.d_model,), self.dtype),
+            "bk": jnp.zeros((self.d_model,), self.dtype),
+            "bv": jnp.zeros((self.d_model,), self.dtype),
+            "bo": jnp.zeros((self.d_model,), self.dtype),
+        }
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        b, s, _ = x.shape
+        h, hd = self.nhead, self.head_dim
+
+        def proj(w, bias):
+            return (jnp.einsum("bsd,de->bse", x, w) + bias).reshape(b, s, h, hd)
+
+        q = proj(params["wq"], params["bq"])
+        k = proj(params["wk"], params["bk"])
+        v = proj(params["wv"], params["bv"])
+        dk = ctx.fold(1).key if ctx.key is not None else None
+        o = dot_product_attention(q, k, v, causal=self.causal,
+                                  dropout_rate=self.dropout, dropout_key=dk,
+                                  train=ctx.train)
+        o = o.reshape(b, s, self.d_model)
+        return jnp.einsum("bsd,de->bse", o, params["wo"]) + params["bo"]
+
+
+class TransformerEncoderLayer(Module):
+    """Post-LN transformer block — semantics of torch's default
+    ``nn.TransformerEncoderLayer`` (reference ``main.py:148``): self-attn →
+    add&norm → FFN(ReLU) → add&norm, dropout on each residual branch."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.0, causal: bool = True,
+                 dtype=jnp.float32, name: str = "encoder_layer"):
+        self.attn = MultiHeadAttention(d_model, nhead, dropout, causal, dtype)
+        self.ff1 = Linear(dim_feedforward, dtype=dtype)
+        self.ff2 = Linear(d_model, dtype=dtype)
+        self.ln1 = LayerNorm(dtype=dtype)
+        self.ln2 = LayerNorm(dtype=dtype)
+        self.drop = Dropout(dropout)
+        self.name = name
+
+    def init(self, key, x):
+        ks = jax.random.split(key, 5)
+        d_model_spec = _spec(x)
+        ff_in = self.ff1.init(ks[1], x)
+        hidden = jax.ShapeDtypeStruct(
+            jnp.shape(x)[:-1] + (self.ff1.features,), jnp.result_type(x))
+        return {
+            "attn": self.attn.init(ks[0], x),
+            "ff1": ff_in,
+            "ff2": self.ff2.init(ks[2], hidden),
+            "ln1": self.ln1.init(ks[3], d_model_spec),
+            "ln2": self.ln2.init(ks[4], d_model_spec),
+        }
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        a = self.attn.apply(params["attn"], x, ctx=ctx.fold(0))
+        a = self.drop.apply({}, a, ctx=ctx.fold(1))
+        x = self.ln1.apply(params["ln1"], x + a, ctx=ctx)
+        h = jax.nn.relu(self.ff1.apply(params["ff1"], x, ctx=ctx))
+        h = self.drop.apply({}, h, ctx=ctx.fold(2))
+        h = self.ff2.apply(params["ff2"], h, ctx=ctx)
+        h = self.drop.apply({}, h, ctx=ctx.fold(3))
+        return self.ln2.apply(params["ln2"], x + h, ctx=ctx)
+
+
+class PositionalEncoding(Module):
+    """Sinusoidal positions + dropout (tutorial ``PositionalEncoding``,
+    reference ``main.py`` model section). Batch-first: [batch, seq, d]."""
+
+    def __init__(self, d_model: int, dropout: float = 0.0,
+                 max_len: int = 5000, dtype=jnp.float32, name: str = "posenc"):
+        self.d_model = d_model
+        self.drop = Dropout(dropout)
+        position = np.arange(max_len)[:, None]
+        div = np.exp(np.arange(0, d_model, 2) * (-math.log(10000.0) / d_model))
+        pe = np.zeros((max_len, d_model), np.float32)
+        pe[:, 0::2] = np.sin(position * div)
+        pe[:, 1::2] = np.cos(position * div)
+        self.pe = jnp.asarray(pe, dtype)
+        self.name = name
+
+    def init(self, key, x):
+        return {}
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        s = x.shape[-2]
+        x = x + self.pe[:s]
+        return self.drop.apply({}, x, ctx=ctx)
+
+
+class Decoder(Module):
+    """Final projection to vocab logits (tutorial ``Decoder``, reference
+    ``main.py`` model section)."""
+
+    def __init__(self, vocab: int, dtype=jnp.float32, name: str = "decoder"):
+        self.proj = Linear(vocab, dtype=dtype)
+        self.name = name
+
+    def init(self, key, x):
+        return self.proj.init(key, x)
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        return self.proj.apply(params, x, ctx=ctx)

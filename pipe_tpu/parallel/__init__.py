@@ -1,0 +1,1 @@
+"""Executors: serial emulator and SPMD shard_map pipeline."""

@@ -1,0 +1,91 @@
+"""Serial clock-cycle executor: the pipeline semantics without the mesh.
+
+This is the TPU build's rebirth of the reference's CPU-sentinel-stream trick
+(``AbstractStream`` admitting a CPU fallback, reference ``pipe.py:22``,
+``pipeline.py:22``): the full scheduler — wavefront order, per-microbatch remat,
+skip carries, ctx/RNG threading — runs on one device with no collectives, so
+transparency tests (pipelined loss == unpipelined loss) and heterogeneous-stage
+models need no mesh at all. The whole executor is pure and jit-able; the Python
+loops unroll into one XLA program.
+
+Where the reference needed ``fence`` (Copy/Wait stream ops + fork/join phony
+edges, ``pipeline.py:119-142``) between ``compute`` dispatches, here the data
+dependence between cycle k and k+1 is simply function composition — XLA sees
+the true dependency graph, and backward order falls out of ``jax.grad``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, List, Optional, Sequence
+
+import jax
+
+from ..core import microbatch as mb
+from ..core.partition import Stage, StageCtx
+from ..core.remat import apply_remat, checkpoint_stop, validate_mode
+from ..core.schedule import GPipeSchedule, Schedule
+
+__all__ = ["run"]
+
+
+def _compute_one(stage: Stage, params: Any, batch: mb.Batch, ctx: StageCtx,
+                 remat: bool, remat_policy) -> mb.Batch:
+    """Run one (microbatch, stage) task, optionally under jax.checkpoint.
+
+    The PRNG key rides as an explicit argument of the remat'd function so the
+    recomputed forward sees the identical key — the reference's
+    ``save/restore_rng_states`` (``README.md:528-537``) with no runtime state.
+    """
+    key = ctx.key
+
+    def task(p, k, *inputs):
+        inner = StageCtx(key=k, train=ctx.train,
+                         microbatch=ctx.microbatch, stage=ctx.stage)
+        return stage(p, *inputs, ctx=inner)
+
+    task = apply_remat(task, enabled=remat, policy=remat_policy)
+    with jax.named_scope(f"chunk{ctx.microbatch}-stage{ctx.stage}"):
+        return batch.call(lambda *inputs: task(params, key, *inputs))
+
+
+def run(stages: Sequence[Stage],
+        params_per_stage: Sequence[Any],
+        batches: List[mb.Batch],
+        *,
+        schedule: Optional[Schedule] = None,
+        checkpoint: str = "never",
+        train: bool = False,
+        key: Optional[jax.Array] = None,
+        remat_policy=None,
+        skip_tracker=None) -> List[mb.Batch]:
+    """Execute the clock-cycle schedule serially; returns transformed batches.
+
+    Mirrors ``Pipeline.run`` (reference ``pipeline.py:100-117``): iterate the
+    wavefront; for each (i, j) run stage j on micro-batch i, rematerializing
+    when ``i < checkpoint_stop`` (``pipeline.py:195-214``). The first stage
+    failure propagates immediately (eager Python → strictly earlier than the
+    reference's hold-and-drain, ``pipeline.py:239-247``, which existed only
+    because of worker threads).
+    """
+    validate_mode(checkpoint)
+    schedule = schedule or GPipeSchedule()
+    m, n = len(batches), len(stages)
+    stop = checkpoint_stop(checkpoint, m, train)
+    batches = list(batches)
+
+    for cycle in schedule.cycles(m, n):
+        for (i, j) in cycle:
+            if not (0 <= i < m and 0 <= j < n):
+                raise IndexError(
+                    f"schedule {schedule.name!r} emitted task (microbatch={i}, "
+                    f"stage={j}) outside the {m}x{n} grid")
+            ctx = StageCtx(key=key, train=train, microbatch=i, stage=j)
+            ctx = ctx.fold(i, j) if key is not None else ctx
+            cm = (skip_tracker.scope(microbatch=i, stage=j)
+                  if skip_tracker is not None else contextlib.nullcontext())
+            with cm:
+                batches[i] = _compute_one(
+                    stages[j], params_per_stage[j], batches[i], ctx,
+                    remat=i < stop, remat_policy=remat_policy)
+    return batches

@@ -1,0 +1,165 @@
+"""The user API: ``Pipe`` — wrap a Sequential, train it pipelined.
+
+Capability parity with reference ``Pipe`` (``pipe.py:224-494``):
+
+* constructor ``Pipe(module, chunks, checkpoint, ...)`` with the same fail-fast
+  validation (``pipe.py:324-345``);
+* container protocol ``__len__``/``__getitem__``/``__iter__`` over stages
+  (``pipe.py:358-386``);
+* ``forward`` = check → scatter → run schedule → gather (``pipe.py:431-494``);
+* ``NoChunk`` passthrough for non-batch inputs (``pipe.py:462-464``).
+
+Deliberate re-idiomizations (documented, not ported):
+
+* Stage placement is a stage count / ``balance`` list, not device tags —
+  ``_retrieve_device``'s cut-at-device-change (``pipe.py:94-118``) has no TPU
+  meaning; the mesh owns placement. ``WithDevice`` is therefore not carried.
+* ``MOVING_DENIED`` (``pipe.py:388-415``) is moot: params are immutable pytrees;
+  there is no ``.cuda()``/``.to()`` to deny.
+* The RPC/RRef layer is vestigial in the reference (disabled with zero effect,
+  ``pipe.py:318-323,491-494``; ``README.md:545``) and is not carried; multi-host
+  is JAX's single-controller runtime.
+* ``forward`` is pure: ``out = pipe(params, x, key=..., train=...)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+
+from .core import microbatch as mb
+from .core.partition import (Stage, StageCtx, split_balance, verify_splitting,
+                             verify_stages)
+from .core.remat import validate_mode
+from .core.schedule import GPipeSchedule, Schedule, get_schedule
+from .ops.layers import Module, Sequential
+from .parallel import emulator
+
+__all__ = ["Pipe", "NoChunk", "BalanceError"]
+
+NoChunk = mb.NoChunk
+from .core.partition import BalanceError  # re-export (API parity)
+
+
+class Pipe:
+    """Synchronous GPipe pipeline over a Sequential of stages.
+
+    Unlike the reference's stateful ``nn.Module`` wrapper, ``Pipe`` is a pure
+    program: ``init`` returns per-stage params, ``__call__`` maps
+    ``(params, *inputs)`` to outputs. Executor selection:
+
+    * no mesh (default): serial clock-cycle emulator, any stage shapes;
+    * ``mesh=``: SPMD shard_map executor over the ``stage`` axis (homogeneous
+      stage stack; see ``pipe_tpu.parallel.spmd``).
+    """
+
+    def __init__(self,
+                 module: Sequential,
+                 chunks: int = 1,
+                 checkpoint: str = "except_last",
+                 *,
+                 n_stages: Optional[int] = None,
+                 balance: Optional[Sequence[int]] = None,
+                 schedule: str = "gpipe",
+                 deferred_batch_norm: bool = False):
+        # --- fail-fast validation (reference pipe.py:324-345) ---
+        if not isinstance(chunks, int) or isinstance(chunks, bool):
+            raise TypeError("chunks must be an integer")
+        if chunks <= 0:
+            raise ValueError("number of chunks must be positive")
+        validate_mode(checkpoint)
+        if not isinstance(module, Sequential):
+            raise TypeError("module must be a pipe_tpu Sequential")
+        seen = set()
+        for layer in module:
+            if id(layer) in seen:
+                raise ValueError("module with duplicate children is not supported")
+            seen.add(id(layer))
+
+        self.chunks = chunks
+        self.checkpoint = checkpoint
+        self.module = module
+
+        if deferred_batch_norm:
+            try:
+                from .extras.norm import convert_deferred_batch_norm
+            except ImportError as e:
+                raise NotImplementedError(
+                    "deferred_batch_norm is not implemented yet "
+                    "(extras/norm is on the roadmap; reference capability "
+                    "pipe.py:261-266)") from e
+            module = convert_deferred_batch_norm(module, chunks)
+            self.module = module
+        self.deferred_batch_norm = deferred_batch_norm
+
+        if balance is not None and n_stages is None:
+            n_stages = len(balance)
+        if n_stages is None:
+            n_stages = 1
+        self.balance = split_balance(len(module), n_stages, balance)
+        self.n_stages = n_stages
+
+        # Partition the Sequential into per-stage sub-Sequentials
+        # (reference _split_module/_assemble_partition, pipe.py:181-218).
+        self.partitions: List[Sequential] = []
+        offset = 0
+        for width in self.balance:
+            self.partitions.append(module[offset:offset + width])
+            offset += width
+
+        self.stages: List[Stage] = [
+            Stage(part.apply, name=f"stage{j}")
+            for j, part in enumerate(self.partitions)
+        ]
+        verify_stages(self.stages)
+        self._schedule: Schedule = get_schedule(schedule)
+
+    # --- container protocol (reference pipe.py:358-386) ---
+
+    def __len__(self) -> int:
+        """Total number of layers across all partitions."""
+        return sum(len(p) for p in self.partitions)
+
+    def __getitem__(self, index: int) -> Module:
+        layers: List[Module] = []
+        for p in self.partitions:
+            layers.extend(p)
+        return layers[index]
+
+    def __iter__(self):
+        for p in self.partitions:
+            yield from p
+
+    # --- params ---
+
+    def init(self, key: jax.Array, *example_inputs) -> List[Any]:
+        """Per-stage parameter pytrees, shapes chained stage to stage."""
+        params: List[Any] = []
+        specs = [jax.ShapeDtypeStruct(jax.numpy.shape(x), jax.numpy.result_type(x))
+                 for x in example_inputs]
+        for j, part in enumerate(self.partitions):
+            pkey = jax.random.fold_in(key, j)
+            p = part.init(pkey, *specs)
+            params.append(p)
+            out = part.out_spec(p, *specs)
+            specs = list(out) if isinstance(out, (tuple, list)) else [out]
+        verify_splitting(params)
+        return params
+
+    # --- forward (reference pipe.py:431-494) ---
+
+    def __call__(self, params: Sequence[Any], *inputs,
+                 key: Optional[jax.Array] = None,
+                 train: bool = False,
+                 remat_policy=None):
+        mb.check(*inputs)
+        batches = mb.scatter(inputs, self.chunks)
+        batches = emulator.run(
+            self.stages, list(params), batches,
+            schedule=self._schedule,
+            checkpoint=self.checkpoint,
+            train=train, key=key, remat_policy=remat_policy)
+        return mb.gather(batches)
+
+    forward = __call__

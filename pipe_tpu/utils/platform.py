@@ -1,0 +1,45 @@
+"""Platform helpers: force the virtual multi-device CPU platform for tests/CI.
+
+The TPU-build analogue of the reference's CPU-sentinel-stream trick
+(``AbstractStream`` admits a CPU fallback so every layer unit-tests without
+GPUs — reference pipe.py:22, pipeline.py:22): here the whole framework —
+scheduler, SPMD shard_map pipeline, ppermute rings, remat — runs on N virtual
+CPU devices, so multi-"chip" tests need no TPU pod.
+
+This machine additionally boots every interpreter through an ``.axon_site``
+sitecustomize registering a real-TPU PJRT plugin and pinning
+``JAX_PLATFORMS=axon``; with that plugin registered, CPU selection via env
+vars hangs at backend init. :func:`force_cpu_platform` therefore neutralizes
+the plugin in-process (pop the factory, flip ``jax_platforms`` through
+``jax.config``) — which works whether or not jax was already imported.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["force_cpu_platform", "on_real_tpu"]
+
+
+def force_cpu_platform(num_devices: int = 8) -> None:
+    """Make jax see ``num_devices`` CPU devices, even on axon-hooked machines.
+
+    Must run before the first jax *computation* (backend init), but is safe
+    after ``import jax``.
+    """
+    os.environ.setdefault("PIPE_TPU_FORCED_CPU", "1")
+    import jax
+    from jax._src import xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", num_devices)
+
+
+def on_real_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform.lower() in ("tpu", "axon")
+    except Exception:
+        return False

@@ -1,0 +1,19 @@
+"""Test configuration: run the suite on a virtual 8-device CPU platform.
+
+This is the TPU-build analogue of the reference's CPU-sentinel-stream trick
+(``AbstractStream`` admitting a CPU fallback, reference pipe.py:22,
+pipeline.py:22): every layer — scheduler, SPMD pipeline, ppermute rings,
+checkpointing — runs on plain CPU with a simulated 8-device mesh, so the full
+multi-"device" suite needs no TPUs and no cluster. See
+``pipe_tpu.utils.platform`` for why this is done via jax.config rather than
+env vars on this machine.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pipe_tpu.utils.platform import force_cpu_platform
+
+force_cpu_platform(num_devices=8)

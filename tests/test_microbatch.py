@@ -1,0 +1,123 @@
+"""Micro-batch layer tests — model: upstream ``test_microbatch.py`` strategy
+(SURVEY §4): scatter/gather identity, torch.chunk sizing, NoChunk, Batch ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.core import microbatch as mb
+
+
+def test_scatter_gather_identity():
+    x = jnp.arange(32.0).reshape(8, 4)
+    batches = mb.scatter((x,), 4)
+    assert len(batches) == 4
+    assert all(b.atomic for b in batches)
+    out = mb.gather(batches)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_scatter_torch_chunk_semantics_non_divisible():
+    # torch.chunk(10, 4) -> sizes [3, 3, 3, 1]
+    x = jnp.arange(10.0)[:, None]
+    batches = mb.scatter((x,), 4)
+    assert [b.tensor.shape[0] for b in batches] == [3, 3, 3, 1]
+    np.testing.assert_array_equal(np.asarray(mb.gather(batches)), np.asarray(x))
+
+
+def test_scatter_fewer_chunks_than_requested():
+    # torch.chunk(6, 4) -> ceil size 2 -> only 3 chunks
+    x = jnp.arange(6.0)[:, None]
+    batches = mb.scatter((x,), 4)
+    assert len(batches) == 3
+    assert [b.tensor.shape[0] for b in batches] == [2, 2, 2]
+
+
+def test_scatter_multiple_inputs_and_nonarray():
+    x = jnp.ones((8, 2))
+    y = jnp.zeros((8, 3))
+    batches = mb.scatter((x, "tag", y), 2)
+    assert len(batches) == 2
+    assert not batches[0].atomic
+    assert batches[0][1] == "tag"
+    out = mb.gather(batches)
+    assert out[1] == "tag"
+    assert out[0].shape == (8, 2) and out[2].shape == (8, 3)
+
+
+def test_nochunk_replicated():
+    x = jnp.ones((8, 2))
+    mask = jnp.arange(5)
+    batches = mb.scatter((x, mb.NoChunk(mask)), 4)
+    for b in batches:
+        np.testing.assert_array_equal(np.asarray(b[1]), np.asarray(mask))
+    out = mb.gather(batches)
+    assert out[1].shape == (5,)
+
+
+def test_nochunk_rejects_nonarray():
+    with pytest.raises(TypeError):
+        mb.NoChunk("not an array")
+
+
+def test_check_requires_array():
+    with pytest.raises(TypeError):
+        mb.check("just a string")
+    with pytest.raises(TypeError):
+        mb.check()
+    mb.check(jnp.ones(3))  # no raise
+
+
+def test_inconsistent_batch_sizes():
+    with pytest.raises(ValueError):
+        mb.scatter((jnp.ones((8, 2)), jnp.ones((4, 2))), 2)
+
+
+def test_batch_call_and_atomicity():
+    b = mb.Batch(jnp.ones((2, 3)), atomic=True)
+    out = b.call(lambda t: t * 2)
+    assert out.atomic
+    out2 = b.call(lambda t: (t, t + 1))
+    assert not out2.atomic and len(out2) == 2
+
+
+def test_batch_find_tensor_idx():
+    b = mb.Batch(("meta", jnp.ones(3)), atomic=False)
+    assert b.find_tensor_idx() == 1
+    with pytest.raises(ValueError):
+        mb.Batch(("a", "b"), atomic=False).find_tensor_idx()
+
+
+def test_stack_scatter_gather_roundtrip():
+    x = jnp.arange(24.0).reshape(8, 3)
+    stacked, bs = mb.stack_scatter(x, 4)
+    assert stacked.shape == (4, 2, 3) and bs == 8
+    out = mb.stack_gather(stacked, bs)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_stack_scatter_pads_non_divisible():
+    x = jnp.arange(10.0)[:, None]
+    stacked, bs = mb.stack_scatter(x, 4)
+    assert stacked.shape == (4, 3, 1) and bs == 10
+    out = mb.stack_gather(stacked, bs)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_stack_scatter_tree_with_nochunk():
+    tree = {"x": jnp.ones((8, 2)), "m": mb.NoChunk(jnp.arange(3))}
+    stacked, bs = mb.stack_scatter(tree, 2)
+    assert stacked["x"].shape == (2, 4, 2)
+    assert stacked["m"].shape == (2, 3)
+
+
+def test_scatter_under_jit():
+    @jax.jit
+    def f(x):
+        batches = mb.scatter((x,), 4)
+        batches = [b.call(lambda t: t * 2) for b in batches]
+        return mb.gather(batches)
+
+    x = jnp.arange(8.0)[:, None]
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x) * 2)

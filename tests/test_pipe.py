@@ -1,0 +1,211 @@
+"""Pipe API + transparency tests.
+
+Transparency is THE correctness property of the whole design (upstream
+``test_transparency`` per SURVEY §4): micro-batching + pipeline scheduling +
+activation checkpointing must produce the identical result (and gradients) as
+the plain unpipelined model, up to dtype tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pipe_tpu
+from pipe_tpu import (Dropout, Lambda, Linear, NoChunk, Pipe, Sequential,
+                      StageCtx)
+
+
+def make_mlp(key, depth=4, width=8):
+    layers = [Linear(width) for _ in range(depth)]
+    seq = Sequential(layers)
+    params = seq.init(key, jnp.zeros((2, width)))
+    return seq, params
+
+
+# ---------- validation parity (reference pipe.py:324-345) ----------
+
+def test_chunks_less_than_1():
+    seq, _ = make_mlp(jax.random.key(0))
+    with pytest.raises(ValueError):
+        Pipe(seq, chunks=0)
+    with pytest.raises(ValueError):
+        Pipe(seq, chunks=-1)
+
+
+def test_chunks_not_int():
+    seq, _ = make_mlp(jax.random.key(0))
+    with pytest.raises(TypeError):
+        Pipe(seq, chunks=2.5)
+
+
+def test_bad_checkpoint_mode():
+    seq, _ = make_mlp(jax.random.key(0))
+    with pytest.raises(ValueError):
+        Pipe(seq, chunks=2, checkpoint="sometimes")
+
+
+def test_module_must_be_sequential():
+    with pytest.raises(TypeError):
+        Pipe([Linear(4)], chunks=1)
+
+
+def test_duplicate_children_rejected():
+    layer = Linear(8)
+    with pytest.raises(ValueError):
+        Pipe(Sequential([layer, layer]), chunks=1)
+
+
+def test_balance_errors():
+    seq, _ = make_mlp(jax.random.key(0), depth=4)
+    with pytest.raises(pipe_tpu.BalanceError):
+        Pipe(seq, chunks=1, balance=[1, 1])  # doesn't sum to 4
+    with pytest.raises(pipe_tpu.BalanceError):
+        Pipe(seq, chunks=1, n_stages=5)  # more stages than layers
+
+
+# ---------- container protocol (reference pipe.py:358-386) ----------
+
+def test_container_protocol():
+    seq, _ = make_mlp(jax.random.key(0), depth=4)
+    pipe = Pipe(seq, chunks=2, n_stages=2)
+    assert len(pipe) == 4
+    assert pipe[0] is seq[0]
+    assert list(iter(pipe)) == list(seq)
+    assert pipe.balance == [2, 2]
+
+
+# ---------- transparency ----------
+
+@pytest.mark.parametrize("chunks", [1, 2, 4, 3])  # 3: non-divisible (8 % 3 != 0)
+@pytest.mark.parametrize("n_stages", [1, 2, 4])
+def test_forward_transparency(chunks, n_stages):
+    key = jax.random.key(0)
+    seq, params = make_mlp(key)
+    pipe = Pipe(seq, chunks=chunks, checkpoint="never", n_stages=n_stages)
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+
+    expected = seq.apply(params, x)
+    # regroup flat per-layer params into per-stage lists
+    stage_params = _regroup(params, pipe.balance)
+    got = pipe(stage_params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _regroup(flat_params, balance):
+    out, off = [], 0
+    for w in balance:
+        out.append(flat_params[off:off + w])
+        off += w
+    return out
+
+
+@pytest.mark.parametrize("checkpoint", ["never", "except_last", "always"])
+def test_gradient_transparency(checkpoint):
+    key = jax.random.key(0)
+    seq, params = make_mlp(key)
+    pipe = Pipe(seq, chunks=4, checkpoint=checkpoint, n_stages=2)
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    stage_params = _regroup(params, pipe.balance)
+
+    def plain_loss(p):
+        return jnp.mean(seq.apply(p, x) ** 2)
+
+    def pipe_loss(sp):
+        return jnp.mean(pipe(sp, x, train=True) ** 2)
+
+    expected = jax.grad(plain_loss)(params)
+    got = jax.grad(pipe_loss)(stage_params)
+    flat_e = jax.tree_util.tree_leaves(expected)
+    flat_g = jax.tree_util.tree_leaves(got)
+    assert len(flat_e) == len(flat_g)
+    for e, g in zip(flat_e, flat_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipe_init_matches_sequential_shapes():
+    seq = Sequential([Linear(16), Linear(8), Linear(4)])
+    pipe = Pipe(seq, chunks=2, n_stages=3, checkpoint="never")
+    sp = pipe.init(jax.random.key(0), jnp.zeros((2, 16)))
+    assert len(sp) == 3
+    assert sp[0][0]["w"].shape == (16, 16)
+    assert sp[1][0]["w"].shape == (16, 8)
+    assert sp[2][0]["w"].shape == (8, 4)
+    out = pipe(sp, jnp.ones((4, 16)))
+    assert out.shape == (4, 4)
+
+
+def test_dropout_deterministic_given_key():
+    seq = Sequential([Linear(8), Dropout(0.5), Linear(8)])
+    pipe = Pipe(seq, chunks=2, n_stages=2, balance=[2, 1], checkpoint="never")
+    sp = pipe.init(jax.random.key(0), jnp.zeros((2, 8)))
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    k = jax.random.key(42)
+    a = pipe(sp, x, key=k, train=True)
+    b = pipe(sp, x, key=k, train=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = pipe(sp, x, key=jax.random.key(43), train=True)
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+
+
+def test_remat_matches_no_remat_with_dropout():
+    """The RNG-replay property: remat'd forward must be bit-identical, so
+    gradients under 'always' equal gradients under 'never' even with dropout
+    (what the reference buys with save/restore_rng_states, README.md:528-537)."""
+    seq = Sequential([Linear(8), Dropout(0.5), Linear(8)])
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    k = jax.random.key(7)
+
+    grads = {}
+    for mode in ("never", "always"):
+        pipe = Pipe(seq, chunks=2, n_stages=1, checkpoint=mode)
+        sp = pipe.init(jax.random.key(0), jnp.zeros((2, 8)))
+
+        def loss(p):
+            return jnp.mean(pipe(p, x, key=k, train=True) ** 2)
+
+        grads[mode] = jax.grad(loss)(sp)
+    for a, b in zip(jax.tree_util.tree_leaves(grads["never"]),
+                    jax.tree_util.tree_leaves(grads["always"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_eval_mode_disables_checkpoint():
+    # train=False => checkpoint_stop == 0 (reference pipeline.py:153-155);
+    # observable as: no error, identical output to never-mode.
+    seq, params = make_mlp(jax.random.key(0))
+    sp = _regroup(params, [2, 2])
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    p_always = Pipe(seq, chunks=2, n_stages=2, checkpoint="always")
+    p_never = Pipe(seq, chunks=2, n_stages=2, checkpoint="never")
+    np.testing.assert_array_equal(
+        np.asarray(p_always(sp, x, train=False)),
+        np.asarray(p_never(sp, x, train=False)))
+
+
+def test_multi_input_stage_with_nochunk():
+    """Non-batch side input rides NoChunk through the pipeline."""
+    scale_layer = Lambda(lambda x, s: (x * s, s), name="scale")
+    sum_layer = Lambda(lambda x, s: x + s, name="add")
+    seq = Sequential([scale_layer, sum_layer])
+    pipe = Pipe(seq, chunks=2, n_stages=2, checkpoint="never")
+    x = jnp.ones((4, 3))
+    out = pipe([[{}], [{}]], x, NoChunk(jnp.full((1,), 2.0)))
+    np.testing.assert_allclose(np.asarray(out), np.ones((4, 3)) * 2 + 2.0)
+
+
+def test_jit_whole_pipe():
+    seq, params = make_mlp(jax.random.key(0))
+    pipe = Pipe(seq, chunks=4, n_stages=2, checkpoint="except_last")
+    sp = _regroup(params, pipe.balance)
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+
+    @jax.jit
+    def step(p, x, k):
+        return pipe(p, x, key=k, train=True)
+
+    out = step(sp, x, jax.random.key(0))
+    assert out.shape == (8, 8)

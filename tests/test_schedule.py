@@ -1,0 +1,78 @@
+"""Schedule tests — the (i, j) wavefront contract of reference
+``pipeline.py:63-79`` and the bubble cost model."""
+
+import pytest
+
+from pipe_tpu.core.schedule import (GPipeSchedule, InterleavedSchedule,
+                                    OneFOneBSchedule, bubble_fraction,
+                                    clock_cycles, get_schedule)
+
+
+def test_clock_cycles_matches_reference():
+    # m=3, n=2: [(0,0)], [(1,0),(0,1)], [(2,0),(1,1)], [(2,1)]
+    got = [sorted(c) for c in clock_cycles(3, 2)]
+    assert got == [[(0, 0)], [(0, 1), (1, 0)], [(1, 1), (2, 0)], [(2, 1)]]
+
+
+def test_clock_cycles_counts():
+    for m in (1, 2, 5, 8):
+        for n in (1, 2, 4):
+            cycles = list(clock_cycles(m, n))
+            assert len(cycles) == m + n - 1
+            tasks = [t for c in cycles for t in c]
+            assert sorted(tasks) == [(i, j) for i in range(m) for j in range(n)]
+
+
+def test_every_task_exactly_once_no_conflicts():
+    cycles = list(clock_cycles(8, 4))
+    for c in cycles:
+        # within a cycle, every stage appears at most once (parallel-safe)
+        stages = [j for (_, j) in c]
+        assert len(stages) == len(set(stages))
+        # wavefront invariant
+        assert all(i + j == c[0][0] + c[0][1] for (i, j) in c)
+
+
+def test_dependency_order():
+    # (i, j) must run after (i, j-1) and after (i-1, j) was *dispatchable*
+    seen = set()
+    for c in clock_cycles(6, 3):
+        for (i, j) in c:
+            if j > 0:
+                assert (i, j - 1) in seen
+        seen.update(c)
+
+
+def test_bubble_fraction():
+    assert bubble_fraction(4, 2) == pytest.approx(1 / 5)
+    assert bubble_fraction(8, 1) == 0.0
+    s = GPipeSchedule()
+    assert s.bubble(4, 2) == pytest.approx((5 * 2 - 8) / 10)
+
+
+def test_get_schedule():
+    assert isinstance(get_schedule("gpipe"), GPipeSchedule)
+    assert isinstance(get_schedule("1f1b"), OneFOneBSchedule)
+    inter = get_schedule("interleaved", v=2)
+    assert isinstance(inter, InterleavedSchedule)
+    assert inter.virtual_stages(4) == 8
+    assert inter.device_of(5, 4) == 1
+    with pytest.raises(ValueError):
+        get_schedule("nope")
+
+
+def test_interleaved_covers_virtual_stages():
+    s = InterleavedSchedule(v=2)
+    # n passed to cycles is already the TOTAL (virtual) stage count.
+    cycles = s.cycles(4, 4)
+    tasks = [t for c in cycles for t in c]
+    assert sorted(tasks) == [(i, j) for i in range(4) for j in range(4)]
+    # interleaving shrinks the per-device fill bubble ~v-fold
+    assert s.device_bubble(8, 4) < GPipeSchedule().bubble(8, 4)
+
+
+def test_fair_split_non_divisible():
+    from pipe_tpu.core.partition import split_balance
+    assert split_balance(4, 3) == [2, 1, 1]
+    assert split_balance(7, 5) == [2, 2, 1, 1, 1]
+    assert split_balance(9, 6) == [2, 2, 2, 1, 1, 1]
