@@ -1,0 +1,212 @@
+"""SPMD pipeline executor: one compiled program, ppermute transport over ICI.
+
+This replaces the reference's entire runtime machine — per-device worker
+threads and queues (``pipeline.py:98,237,240``; ``README.md:39-47,291-314``),
+per-(stage,chunk) copy streams with ``Copy``/``Wait`` autograd ops
+(``pipe.py:417-429``; ``README.md:185-237,324-369``), and fork/join phony
+ordering edges (``pipeline.py:128-132``) — with a single ``shard_map``'d
+``lax.scan`` over clock cycles:
+
+* transport: ``jax.lax.ppermute`` (XLA ``collective-permute``) shifts the
+  activation ring one stage forward per cycle — the D2D copy *and* its
+  ordering, compiled;
+* schedule: the scan index IS the clock cycle (``pipeline.py:63-79``); stage
+  ``j`` works on micro-batch ``i = t - j``, idling (masked) during fill/drain;
+* backward: ``jax.grad`` differentiates the scan — reverse ppermutes and
+  reverse schedule fall out of AD (the moral equivalent of ``Copy.backward``/
+  ``Wait.backward``, ``README.md:219-237,359-369``), and backward micro-batch
+  ordering is compiled instead of discovered by a C++ graph walk;
+* remat: per-microbatch ``jax.checkpoint`` selected by a ``lax.cond`` on the
+  in-flight micro-batch index (modes ``always``/``except_last``/``never``,
+  reference ``pipe.py:354``), eval-mode off (``pipeline.py:153-155``);
+* overlap: XLA's latency-hiding scheduler overlaps the collective-permute with
+  stage compute — the role of the reference's dedicated copy streams.
+
+Stage heterogeneity (SURVEY §7 hard part #2) is handled Encoder/Decoder-style:
+the pipelined body is a *homogeneous* stage stack (params stacked on a leading
+``[n_stages, ...]`` axis, sharded over the ``stage`` mesh axis), while an
+optional ``pre_fn`` (e.g. embed+posenc) runs only on stage 0 and ``post_fn``
+(e.g. decode or per-microbatch loss) only on stage n-1, their params
+replicated. This matches the tutorial topology (Encoder + N×block + Decoder,
+``main.py:139-157``) while keeping every ppermute a static same-shape ring
+shift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.partition import StageCtx
+from ..core.remat import checkpoint_stop, validate_mode
+from .mesh import DATA_AXIS, STAGE_AXIS
+
+__all__ = ["SpmdPipeline", "stack_stage_params"]
+
+
+def stack_stage_params(params_per_stage):
+    """Stack per-stage (identically-structured) pytrees on a leading stage axis."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *params_per_stage)
+
+
+def _identity(params, x, ctx):
+    return x
+
+
+@dataclasses.dataclass
+class SpmdPipeline:
+    """GPipe pipeline compiled over a ``(stage[, data])`` mesh.
+
+    Args:
+      mesh: mesh containing ``stage`` (and optionally ``data``) axes.
+      stage_fn: ``(params_j, h, ctx) -> h`` homogeneous stage body; input and
+        output activation must have identical shape/dtype (ring invariant).
+      pre_fn: ``(pre_params, x_mb, ctx) -> h`` run on stage 0 only (embed).
+      post_fn: ``(post_params, h, ctx) -> out`` run on stage n-1 only (decode
+        or per-example loss); ``out``'s leading dim must be the micro-batch
+        rows (it is sharded over ``data``).
+      checkpoint: ``always | except_last | never`` (reference ``pipe.py:354``).
+    """
+
+    mesh: Mesh
+    stage_fn: Callable
+    pre_fn: Optional[Callable] = None
+    post_fn: Optional[Callable] = None
+    checkpoint: str = "never"
+    remat_policy: Any = None
+
+    def __post_init__(self):
+        validate_mode(self.checkpoint)
+        if STAGE_AXIS not in self.mesh.axis_names:
+            raise ValueError(f"mesh must have a {STAGE_AXIS!r} axis")
+        self.n_stages = self.mesh.shape[STAGE_AXIS]
+        self.has_data_axis = DATA_AXIS in self.mesh.axis_names
+        self._pre = self.pre_fn or _identity
+        self._post = self.post_fn or _identity
+
+    # -----------------------------------------------------------------
+    def __call__(self, stage_params, pre_params, post_params, x,
+                 *, key: Optional[jax.Array] = None, train: bool = False):
+        """Run the pipeline on micro-batched input ``x`` of shape [m, mb, ...].
+
+        Returns ``[m, mb_out, ...]`` stacked ``post_fn`` outputs (a global
+        array whose data lives on the last stage's devices).
+        """
+        m = x.shape[0]
+        n = self.n_stages
+        stop = checkpoint_stop(self.checkpoint, m, train)
+        # Key is threaded as data so remat replays identical dropout.
+        key = key if key is not None else jax.random.key(0)
+
+        data = DATA_AXIS if self.has_data_axis else None
+        ctx0 = StageCtx(key=None, train=train)
+
+        # Global post-output spec (for the caller-visible shape only; local
+        # buffer shapes are derived inside the device program on local shards).
+        x_mb_spec = jax.eval_shape(lambda a: a[0], x)
+        h_spec = jax.eval_shape(
+            lambda p, a: self._pre(p, a, ctx0), pre_params, x_mb_spec)
+        out_spec = jax.eval_shape(
+            lambda p, h: self._post(p, h, ctx0), post_params, h_spec)
+
+        in_specs = (
+            jax.tree_util.tree_map(lambda _: P(STAGE_AXIS), stage_params),
+            jax.tree_util.tree_map(lambda _: P(), pre_params),
+            jax.tree_util.tree_map(lambda _: P(), post_params),
+            # x: [m, mb_rows, ...] — micro-batch rows sharded over data
+            P(*([None, data] + [None] * (x.ndim - 2))),
+            P(),                          # key
+        )
+        # result: [stage, m, mb_rows_out, ...]
+        out_specs = P(*([STAGE_AXIS, None, data]
+                        + [None] * (len(out_spec.shape) - 1)))
+
+        run = jax.shard_map(
+            functools.partial(self._device_program, m=m, stop=stop,
+                              train=train),
+            mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False)
+
+        stacked = run(stage_params, pre_params, post_params, x, key)
+        # Only the last stage's slice holds real data: [n, m, ...] -> [m, ...]
+        return stacked[-1]
+
+    # -----------------------------------------------------------------
+    def _device_program(self, stage_params, pre_params, post_params, x, key,
+                        *, m, stop, train):
+        """The per-device SPMD program (runs under shard_map)."""
+        n = self.n_stages
+        j = jax.lax.axis_index(STAGE_AXIS)
+        # This device's stage slice: leading dim n/n_devices == 1 for GPipe.
+        params_j = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+        # Local (per-shard) activation and output specs.
+        ctx0 = StageCtx(key=None, train=train)
+        h_spec = jax.eval_shape(
+            lambda p, a: self._pre(p, a, ctx0), pre_params,
+            jax.eval_shape(lambda a: a[0], x))
+        out_spec = jax.eval_shape(
+            lambda p, h: self._post(p, h, ctx0), post_params, h_spec)
+
+        h0 = jnp.zeros(h_spec.shape, h_spec.dtype)
+        outbuf = jnp.zeros((m,) + tuple(out_spec.shape), out_spec.dtype)
+
+        def cycle(carry, t):
+            h, outbuf = carry
+            # --- stage 0 ingests micro-batch t (clamped during drain) ---
+            idx = jnp.clip(t, 0, m - 1)
+            x_t = jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+            i = t - j  # micro-batch index in flight on this device
+            ctx_key = jax.random.fold_in(jax.random.fold_in(key, i), j)
+
+            h = jax.lax.cond(
+                j == 0,
+                lambda: self._pre(pre_params,
+                                  x_t,
+                                  StageCtx(key=jax.random.fold_in(ctx_key, 0),
+                                           train=train)),
+                lambda: h)
+
+            # --- stage body, remat'd when i < checkpoint_stop ---
+            def body(p, k, h):
+                return self.stage_fn(p, h, StageCtx(key=k, train=train))
+
+            body_remat = jax.checkpoint(body, policy=self.remat_policy) \
+                if self.remat_policy is not None else jax.checkpoint(body)
+            bkey = jax.random.fold_in(ctx_key, 1)
+            h = jax.lax.cond(
+                i < stop,
+                lambda: body_remat(params_j, bkey, h),
+                lambda: body(params_j, bkey, h))
+
+            # --- last stage emits output for valid micro-batches ---
+            valid = (j == n - 1) & (i >= 0) & (i < m)
+            out_t = jax.lax.cond(
+                valid,
+                lambda: self._post(post_params, h,
+                                   StageCtx(key=jax.random.fold_in(ctx_key, 2),
+                                            train=train)),
+                lambda: jnp.zeros(tuple(out_spec.shape), out_spec.dtype))
+            outbuf = jax.lax.cond(
+                valid,
+                lambda: jax.lax.dynamic_update_index_in_dim(
+                    outbuf, out_t, jnp.clip(i, 0, m - 1), 0),
+                lambda: outbuf)
+
+            # --- ring shift: stage j -> j+1 (XLA collective-permute) ---
+            if n > 1:
+                h = jax.lax.ppermute(
+                    h, STAGE_AXIS, [(k, k + 1) for k in range(n - 1)])
+            return (h, outbuf), None
+
+        (h, outbuf), _ = jax.lax.scan(
+            cycle, (h0, outbuf), jnp.arange(m + n - 1))
+        # Stack on a leading stage axis so out_specs=P(stage,...) is exact
+        # (device j contributes its outbuf as slice j; only j=n-1 is real).
+        return outbuf[None]
