@@ -1,0 +1,172 @@
+"""Tutorial-parity Transformer language model, pipelined both ways.
+
+Workload parity with the reference driver (``main.py:101-120,139-171``):
+WikiText-2 LM with Encoder (embedding + positional encoding), N ×
+``TransformerEncoderLayer``, Decoder (projection to vocab); defaults emsize
+2048, nhid 2048, nlayers 16, nhead 32, dropout 0.2, batch-first inputs
+(``main.py:108-113``).
+
+Two execution paths:
+
+* :func:`build_sequential` — a heterogeneous ``Sequential`` for the ``Pipe``
+  API / serial emulator (any stage split, like the reference's
+  Encoder+blocks+Decoder partitions);
+* :class:`PipelinedLM` — the SPMD path: homogeneous stacked transformer-block
+  stages over the ``stage`` mesh axis, embed as ``pre_fn`` on stage 0 and
+  decode (or per-token loss) as ``post_fn`` on stage n-1.
+
+Mixed precision is TPU-idiomatic: params live in float32, stage compute can
+run in bfloat16 (MXU native), logits/loss in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.partition import StageCtx
+from ..ops.layers import (Decoder, Embedding, PositionalEncoding, Sequential,
+                          TransformerEncoderLayer)
+
+__all__ = ["LMConfig", "build_sequential", "PipelinedLM", "cross_entropy"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    """Tutorial hyperparameters (reference ``main.py:101-120``)."""
+
+    vocab: int = 28782          # WikiText-2 vocab size ballpark
+    d_model: int = 2048         # emsize
+    nhead: int = 32
+    d_ff: int = 2048            # nhid
+    n_layers: int = 16
+    dropout: float = 0.2
+    seq_len: int = 128          # bptt
+    causal: bool = True
+    compute_dtype: Any = jnp.float32   # set jnp.bfloat16 on TPU
+
+    def tiny(self) -> "LMConfig":
+        return dataclasses.replace(
+            self, vocab=101, d_model=16, nhead=2, d_ff=32, n_layers=4,
+            seq_len=16, dropout=0.0)
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean token cross-entropy, float32 accumulation.
+
+    The reference computes ``CrossEntropyLoss(output.view(-1, V), targets)``
+    on the last stage's device (``main.py:216``).
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous Sequential path (Pipe / emulator)
+# ---------------------------------------------------------------------------
+
+def build_sequential(cfg: LMConfig) -> Sequential:
+    """Encoder + N blocks + Decoder as one Sequential (reference
+    ``main.py:139-157`` builds exactly this module list for ``Pipe``)."""
+    layers = [
+        Embedding(cfg.vocab, cfg.d_model, scale=True),
+        PositionalEncoding(cfg.d_model, cfg.dropout, max_len=max(5000, cfg.seq_len)),
+    ]
+    for _ in range(cfg.n_layers):
+        layers.append(TransformerEncoderLayer(
+            cfg.d_model, cfg.nhead, cfg.d_ff, cfg.dropout, causal=cfg.causal))
+    layers.append(Decoder(cfg.vocab))
+    return Sequential(layers, name="transformer_lm")
+
+
+# ---------------------------------------------------------------------------
+# SPMD path: homogeneous stacked stages
+# ---------------------------------------------------------------------------
+
+class PipelinedLM:
+    """The SPMD-ready factorization: embed | k blocks per stage | decode.
+
+    ``init`` returns ``(stage_params, pre_params, post_params)`` where
+    ``stage_params`` is a list (length n_stages) of identically-structured
+    pytrees — feed through ``stack_stage_params`` and ``SpmdPipeline``.
+    """
+
+    def __init__(self, cfg: LMConfig, n_stages: int):
+        if cfg.n_layers % n_stages:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} must divide evenly into "
+                f"n_stages={n_stages} for the homogeneous SPMD path "
+                f"(use Pipe/emulator for uneven splits)")
+        self.cfg = cfg
+        self.n_stages = n_stages
+        self.layers_per_stage = cfg.n_layers // n_stages
+        self.embed = Embedding(cfg.vocab, cfg.d_model, scale=True)
+        self.posenc = PositionalEncoding(
+            cfg.d_model, cfg.dropout, max_len=max(5000, cfg.seq_len))
+        self.block = TransformerEncoderLayer(
+            cfg.d_model, cfg.nhead, cfg.d_ff, cfg.dropout, causal=cfg.causal)
+        self.decoder = Decoder(cfg.vocab)
+
+    # --- params ---
+
+    def init(self, key: jax.Array):
+        cfg = self.cfg
+        x_spec = jax.ShapeDtypeStruct((1, cfg.seq_len), jnp.int32)
+        h_spec = jax.ShapeDtypeStruct((1, cfg.seq_len, cfg.d_model),
+                                      jnp.float32)
+        pre_params = {"embed": self.embed.init(jax.random.fold_in(key, 0),
+                                               x_spec)}
+        post_params = {"decoder": self.decoder.init(
+            jax.random.fold_in(key, 1), h_spec)}
+        stage_params: List[Any] = []
+        for s in range(self.n_stages):
+            blocks = []
+            for l in range(self.layers_per_stage):
+                lkey = jax.random.fold_in(key, 2 + s * self.layers_per_stage + l)
+                blocks.append(self.block.init(lkey, h_spec))
+            stage_params.append(blocks)
+        return stage_params, pre_params, post_params
+
+    # --- SPMD stage functions ---
+
+    def pre_fn(self, pre_params, x_mb, ctx: StageCtx):
+        tokens = x_mb["tokens"] if isinstance(x_mb, dict) else x_mb
+        h = self.embed.apply(pre_params["embed"], tokens, ctx=ctx)
+        h = self.posenc.apply({}, h, ctx=ctx.fold(1))
+        return h.astype(self.cfg.compute_dtype)
+
+    def stage_fn(self, blocks, h, ctx: StageCtx):
+        cd = self.cfg.compute_dtype
+        for l, bp in enumerate(blocks):
+            bp = jax.tree_util.tree_map(lambda p: p.astype(cd), bp)
+            h = self.block.apply(bp, h, ctx=ctx.fold(l))
+        return h
+
+    def post_fn(self, post_params, h, ctx: StageCtx):
+        return self.decoder.apply(post_params["decoder"],
+                                  h.astype(jnp.float32), ctx=ctx)
+
+    def loss_post_fn(self, post_params, h, x_mb, ctx: StageCtx):
+        """In-pipeline loss: per-row mean token cross-entropy [mb_rows].
+
+        Use with ``SpmdPipeline(post_with_batch=True)`` and
+        ``x = {"tokens": [m,mb,seq], "targets": [m,mb,seq]}`` — the loss is
+        computed on the last stage against the matching micro-batch, so the
+        [m, mb, seq, vocab] logits never materialize in HBM (the reference
+        moves targets to the last GPU for the same reason, ``main.py:216``).
+        """
+        logits = self.decoder.apply(post_params["decoder"],
+                                    h.astype(jnp.float32), ctx=ctx)
+        targets = x_mb["targets"]
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(logz - gold, axis=-1)  # mean over seq -> [mb_rows]
+
+    def num_params(self, params_tuple) -> int:
+        return sum(int(p.size) for p in jax.tree_util.tree_leaves(params_tuple))

@@ -68,9 +68,13 @@ class SpmdPipeline:
       stage_fn: ``(params_j, h, ctx) -> h`` homogeneous stage body; input and
         output activation must have identical shape/dtype (ring invariant).
       pre_fn: ``(pre_params, x_mb, ctx) -> h`` run on stage 0 only (embed).
+        ``x_mb`` is one micro-batch slice of the input pytree.
       post_fn: ``(post_params, h, ctx) -> out`` run on stage n-1 only (decode
-        or per-example loss); ``out``'s leading dim must be the micro-batch
-        rows (it is sharded over ``data``).
+        or per-example loss); with ``post_with_batch=True`` it is
+        ``(post_params, h, x_mb, ctx)`` where ``x_mb`` is the micro-batch the
+        output belongs to — e.g. targets for computing loss in-pipeline
+        without materializing logits. ``out``'s leading dim must be the
+        micro-batch rows (it is sharded over ``data``).
       checkpoint: ``always | except_last | never`` (reference ``pipe.py:354``).
     """
 
@@ -78,6 +82,7 @@ class SpmdPipeline:
     stage_fn: Callable
     pre_fn: Optional[Callable] = None
     post_fn: Optional[Callable] = None
+    post_with_batch: bool = False
     checkpoint: str = "never"
     remat_policy: Any = None
 
@@ -88,17 +93,26 @@ class SpmdPipeline:
         self.n_stages = self.mesh.shape[STAGE_AXIS]
         self.has_data_axis = DATA_AXIS in self.mesh.axis_names
         self._pre = self.pre_fn or _identity
-        self._post = self.post_fn or _identity
+        if self.post_fn is None:
+            self._post = lambda p, h, x_mb, ctx: h
+        elif self.post_with_batch:
+            self._post = self.post_fn
+        else:
+            self._post = lambda p, h, x_mb, ctx: self.post_fn(p, h, ctx)
 
     # -----------------------------------------------------------------
     def __call__(self, stage_params, pre_params, post_params, x,
                  *, key: Optional[jax.Array] = None, train: bool = False):
-        """Run the pipeline on micro-batched input ``x`` of shape [m, mb, ...].
+        """Run the pipeline on micro-batched input ``x``: a [m, mb, ...] array
+        or a pytree of such (e.g. ``{"tokens": ..., "targets": ...}``).
 
         Returns ``[m, mb_out, ...]`` stacked ``post_fn`` outputs (a global
         array whose data lives on the last stage's devices).
         """
-        m = x.shape[0]
+        x_leaves = jax.tree_util.tree_leaves(x)
+        if not x_leaves:
+            raise TypeError("x must contain at least one array leaf")
+        m = x_leaves[0].shape[0]
         n = self.n_stages
         stop = checkpoint_stop(self.checkpoint, m, train)
         # Key is threaded as data so remat replays identical dropout.
@@ -109,23 +123,28 @@ class SpmdPipeline:
 
         # Global post-output spec (for the caller-visible shape only; local
         # buffer shapes are derived inside the device program on local shards).
-        x_mb_spec = jax.eval_shape(lambda a: a[0], x)
+        x_mb_spec = jax.eval_shape(
+            lambda a: jax.tree_util.tree_map(lambda l: l[0], a), x)
         h_spec = jax.eval_shape(
             lambda p, a: self._pre(p, a, ctx0), pre_params, x_mb_spec)
         out_spec = jax.eval_shape(
-            lambda p, h: self._post(p, h, ctx0), post_params, h_spec)
+            lambda p, h, a: self._post(p, h, a, ctx0),
+            post_params, h_spec, x_mb_spec)
 
         in_specs = (
             jax.tree_util.tree_map(lambda _: P(STAGE_AXIS), stage_params),
             jax.tree_util.tree_map(lambda _: P(), pre_params),
             jax.tree_util.tree_map(lambda _: P(), post_params),
-            # x: [m, mb_rows, ...] — micro-batch rows sharded over data
-            P(*([None, data] + [None] * (x.ndim - 2))),
+            # x leaves: [m, mb_rows, ...] — micro-batch rows sharded over data
+            jax.tree_util.tree_map(
+                lambda l: P(*([None, data] + [None] * (l.ndim - 2))), x),
             P(),                          # key
         )
-        # result: [stage, m, mb_rows_out, ...]
-        out_specs = P(*([STAGE_AXIS, None, data]
-                        + [None] * (len(out_spec.shape) - 1)))
+        # result leaves: [stage, m, mb_rows_out, ...]
+        out_specs = jax.tree_util.tree_map(
+            lambda s: P(*([STAGE_AXIS, None, data]
+                          + [None] * (len(s.shape) - 1))),
+            out_spec)
 
         run = jax.shard_map(
             functools.partial(self._device_program, m=m, stop=stop,
@@ -135,7 +154,7 @@ class SpmdPipeline:
 
         stacked = run(stage_params, pre_params, post_params, x, key)
         # Only the last stage's slice holds real data: [n, m, ...] -> [m, ...]
-        return stacked[-1]
+        return jax.tree_util.tree_map(lambda a: a[-1], stacked)
 
     # -----------------------------------------------------------------
     def _device_program(self, stage_params, pre_params, post_params, x, key,
@@ -148,20 +167,28 @@ class SpmdPipeline:
 
         # Local (per-shard) activation and output specs.
         ctx0 = StageCtx(key=None, train=train)
+        x_mb_spec = jax.eval_shape(
+            lambda a: jax.tree_util.tree_map(lambda l: l[0], a), x)
         h_spec = jax.eval_shape(
-            lambda p, a: self._pre(p, a, ctx0), pre_params,
-            jax.eval_shape(lambda a: a[0], x))
+            lambda p, a: self._pre(p, a, ctx0), pre_params, x_mb_spec)
         out_spec = jax.eval_shape(
-            lambda p, h: self._post(p, h, ctx0), post_params, h_spec)
+            lambda p, h, a: self._post(p, h, a, ctx0),
+            post_params, h_spec, x_mb_spec)
 
-        h0 = jnp.zeros(h_spec.shape, h_spec.dtype)
-        outbuf = jnp.zeros((m,) + tuple(out_spec.shape), out_spec.dtype)
+        h0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), h_spec)
+        outbuf = jax.tree_util.tree_map(
+            lambda s: jnp.zeros((m,) + tuple(s.shape), s.dtype), out_spec)
+
+        def index_x(idx):
+            return jax.tree_util.tree_map(
+                lambda l: jax.lax.dynamic_index_in_dim(
+                    l, idx, 0, keepdims=False), x)
 
         def cycle(carry, t):
             h, outbuf = carry
             # --- stage 0 ingests micro-batch t (clamped during drain) ---
-            idx = jnp.clip(t, 0, m - 1)
-            x_t = jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+            x_t = index_x(jnp.clip(t, 0, m - 1))
             i = t - j  # micro-batch index in flight on this device
             ctx_key = jax.random.fold_in(jax.random.fold_in(key, i), j)
 
@@ -187,26 +214,30 @@ class SpmdPipeline:
 
             # --- last stage emits output for valid micro-batches ---
             valid = (j == n - 1) & (i >= 0) & (i < m)
+            x_i = index_x(jnp.clip(i, 0, m - 1))
             out_t = jax.lax.cond(
                 valid,
-                lambda: self._post(post_params, h,
+                lambda: self._post(post_params, h, x_i,
                                    StageCtx(key=jax.random.fold_in(ctx_key, 2),
                                             train=train)),
-                lambda: jnp.zeros(tuple(out_spec.shape), out_spec.dtype))
+                lambda: jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), out_spec))
             outbuf = jax.lax.cond(
                 valid,
-                lambda: jax.lax.dynamic_update_index_in_dim(
-                    outbuf, out_t, jnp.clip(i, 0, m - 1), 0),
+                lambda: jax.tree_util.tree_map(
+                    lambda buf, o: jax.lax.dynamic_update_index_in_dim(
+                        buf, o, jnp.clip(i, 0, m - 1), 0), outbuf, out_t),
                 lambda: outbuf)
 
             # --- ring shift: stage j -> j+1 (XLA collective-permute) ---
             if n > 1:
-                h = jax.lax.ppermute(
-                    h, STAGE_AXIS, [(k, k + 1) for k in range(n - 1)])
+                perm = [(k, k + 1) for k in range(n - 1)]
+                h = jax.tree_util.tree_map(
+                    lambda a: jax.lax.ppermute(a, STAGE_AXIS, perm), h)
             return (h, outbuf), None
 
         (h, outbuf), _ = jax.lax.scan(
             cycle, (h0, outbuf), jnp.arange(m + n - 1))
         # Stack on a leading stage axis so out_specs=P(stage,...) is exact
         # (device j contributes its outbuf as slice j; only j=n-1 is real).
-        return outbuf[None]
+        return jax.tree_util.tree_map(lambda b: b[None], outbuf)

@@ -1,0 +1,156 @@
+"""Transformer-LM tests: tutorial model through both executors.
+
+Mirrors the reference's verification strategy (SURVEY §4): the runnable
+tutorial as integration test, plus transparency between the pipelined and
+plain forms of the same model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu import Pipe
+from pipe_tpu.core import microbatch as mb
+from pipe_tpu.core.partition import StageCtx
+from pipe_tpu.models.transformer_lm import (LMConfig, PipelinedLM,
+                                            build_sequential, cross_entropy)
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.spmd import SpmdPipeline, stack_stage_params
+
+CFG = LMConfig().tiny()
+
+
+def make_tokens(key, batch, seq):
+    return jax.random.randint(key, (batch, seq), 0, CFG.vocab)
+
+
+def test_sequential_lm_shapes():
+    seq_model = build_sequential(CFG)
+    # Encoder(2 modules) + n_layers + Decoder
+    assert len(seq_model) == CFG.n_layers + 3
+    params = seq_model.init(jax.random.key(0),
+                            jax.ShapeDtypeStruct((2, CFG.seq_len), jnp.int32))
+    toks = make_tokens(jax.random.key(1), 2, CFG.seq_len)
+    logits = seq_model.apply(params, toks)
+    assert logits.shape == (2, CFG.seq_len, CFG.vocab)
+
+
+def test_pipe_lm_transparency():
+    """Pipe-wrapped LM == plain LM (the 2-stage tutorial topology)."""
+    seq_model = build_sequential(CFG)
+    # balance like the tutorial: encoder+posenc+half blocks | rest+decoder
+    pipe = Pipe(seq_model, chunks=4, checkpoint="never",
+                balance=[2 + CFG.n_layers // 2, CFG.n_layers // 2 + 1])
+    sp = pipe.init(jax.random.key(0),
+                   jax.ShapeDtypeStruct((2, CFG.seq_len), jnp.int32))
+    flat = [p for stage in sp for p in stage]
+    toks = make_tokens(jax.random.key(1), 8, CFG.seq_len)
+    got = pipe(sp, toks)
+    expected = seq_model.apply(flat, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_spmd_lm_matches_plain():
+    n_stages = 4
+    lm = PipelinedLM(CFG, n_stages)
+    stage_params, pre_p, post_p = lm.init(jax.random.key(0))
+    mesh = make_mesh(n_stages, 1)
+    pipe = SpmdPipeline(mesh, lm.stage_fn, pre_fn=lm.pre_fn,
+                        post_fn=lm.post_fn)
+    stacked = stack_stage_params(stage_params)
+
+    toks = make_tokens(jax.random.key(1), 8, CFG.seq_len)
+    xs, bs = mb.stack_scatter(toks, 4)
+    logits = mb.stack_gather(pipe(stacked, pre_p, post_p, xs), bs)
+
+    # plain single-device forward of the identical params
+    h = lm.pre_fn(pre_p, toks, StageCtx())
+    for blocks in stage_params:
+        h = lm.stage_fn(blocks, h, StageCtx())
+    expected = lm.post_fn(post_p, h, StageCtx())
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_spmd_lm_loss_mode_and_grads():
+    """In-pipeline loss (post_with_batch): value and grads match plain CE."""
+    n_stages = 2
+    lm = PipelinedLM(CFG, n_stages)
+    stage_params, pre_p, post_p = lm.init(jax.random.key(0))
+    mesh = make_mesh(n_stages, 1)
+    pipe = SpmdPipeline(mesh, lm.stage_fn, pre_fn=lm.pre_fn,
+                        post_fn=lm.loss_post_fn, post_with_batch=True)
+    stacked = stack_stage_params(stage_params)
+
+    toks = make_tokens(jax.random.key(1), 8, CFG.seq_len)
+    targets = jnp.roll(toks, -1, axis=-1)
+    x = {"tokens": toks, "targets": targets}
+    xs, bs = mb.stack_scatter(x, 4)
+
+    def pipe_loss(sp, pre_p, post_p):
+        per_row = pipe(sp, pre_p, post_p, xs, train=False)
+        return jnp.mean(per_row)
+
+    def plain_loss(splist, pre_p, post_p):
+        h = lm.pre_fn(pre_p, toks, StageCtx())
+        for blocks in splist:
+            h = lm.stage_fn(blocks, h, StageCtx())
+        logits = lm.post_fn(post_p, h, StageCtx())
+        return cross_entropy(logits, targets)
+
+    lv = pipe_loss(stacked, pre_p, post_p)
+    le = plain_loss(stage_params, pre_p, post_p)
+    np.testing.assert_allclose(float(lv), float(le), rtol=1e-5)
+
+    g_pipe = jax.grad(pipe_loss, argnums=(0, 1, 2))(stacked, pre_p, post_p)
+    g_plain = jax.grad(plain_loss, argnums=(0, 1, 2))(
+        list(stage_params), pre_p, post_p)
+    g_plain = (stack_stage_params(g_plain[0]), g_plain[1], g_plain[2])
+    for g, e in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_plain)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                   rtol=5e-4, atol=1e-6)
+
+
+def test_spmd_lm_train_step_converges():
+    n_stages = 2
+    lm = PipelinedLM(CFG, n_stages)
+    stage_params, pre_p, post_p = lm.init(jax.random.key(0))
+    mesh = make_mesh(n_stages, 2)  # pipeline x data
+    pipe = SpmdPipeline(mesh, lm.stage_fn, pre_fn=lm.pre_fn,
+                        post_fn=lm.loss_post_fn, post_with_batch=True,
+                        checkpoint="except_last")
+    params = (stack_stage_params(stage_params), pre_p, post_p)
+
+    toks = make_tokens(jax.random.key(1), 16, CFG.seq_len)
+    targets = jnp.roll(toks, -1, axis=-1)
+    xs, _ = mb.stack_scatter({"tokens": toks, "targets": targets}, 4)
+
+    @jax.jit
+    def step(params, k):
+        def loss_fn(params):
+            sp, pre_p, post_p = params
+            return jnp.mean(pipe(sp, pre_p, post_p, xs, key=k, train=True))
+        l, g = jax.value_and_grad(loss_fn)(params)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, params, g), l
+
+    losses = []
+    for i in range(30):
+        params, l = step(params, jax.random.key(i))
+        losses.append(float(l))
+    assert losses[-1] < losses[0] - 0.3, losses[::10]
+
+
+def test_uneven_layers_rejected_for_spmd():
+    with pytest.raises(ValueError):
+        PipelinedLM(CFG, 3)  # 4 layers % 3 != 0
+
+
+def test_cross_entropy_reference():
+    logits = jnp.array([[[2.0, 0.0, 0.0], [0.0, 2.0, 0.0]]])
+    targets = jnp.array([[0, 1]])
+    l = cross_entropy(logits, targets)
+    expected = -np.log(np.exp(2) / (np.exp(2) + 2))
+    np.testing.assert_allclose(float(l), expected, rtol=1e-6)
