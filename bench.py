@@ -1,0 +1,178 @@
+"""Benchmark: tutorial-parity Transformer LM training throughput.
+
+Workload = the reference's headline config (``/root/reference/main.py:101-120``:
+WikiText-2 LM, batch 32, bptt 128, emsize 2048, nhid 2048, nlayers 16,
+nhead 32, chunks 4, checkpoint=except_last) driven through the compiled SPMD
+pipeline, full train step (forward + in-pipeline loss + backward + grad-clip +
+Adam).
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+``vs_baseline`` is pipelined throughput / plain (unpipelined) single-chip
+throughput of the identical model and step — i.e. how much the pipeline
+machinery costs (or saves) against the no-framework ideal; >= 1.0 means the
+pipeline adds no overhead. The reference publishes no numbers (BASELINE.md),
+so the baseline must be measured, not copied.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from pipe_tpu.core import microbatch as mb
+from pipe_tpu.core.schedule import bubble_fraction
+from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.spmd import SpmdPipeline, stack_stage_params
+
+CHUNKS = 4
+BATCH = 32
+# `python main.py except_last` parity: at 520M params the no-remat config
+# does not fit one 16G chip (the reference used 2 larger GPUs), so remat is
+# the realistic headline mode; override with BENCH_CHECKPOINT=never etc.
+CHECKPOINT = os.environ.get("BENCH_CHECKPOINT", "except_last")
+
+
+def tutorial_config(platform: str) -> LMConfig:
+    if platform == "tpu":
+        return LMConfig(compute_dtype=jnp.bfloat16)  # full 520M-param config
+    # CPU/dev fallback: same structure, small dims, so the script stays runnable.
+    return LMConfig(vocab=1024, d_model=128, nhead=4, d_ff=256, n_layers=8,
+                    seq_len=64)
+
+
+def make_step(model, spmd, tx):
+    def train_step(params, opt_state, x, key):
+        sp, prep, postp = params
+
+        def loss_fn(sp, prep, postp):
+            return jnp.mean(spmd(sp, prep, postp, x, key=key, train=True))
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            sp, prep, postp)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def make_plain_step(model, tx):
+    """The unpipelined ideal: same model, same step, no pipeline machinery."""
+
+    def forward(params, tokens, targets, key):
+        from pipe_tpu.core.partition import StageCtx
+        sp, prep, postp = params
+        ctx = StageCtx(key=key, train=True)
+        h = model.pre_fn(prep, tokens, ctx)
+
+        # same remat policy as the pipelined step, for a fair comparison
+        def block_fn(blocks, k, h):
+            return model.stage_fn(blocks, h, StageCtx(key=k, train=True))
+
+        body = block_fn if CHECKPOINT == "never" else jax.checkpoint(block_fn)
+        for j, blocks in enumerate(sp):
+            h = body(blocks, ctx.fold(j).key, h)
+        per_row = model.loss_post_fn(postp, h, {"targets": targets},
+                                     ctx.fold(99))
+        return jnp.mean(per_row)
+
+    def train_step(params, opt_state, tokens, targets, key):
+        loss, grads = jax.value_and_grad(forward)(params, tokens, targets, key)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def time_steps(step_fn, params, opt_state, args, warmup=2, iters=8):
+    """Per-step wall time with a host value fetch every step.
+
+    ``float(loss)`` forces a real device->host read of computed data each
+    iteration — immune to async-dispatch/readiness quirks of remote-execution
+    PJRT bridges, unlike ``block_until_ready`` bulk timing.
+    """
+    for _ in range(warmup):
+        params, opt_state, loss = step_fn(params, opt_state, *args)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step_fn(params, opt_state, *args)
+        last = float(loss)
+    return (time.perf_counter() - t0) / iters, last
+
+
+def main():
+    platform = jax.default_backend()
+    n_chips = jax.device_count()
+    cfg = tutorial_config(platform)
+    n_stages = 1  # bench chip count decides the pipeline depth
+    for cand in (8, 4, 2, 1):
+        if n_chips % cand == 0 and cand <= n_chips and cfg.n_layers % cand == 0:
+            n_stages = cand
+            break
+    mesh = make_mesh(n_stages, 1, devices=jax.devices()[:n_stages])
+
+    model = PipelinedLM(cfg, n_stages)
+    stage_params, pre_params, post_params = model.init(jax.random.key(0))
+    params = (stack_stage_params(stage_params), pre_params, post_params)
+    # fresh buffers: the pipelined step donates its inputs, and pre/post
+    # params are shared between the two trees
+    plain_params = jax.tree_util.tree_map(
+        lambda a: jnp.array(a, copy=True),
+        (stage_params, pre_params, post_params))
+
+    n_params = model.num_params(plain_params)
+    spmd = SpmdPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
+                        post_fn=model.loss_post_fn, post_with_batch=True,
+                        checkpoint=CHECKPOINT)
+    tx = optax.chain(optax.clip_by_global_norm(0.5), optax.adam(1e-4))
+
+    tokens = jax.random.randint(jax.random.key(1), (BATCH, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=-1)
+    x, _ = mb.stack_scatter({"tokens": tokens, "targets": targets}, CHUNKS)
+    key = jax.random.key(2)
+
+    step = make_step(model, spmd, tx)
+    sec_per_step, loss = time_steps(
+        step, params, tx.init(params), (x, key))
+    tokens_per_step = BATCH * cfg.seq_len
+    pipe_tps_chip = tokens_per_step / sec_per_step / n_stages
+
+    try:
+        plain = make_plain_step(model, tx)
+        plain_sec, _ = time_steps(
+            plain, plain_params, tx.init(plain_params), (tokens, targets, key))
+        plain_tps_chip = tokens_per_step / plain_sec  # single chip
+        vs_baseline = pipe_tps_chip / plain_tps_chip
+    except Exception as e:  # baseline OOM etc. — report pipeline number alone
+        print(f"plain baseline failed: {e}", file=sys.stderr)
+        vs_baseline = 0.0
+
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(pipe_tps_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+        "platform": platform,
+        "n_stages": n_stages,
+        "chunks": CHUNKS,
+        "checkpoint": CHECKPOINT,
+        "params": n_params,
+        "analytic_bubble": round(bubble_fraction(CHUNKS, n_stages), 4),
+        "final_loss": round(loss, 4),
+        "config": dataclasses.asdict(
+            dataclasses.replace(cfg, compute_dtype=str(cfg.compute_dtype))),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Deferred BatchNorm: micro-batching-safe batch normalization.
+
+Capability parity with the reference ``batchnorm.py`` (imported at
+``pipe.py:18,261-266,341-342``; quoted at ``README.md:549-554``): splitting a
+mini-batch into ``chunks`` micro-batches would update BN running statistics
+``chunks`` times with momentum each time — different numbers than the
+unpipelined model. ``DeferredBatchNorm`` accumulates per-micro-batch partial
+sums across the whole mini-batch and commits ONE running-stats update per
+mini-batch, restoring the unpipelined semantics.
+
+TPU-native re-design: torch mutates module buffers in place; here layers are
+pure, so per-microbatch ``(sum, sum_sq, count)`` ride the tracker's
+accumulator channel (crossing remat boundaries as explicit outputs — see
+``emulator._compute_one``), and ``Pipe`` returns the committed stats as a new
+params tree (``pipe(params, x, train=True)`` → ``(out, new_params)`` when
+``deferred_batch_norm=True``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.partition import StageCtx
+from ..ops.layers import Module, Sequential
+from .skip.namespace import Namespace
+from .skip.tracker import accumulate
+
+__all__ = ["BatchNorm", "DeferredBatchNorm", "convert_deferred_batch_norm",
+           "commit_batchnorm_stats"]
+
+_STATS = "deferred_stats"
+
+
+class BatchNorm(Module):
+    """Plain batch norm over all axes but the last (feature) axis.
+
+    Train mode normalizes by the micro-batch's own statistics — exactly the
+    behavior that makes naive micro-batching unsafe and motivates the
+    deferred variant (reference ``pipe.py:261-266``). Running stats live in
+    the params tree (``mean``/``var``/``count``); eval mode uses them.
+    """
+
+    def __init__(self, momentum: float = 0.1, eps: float = 1e-5,
+                 dtype=jnp.float32, name: str = "bn"):
+        self.momentum = momentum
+        self.eps = eps
+        self.dtype = dtype
+        self.name = name
+
+    def init(self, key, x):
+        d = jnp.shape(x)[-1]
+        return {
+            "scale": jnp.ones((d,), self.dtype),
+            "bias": jnp.zeros((d,), self.dtype),
+            "mean": jnp.zeros((d,), self.dtype),
+            "var": jnp.ones((d,), self.dtype),
+        }
+
+    def _normalize(self, params, x, mean, var):
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        return y * params["scale"] + params["bias"]
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        if not ctx.train:
+            return self._normalize(params, x, params["mean"], params["var"])
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        return self._normalize(params, x, mean, var)
+
+
+class DeferredBatchNorm(BatchNorm):
+    """BatchNorm whose running-stat update is deferred to once per mini-batch.
+
+    Each train-mode application normalizes by its micro-batch statistics
+    (same activations as the unpipelined model's train forward on that slice
+    of data is *not* the goal — parity is with whole-batch BN running stats)
+    and accumulates ``(sum, sum_sq, count)``; :func:`commit_batchnorm_stats`
+    folds the accumulated whole-mini-batch statistics into ``mean``/``var``
+    with one momentum step, matching torch's unbiased-variance update.
+    """
+
+    def __init__(self, momentum: float = 0.1, eps: float = 1e-5,
+                 dtype=jnp.float32, name: str = "deferred_bn"):
+        super().__init__(momentum, eps, dtype, name)
+        self.ns = Namespace()  # instance identity for the accumulator channel
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        if not ctx.train:
+            return self._normalize(params, x, params["mean"], params["var"])
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        n = 1
+        for a in axes:
+            n *= x.shape[a]
+        accumulate(self.ns, _STATS, {
+            "sum": jnp.sum(x, axis=axes),
+            "sum_sq": jnp.sum(jnp.square(x), axis=axes),
+            "count": jnp.asarray(n, jnp.float32),
+        })
+        return self._normalize(params, x, mean, var)
+
+    def commit(self, params, stats) -> Any:
+        """One momentum update from accumulated whole-mini-batch stats."""
+        n = stats["count"]
+        mean = stats["sum"] / n
+        var = stats["sum_sq"] / n - jnp.square(mean)
+        unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+        m = self.momentum
+        new = dict(params)
+        new["mean"] = (1 - m) * params["mean"] + m * mean.astype(self.dtype)
+        new["var"] = (1 - m) * params["var"] + m * unbiased.astype(self.dtype)
+        return new
+
+
+def convert_deferred_batch_norm(module: Sequential, chunks: int) -> Sequential:
+    """Replace every BatchNorm with a DeferredBatchNorm (reference
+    ``DeferredBatchNorm.convert_deferred_batch_norm``, ``pipe.py:341-342``).
+
+    ``chunks`` exists for signature parity with the reference converter; the
+    tracker-based accumulator needs no per-chunk state.
+    """
+    del chunks
+    layers = []
+    for layer in module:
+        if isinstance(layer, BatchNorm) and not isinstance(layer,
+                                                           DeferredBatchNorm):
+            d = DeferredBatchNorm(layer.momentum, layer.eps, layer.dtype,
+                                  name=layer.name)
+            layers.append(d)
+        else:
+            layers.append(layer)
+    return Sequential(layers, name=module.name)
+
+
+def commit_batchnorm_stats(partitions: Sequence[Sequential],
+                           params: Sequence[Any], tracker) -> Any:
+    """New per-stage params with every DeferredBatchNorm's stats committed.
+
+    ``tracker.accum`` holds the (ns, "deferred_stats") sums collected while
+    the schedule ran; layers without accumulated stats keep their params.
+    """
+    new_params = [list(p) for p in params]
+    for j, part in enumerate(partitions):
+        for i, layer in enumerate(part):
+            if isinstance(layer, DeferredBatchNorm):
+                stats = tracker.accum.get((layer.ns, _STATS))
+                if stats is not None:
+                    new_params[j][i] = layer.commit(params[j][i], stats)
+    return new_params

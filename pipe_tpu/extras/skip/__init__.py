@@ -1,0 +1,36 @@
+"""Skip-connection subsystem: tensors that jump over pipeline stages.
+
+Capability parity with the reference ``skip/`` package (imported at
+``pipe.py:20-21`` and ``pipeline.py:20-21``; SURVEY §2: ``skippable.py``,
+``portal.py``, ``tracker.py``, ``layout.py``, ``namespace.py``): a layer deep
+in one stage can ``stash`` a tensor and a layer in a *later* stage can ``pop``
+it, outside the stage-to-stage dataflow.
+
+TPU-native re-design: the reference routes stashed tensors through "portals"
+(phantom autograd nodes riding dedicated copy streams,
+``pipeline.py:136-138``). Here a stash is simply a named value recorded by a
+:class:`SkipTracker` while the (unrolled, traced) schedule runs — the value's
+journey across devices is whatever XLA compiles for the resulting dataflow,
+and its gradient path falls out of AD. The static stash/pop wiring is captured
+by :func:`inspect_skip_layout`, and :func:`verify_skippables` gives the same
+fail-fast init check as the reference (``pipe.py:336``).
+"""
+
+from .namespace import Namespace
+from .skippable import Skippable, pop, skippable, stash, verify_skippables
+from .layout import SkipLayout, inspect_skip_layout
+from .tracker import SkipTracker, current_skip_tracker, use_skip_tracker
+
+__all__ = [
+    "Namespace",
+    "Skippable",
+    "skippable",
+    "stash",
+    "pop",
+    "verify_skippables",
+    "SkipLayout",
+    "inspect_skip_layout",
+    "SkipTracker",
+    "current_skip_tracker",
+    "use_skip_tracker",
+]

@@ -1,0 +1,133 @@
+"""``@skippable`` modules and the ``stash``/``pop`` verbs.
+
+Parity with the reference ``skip/skippable.py`` (``@skippable(stash=[...],
+pop=[...])``, ``stash``, ``pop``, ``verify_skippables`` — imported at
+``pipe.py:20-21``). The reference's generator protocol (``yield stash(...)``)
+exists to thread values through an imperative nn.Module ``forward``; here
+modules are pure functions running under an active :class:`SkipTracker`
+scope, so ``stash``/``pop`` are direct calls:
+
+    @skippable(stash=["1to3"])
+    class Stash13(Module):
+        def apply(self, params, x, ctx=StageCtx()):
+            stash("1to3", x)
+            return x
+
+    @skippable(pop=["1to3"])
+    class Pop13(Module):
+        def apply(self, params, x, ctx=StageCtx()):
+            return x + pop("1to3")
+
+Two instances of the same skippable class are isolated with
+``module.isolate(Namespace())`` (reference ``Skippable.isolate``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Set, Tuple
+
+from ...ops.layers import Module, Sequential
+from .namespace import Namespace
+from .tracker import current_skip_tracker
+
+__all__ = ["Skippable", "skippable", "stash", "pop", "verify_skippables"]
+
+_GLOBAL_NS = Namespace()  # default namespace for un-isolated skippables
+
+
+class Skippable:
+    """Mixin marking a Module as stashing/popping named skips.
+
+    Applied by :func:`skippable`; carries ``stashes``/``pops`` as sets of
+    ``(namespace, name)`` resolved through the instance's namespace.
+    """
+
+    _stash_names: Tuple[str, ...] = ()
+    _pop_names: Tuple[str, ...] = ()
+
+    @property
+    def namespace(self):
+        return getattr(self, "_skip_ns", _GLOBAL_NS)
+
+    def isolate(self, ns: Namespace, *, only: Optional[Iterable[str]] = None):
+        """Return a copy whose skips live in ``ns`` (reference ``isolate``)."""
+        import copy
+
+        clone = copy.copy(self)
+        clone._skip_ns = ns
+        if only is not None:
+            keep = set(only)
+            clone._stash_names = tuple(n for n in self._stash_names if n in keep)
+            clone._pop_names = tuple(n for n in self._pop_names if n in keep)
+        return clone
+
+    @property
+    def stashes(self) -> Set[Tuple[object, str]]:
+        return {(self.namespace, n) for n in self._stash_names}
+
+    @property
+    def pops(self) -> Set[Tuple[object, str]]:
+        return {(self.namespace, n) for n in self._pop_names}
+
+
+def skippable(stash: Sequence[str] = (), pop: Sequence[str] = ()):
+    """Class decorator declaring which skip names a Module stashes/pops."""
+    stash_names = tuple(stash)
+    pop_names = tuple(pop)
+
+    def decorate(cls):
+        if not issubclass(cls, Module):
+            raise TypeError("@skippable expects a Module subclass")
+        return type(cls.__name__, (Skippable, cls), {
+            "_stash_names": stash_names,
+            "_pop_names": pop_names,
+        })
+
+    return decorate
+
+
+def stash(name: str, value, ns: Optional[Namespace] = None) -> None:
+    """Record ``value`` under ``name`` for a later stage's :func:`pop`."""
+    scope = current_skip_tracker()
+    scope.tracker.save(scope.microbatch, ns or _GLOBAL_NS, name, value)
+
+
+def pop(name: str, ns: Optional[Namespace] = None):
+    """Retrieve (and consume) the value stashed under ``name``."""
+    scope = current_skip_tracker()
+    return scope.tracker.load(scope.microbatch, ns or _GLOBAL_NS, name)
+
+
+def verify_skippables(module: Sequential) -> None:
+    """Fail-fast static check of stash/pop pairing (reference ``pipe.py:336``).
+
+    Every pop must have exactly one earlier stash of the same ``(ns, name)``;
+    a name must not be stashed twice; every stash must be popped (unpopped
+    stashes leak memory in a pipeline, so they are rejected like the
+    reference's verify).
+    """
+    stashed: Set[Tuple[object, str]] = set()
+    popped: Set[Tuple[object, str]] = set()
+    msgs = []
+
+    for i, layer in enumerate(module):
+        for key in sorted(getattr(layer, "stashes", ()),
+                          key=lambda k: (id(k[0]), k[1])):
+            if key in stashed:
+                msgs.append(f"layer {i}: '{key[1]}' is stashed twice")
+            stashed.add(key)
+        for key in sorted(getattr(layer, "pops", ()),
+                          key=lambda k: (id(k[0]), k[1])):
+            if key not in stashed:
+                msgs.append(
+                    f"layer {i}: '{key[1]}' is popped before it is stashed")
+            elif key in popped:
+                msgs.append(f"layer {i}: '{key[1]}' is popped twice")
+            popped.add(key)
+
+    for key in stashed - popped:
+        msgs.append(f"'{key[1]}' is stashed but never popped")
+
+    if msgs:
+        raise TypeError("skip connections are miswired:\n  " +
+                        "\n  ".join(msgs))

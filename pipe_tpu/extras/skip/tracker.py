@@ -1,0 +1,137 @@
+"""Skip tracker: the runtime store that carries stashed values to their pops.
+
+Parity with the reference ``skip/tracker.py`` (``SkipTracker``,
+``SkipTrackerThroughPotals``, ``use_skip_tracker`` — used by the scheduler at
+``pipeline.py:21,113,136-138,201,208``). The reference needs one tracker per
+micro-batch plus portal objects so stashed tensors ride copy streams between
+non-adjacent devices; here the executors run under trace (emulator) where a
+plain keyed store suffices — XLA sees the stash→pop dataflow and compiles the
+transfer and its gradient. Values are keyed per micro-batch so the m
+concurrent wavefront lanes never mix (the reference allocates m trackers for
+the same reason, ``pipeline.py:113``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Any, Dict, Optional, Tuple
+
+from .layout import SkipLayout
+
+__all__ = ["SkipTracker", "current_skip_tracker", "use_skip_tracker"]
+
+_current: contextvars.ContextVar[Optional["_Scope"]] = contextvars.ContextVar(
+    "pipe_tpu_skip_scope", default=None)
+
+
+class _Scope:
+    __slots__ = ("tracker", "microbatch", "stage")
+
+    def __init__(self, tracker: "SkipTracker", microbatch: int, stage: int):
+        self.tracker = tracker
+        self.microbatch = microbatch
+        self.stage = stage
+
+
+class SkipTracker:
+    """Stores stashed values per (microbatch, namespace, name).
+
+    A pop consumes its value (portal lifetime semantics: the reference's
+    portal drops its tensor once the destination copy happened).
+    """
+
+    def __init__(self, layout: Optional[SkipLayout] = None,
+                 spec_mode: bool = False):
+        self.layout = layout
+        # spec_mode serves shape inference (init/out_spec chains): stashes
+        # store only ShapeDtypeStructs (tracers cannot cross eval_shape
+        # boundaries), pops return zeros of the stored spec and do not
+        # consume, and repeated stashes overwrite (out_spec may re-trace).
+        self.spec_mode = spec_mode
+        self._store: Dict[Tuple[int, Any, str], Any] = {}
+        # Cross-microbatch stat accumulators (deferred BatchNorm channel):
+        # keyed (ns, name) only — values merge additively across tasks.
+        self.accum: Dict[Tuple[Any, str], Any] = {}
+
+    # -- used by executors ------------------------------------------------
+    @contextlib.contextmanager
+    def scope(self, microbatch: int, stage: int):
+        """Activate this tracker for one (microbatch, stage) task."""
+        token = _current.set(_Scope(self, microbatch, stage))
+        try:
+            yield self
+        finally:
+            _current.reset(token)
+
+    # -- used by skippable modules ---------------------------------------
+    def save(self, microbatch: int, ns, name: str, value: Any) -> None:
+        key = (microbatch, ns, name)
+        if self.spec_mode:
+            import jax
+            import jax.numpy as jnp
+            self._store[key] = jax.ShapeDtypeStruct(
+                jnp.shape(value), jnp.result_type(value))
+            return
+        if key in self._store:
+            raise RuntimeError(
+                f"skip {(ns, name)!r} stashed twice for microbatch {microbatch}")
+        self._store[key] = value
+
+    def load(self, microbatch: int, ns, name: str) -> Any:
+        key = (microbatch, ns, name)
+        if key not in self._store:
+            raise RuntimeError(
+                f"skip {(ns, name)!r} popped before stash "
+                f"(microbatch {microbatch})")
+        if self.spec_mode:
+            import jax.numpy as jnp
+            spec = self._store[key]  # non-consuming: re-traces re-pop
+            return jnp.zeros(spec.shape, spec.dtype)
+        return self._store.pop(key)
+
+    def accumulate(self, ns, name: str, value: Any) -> None:
+        """Add ``value`` (a pytree) into the (ns, name) accumulator.
+
+        Used by stat-bearing layers (DeferredBatchNorm): per-microbatch
+        partial sums accumulate across the whole mini-batch and are read once
+        after the schedule drains (reference ``batchnorm.py`` capability,
+        ``README.md:549-554``). Gradients are not tracked through stats.
+        """
+        import jax
+        value = jax.tree_util.tree_map(jax.lax.stop_gradient, value)
+        key = (ns, name)
+        if key in self.accum:
+            self.accum[key] = jax.tree_util.tree_map(
+                lambda a, b: a + b, self.accum[key], value)
+        else:
+            self.accum[key] = value
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+
+def accumulate(ns, name: str, value: Any) -> bool:
+    """Accumulate into the active tracker; False (no-op) outside a run."""
+    scope = _current.get()
+    if scope is None or scope.tracker.spec_mode:
+        return False
+    scope.tracker.accumulate(ns, name, value)
+    return True
+
+
+def current_skip_tracker() -> _Scope:
+    """The active (tracker, microbatch, stage) scope, or raise."""
+    scope = _current.get()
+    if scope is None:
+        raise RuntimeError(
+            "stash/pop used outside a pipeline run (no active skip tracker); "
+            "skippable modules only work under Pipe/emulator execution")
+    return scope
+
+
+@contextlib.contextmanager
+def use_skip_tracker(tracker: SkipTracker, microbatch: int = 0, stage: int = 0):
+    """Public form of :meth:`SkipTracker.scope` (reference ``use_skip_tracker``)."""
+    with tracker.scope(microbatch, stage):
+        yield tracker

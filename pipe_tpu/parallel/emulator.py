@@ -16,7 +16,6 @@ the true dependency graph, and backward order falls out of ``jax.grad``.
 
 from __future__ import annotations
 
-import contextlib
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -30,23 +29,68 @@ __all__ = ["run"]
 
 
 def _compute_one(stage: Stage, params: Any, batch: mb.Batch, ctx: StageCtx,
-                 remat: bool, remat_policy) -> mb.Batch:
+                 remat: bool, remat_policy, skip_tracker=None) -> mb.Batch:
     """Run one (microbatch, stage) task, optionally under jax.checkpoint.
 
     The PRNG key rides as an explicit argument of the remat'd function so the
     recomputed forward sees the identical key — the reference's
     ``save/restore_rng_states`` (``README.md:528-537``) with no runtime state.
+
+    Skip values cross the task (and hence the ``jax.checkpoint``) boundary as
+    explicit inputs/outputs: incoming pops are loaded from the persistent
+    tracker and fed in, outgoing stashes are returned and saved back. Tracers
+    must not leak out of a remat trace via Python state, so a fresh per-task
+    tracker serves the in-stage stash/pop calls — the TPU-native stand-in for
+    the reference's portal machinery threading skips through the
+    ``Checkpointing`` graph (``pipeline.py:136-138,201,208``).
     """
     key = ctx.key
+    layout = (getattr(skip_tracker, "layout", None)
+              if skip_tracker is not None else None)
+    pop_keys = layout.pops_of(ctx.stage) if layout else ()
+    stash_keys = layout.stashes_of(ctx.stage) if layout else ()
 
-    def task(p, k, *inputs):
+    def call_payload(p, k, *inputs):
         inner = StageCtx(key=k, train=ctx.train,
                          microbatch=ctx.microbatch, stage=ctx.stage)
         return stage(p, *inputs, ctx=inner)
 
+    if skip_tracker is None:
+        def task(p, k, *inputs):
+            return call_payload(p, k, *inputs)
+
+        task = apply_remat(task, enabled=remat, policy=remat_policy)
+        with jax.named_scope(f"chunk{ctx.microbatch}-stage{ctx.stage}"):
+            return batch.call(lambda *inputs: task(params, key, *inputs))
+
+    from ..extras.skip import SkipTracker
+
+    pop_vals = [skip_tracker.load(ctx.microbatch, ns, name)
+                for ns, name in pop_keys]
+
+    def task(p, k, pop_vals, *inputs):
+        local = SkipTracker(layout)
+        for (ns, name), v in zip(pop_keys, pop_vals):
+            local.save(ctx.microbatch, ns, name, v)
+        with local.scope(ctx.microbatch, ctx.stage):
+            out = call_payload(p, k, *inputs)
+        stash_vals = [local.load(ctx.microbatch, ns, name)
+                      for ns, name in stash_keys]
+        # Stat accumulators (deferred BN) also cross the remat boundary as
+        # explicit outputs; dict keys are static by the end of the trace.
+        return out, stash_vals, dict(local.accum)
+
     task = apply_remat(task, enabled=remat, policy=remat_policy)
     with jax.named_scope(f"chunk{ctx.microbatch}-stage{ctx.stage}"):
-        return batch.call(lambda *inputs: task(params, key, *inputs))
+        result, stash_vals, accums = task(params, key, pop_vals,
+                                          *batch.values)
+    for (ns, name), v in zip(stash_keys, stash_vals):
+        skip_tracker.save(ctx.microbatch, ns, name, v)
+    for (ns, name), v in accums.items():
+        skip_tracker.accumulate(ns, name, v)
+    if isinstance(result, (tuple, list)):
+        return mb.Batch(tuple(result), atomic=False)
+    return mb.Batch(result, atomic=True)
 
 
 def run(stages: Sequence[Stage],
@@ -82,10 +126,8 @@ def run(stages: Sequence[Stage],
                     f"stage={j}) outside the {m}x{n} grid")
             ctx = StageCtx(key=key, train=train, microbatch=i, stage=j)
             ctx = ctx.fold(i, j) if key is not None else ctx
-            cm = (skip_tracker.scope(microbatch=i, stage=j)
-                  if skip_tracker is not None else contextlib.nullcontext())
-            with cm:
-                batches[i] = _compute_one(
-                    stages[j], params_per_stage[j], batches[i], ctx,
-                    remat=i < stop, remat_policy=remat_policy)
+            batches[i] = _compute_one(
+                stages[j], params_per_stage[j], batches[i], ctx,
+                remat=i < stop, remat_policy=remat_policy,
+                skip_tracker=skip_tracker)
     return batches

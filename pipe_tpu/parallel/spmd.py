@@ -16,9 +16,15 @@ ordering edges (``pipeline.py:128-132``) — with a single ``shard_map``'d
   reverse schedule fall out of AD (the moral equivalent of ``Copy.backward``/
   ``Wait.backward``, ``README.md:219-237,359-369``), and backward micro-batch
   ordering is compiled instead of discovered by a C++ graph walk;
-* remat: per-microbatch ``jax.checkpoint`` selected by a ``lax.cond`` on the
-  in-flight micro-batch index (modes ``always``/``except_last``/``never``,
-  reference ``pipe.py:354``), eval-mode off (``pipeline.py:153-155``);
+* remat: ``jax.checkpoint`` on the stage body (modes ``always``/
+  ``except_last``/``never``, reference ``pipe.py:354``), eval-mode off
+  (``pipeline.py:153-155``). NOTE: on this compiled path the remat decision is
+  *static* — ``except_last`` remats every micro-batch (numerically identical;
+  memory ≤ the reference's except_last; ~1/m extra recompute). The exact
+  per-microbatch policy needs ``lax.cond(i < stop, remat(body), body)``, which
+  jax 0.9.0 cannot differentiate when the body consumes PRNG (cond branch
+  residual join emits mismatched branch return types). The serial emulator
+  path implements the exact per-microbatch policy;
 * overlap: XLA's latency-hiding scheduler overlaps the collective-permute with
   stage compute — the role of the reference's dedicated copy streams.
 
@@ -200,17 +206,16 @@ class SpmdPipeline:
                                            train=train)),
                 lambda: h)
 
-            # --- stage body, remat'd when i < checkpoint_stop ---
+            # --- stage body, remat'd when the mode asks for any remat at all
+            # (static selection; see module docstring for why not per-i) ---
             def body(p, k, h):
                 return self.stage_fn(p, h, StageCtx(key=k, train=train))
 
-            body_remat = jax.checkpoint(body, policy=self.remat_policy) \
-                if self.remat_policy is not None else jax.checkpoint(body)
+            if stop > 0:
+                body = jax.checkpoint(body, policy=self.remat_policy) \
+                    if self.remat_policy is not None else jax.checkpoint(body)
             bkey = jax.random.fold_in(ctx_key, 1)
-            h = jax.lax.cond(
-                i < stop,
-                lambda: body_remat(params_j, bkey, h),
-                lambda: body(params_j, bkey, h))
+            h = body(params_j, bkey, h)
 
             # --- last stage emits output for valid micro-batches ---
             valid = (j == n - 1) & (i >= 0) & (i < m)

@@ -82,13 +82,7 @@ class Pipe:
         self.module = module
 
         if deferred_batch_norm:
-            try:
-                from .extras.norm import convert_deferred_batch_norm
-            except ImportError as e:
-                raise NotImplementedError(
-                    "deferred_batch_norm is not implemented yet "
-                    "(extras/norm is on the roadmap; reference capability "
-                    "pipe.py:261-266)") from e
+            from .extras.norm import convert_deferred_batch_norm
             module = convert_deferred_batch_norm(module, chunks)
             self.module = module
         self.deferred_batch_norm = deferred_batch_norm
@@ -115,6 +109,16 @@ class Pipe:
         verify_stages(self.stages)
         self._schedule: Schedule = get_schedule(schedule)
 
+        # Skip-connection wiring: fail-fast verification at init (reference
+        # verify_skippables, pipe.py:336) and the static stash->pop layout
+        # (reference inspect_skip_layout, pipe.py:348).
+        from .extras.skip import inspect_skip_layout, verify_skippables
+        verify_skippables(self.module)
+        self.skip_layout = inspect_skip_layout(self.partitions)
+        # After verify_skippables, every declared stash/pop resolves to a
+        # layout pair, so this single flag decides tracker creation.
+        self._needs_skip_tracker = self.skip_layout.num_skips > 0
+
     # --- container protocol (reference pipe.py:358-386) ---
 
     def __len__(self) -> int:
@@ -135,15 +139,26 @@ class Pipe:
 
     def init(self, key: jax.Array, *example_inputs) -> List[Any]:
         """Per-stage parameter pytrees, shapes chained stage to stage."""
+        import contextlib
+
+        # Shape inference through skip-carrying layers: a spec-mode tracker
+        # records stash shapes and serves pops as zeros (tracers cannot cross
+        # the per-partition eval_shape boundaries).
+        cm = contextlib.nullcontext()
+        if self._needs_skip_tracker:
+            from .extras.skip import SkipTracker, use_skip_tracker
+            cm = use_skip_tracker(SkipTracker(self.skip_layout,
+                                              spec_mode=True))
         params: List[Any] = []
         specs = [jax.ShapeDtypeStruct(jax.numpy.shape(x), jax.numpy.result_type(x))
                  for x in example_inputs]
-        for j, part in enumerate(self.partitions):
-            pkey = jax.random.fold_in(key, j)
-            p = part.init(pkey, *specs)
-            params.append(p)
-            out = part.out_spec(p, *specs)
-            specs = list(out) if isinstance(out, (tuple, list)) else [out]
+        with cm:
+            for j, part in enumerate(self.partitions):
+                pkey = jax.random.fold_in(key, j)
+                p = part.init(pkey, *specs)
+                params.append(p)
+                out = part.out_spec(p, *specs)
+                specs = list(out) if isinstance(out, (tuple, list)) else [out]
         verify_splitting(params)
         return params
 
@@ -153,13 +168,29 @@ class Pipe:
                  key: Optional[jax.Array] = None,
                  train: bool = False,
                  remat_policy=None):
+        from .extras.norm import DeferredBatchNorm, commit_batchnorm_stats
+
         mb.check(*inputs)
         batches = mb.scatter(inputs, self.chunks)
+        has_bn = any(isinstance(l, DeferredBatchNorm) for l in self)
+        skip_tracker = None
+        if has_bn or self._needs_skip_tracker:
+            from .extras.skip import SkipTracker
+            skip_tracker = SkipTracker(self.skip_layout)
         batches = emulator.run(
             self.stages, list(params), batches,
             schedule=self._schedule,
             checkpoint=self.checkpoint,
-            train=train, key=key, remat_policy=remat_policy)
-        return mb.gather(batches)
+            train=train, key=key, remat_policy=remat_policy,
+            skip_tracker=skip_tracker)
+        out = mb.gather(batches)
+        if has_bn and train:
+            # Deferred-BN commit: one running-stats update per mini-batch
+            # (reference batchnorm.py capability; torch mutates buffers in
+            # place, a pure program returns the new params instead).
+            new_params = commit_batchnorm_stats(
+                self.partitions, list(params), skip_tracker)
+            return out, new_params
+        return out
 
     forward = __call__

@@ -1,0 +1,111 @@
+"""Deferred BatchNorm tests (reference test_deferred_batch_norm, SURVEY §4).
+
+The core property: running stats after one pipelined mini-batch (any chunks)
+equal the stats of one whole-batch BN update — micro-batching must not change
+BN semantics (reference batchnorm.py capability, README.md:549-554).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.extras.norm import (BatchNorm, DeferredBatchNorm,
+                                  convert_deferred_batch_norm)
+from pipe_tpu.ops.layers import Lambda, Linear, Sequential
+from pipe_tpu.pipe import Pipe
+
+
+def whole_batch_reference_stats(x, momentum=0.1):
+    """One torch-style BN update from the full mini-batch."""
+    n = x.shape[0] * (x.shape[1] if x.ndim == 3 else 1)
+    axes = tuple(range(x.ndim - 1))
+    mean = np.mean(np.asarray(x), axis=axes)
+    var = np.var(np.asarray(x), axis=axes)
+    unbiased = var * n / max(n - 1.0, 1.0)
+    return momentum * mean, (1 - momentum) * 1.0 + momentum * unbiased
+
+
+@pytest.mark.parametrize("chunks", [1, 2, 4])
+@pytest.mark.parametrize("checkpoint", ["never", "always"])
+def test_running_stats_match_whole_batch(chunks, checkpoint):
+    module = Sequential([Linear(6), BatchNorm()])
+    pipe = Pipe(module, chunks=chunks, checkpoint=checkpoint, n_stages=2,
+                deferred_batch_norm=True)
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    params = pipe.init(jax.random.key(0), x)
+
+    out, new_params = pipe(params, x, train=True, key=jax.random.key(2))
+
+    # reference: stats of the *linear output* over the whole batch
+    h = module[0].apply(params[0][0], x)
+    exp_mean, exp_var = whole_batch_reference_stats(h)
+    got = new_params[1][0]  # stage 1, layer 0 = the converted BN
+    np.testing.assert_allclose(np.asarray(got["mean"]), exp_mean,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["var"]), exp_var,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_chunks_invariance():
+    """Stats identical whether the batch ran as 1, 2, or 4 micro-batches."""
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    results = []
+    for chunks in (1, 2, 4):
+        pipe = Pipe(Sequential([Linear(6), BatchNorm()]), chunks=chunks,
+                    n_stages=2, deferred_batch_norm=True)
+        params = pipe.init(jax.random.key(0), x)
+        _, new_params = pipe(params, x, train=True)
+        results.append(new_params[1][0])
+    for r in results[1:]:
+        np.testing.assert_allclose(np.asarray(r["mean"]),
+                                   np.asarray(results[0]["mean"]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(r["var"]),
+                                   np.asarray(results[0]["var"]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_eval_uses_running_stats():
+    pipe = Pipe(Sequential([BatchNorm()]), chunks=2, n_stages=1,
+                deferred_batch_norm=True)
+    x = jax.random.normal(jax.random.key(1), (8, 4)) * 3.0 + 1.0
+    params = pipe.init(jax.random.key(0), x)
+    out = pipe(params, x, train=False)  # eval: single return, no commit
+    # init stats are mean=0, var=1 -> eval output equals input (scale=1,b=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_train_forward_normalizes_per_microbatch():
+    pipe = Pipe(Sequential([BatchNorm()]), chunks=2, n_stages=1,
+                deferred_batch_norm=True)
+    x = jax.random.normal(jax.random.key(1), (8, 4)) * 5.0
+    params = pipe.init(jax.random.key(0), x)
+    out, _ = pipe(params, x, train=True)
+    # each micro-batch normalized by its own stats: per-half mean ~0, var ~1
+    for half in (np.asarray(out[:4]), np.asarray(out[4:])):
+        np.testing.assert_allclose(half.mean(axis=0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(half.var(axis=0), 1.0, atol=1e-3)
+
+
+def test_convert_replaces_only_plain_bn():
+    module = Sequential([Linear(4), BatchNorm(), Lambda(lambda x: x * 2)])
+    converted = convert_deferred_batch_norm(module, chunks=4)
+    kinds = [type(l).__name__ for l in converted]
+    assert kinds == ["Linear", "DeferredBatchNorm", "Lambda"]
+    assert isinstance(converted[1], DeferredBatchNorm)
+
+
+def test_momentum_accumulates_over_steps():
+    """Two train steps move stats twice (one commit per mini-batch each)."""
+    pipe = Pipe(Sequential([BatchNorm()]), chunks=2, n_stages=1,
+                deferred_batch_norm=True)
+    x = jnp.ones((8, 4)) * 2.0
+    params = pipe.init(jax.random.key(0), x)
+    _, p1 = pipe(params, x, train=True)
+    _, p2 = pipe(p1, x, train=True)
+    m1 = np.asarray(p1[0][0]["mean"])
+    m2 = np.asarray(p2[0][0]["mean"])
+    np.testing.assert_allclose(m1, 0.2, atol=1e-6)        # 0.9*0 + 0.1*2
+    np.testing.assert_allclose(m2, 0.38, atol=1e-6)       # 0.9*0.2 + 0.1*2

@@ -1,0 +1,156 @@
+"""Skip-connection subsystem tests (reference skip/ suite, SURVEY §2,4).
+
+Covers: @skippable declaration, stash/pop through Pipe across stages,
+verify_skippables failure modes, inspect_skip_layout wiring, Namespace
+isolation, gradient flow through a skip, and remat compatibility (skips must
+cross jax.checkpoint boundaries as explicit residuals).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.core.partition import StageCtx
+from pipe_tpu.extras.skip import (Namespace, SkipTracker, inspect_skip_layout,
+                                  pop, skippable, stash, verify_skippables)
+from pipe_tpu.ops.layers import Lambda, Linear, Module, Sequential
+from pipe_tpu.pipe import Pipe
+
+
+@skippable(stash=["skip"])
+class StashX(Module):
+    def init(self, key, *inputs):
+        return {}
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        stash("skip", x, getattr(self, "_skip_ns", None))
+        return x
+
+
+@skippable(pop=["skip"])
+class PopX(Module):
+    def init(self, key, *inputs):
+        return {}
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        return x + pop("skip", getattr(self, "_skip_ns", None))
+
+
+def double(x):
+    return x * 2.0
+
+
+def build_pipe(n_stages, chunks=2, checkpoint="never"):
+    """[stash, double, double, pop] split across stages: skip jumps stages."""
+    module = Sequential([
+        StashX(),
+        Lambda(double),
+        Lambda(double),
+        PopX(),
+    ])
+    return Pipe(module, chunks=chunks, checkpoint=checkpoint,
+                n_stages=n_stages)
+
+
+@pytest.mark.parametrize("n_stages", [1, 2, 4])
+@pytest.mark.parametrize("checkpoint", ["never", "except_last", "always"])
+def test_stash_pop_through_pipe(n_stages, checkpoint):
+    pipe = build_pipe(n_stages, chunks=2, checkpoint=checkpoint)
+    x = jnp.arange(8.0).reshape(4, 2)
+    params = pipe.init(jax.random.key(0), x)
+    out = pipe(params, x, train=True, key=jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x * 4 + x))
+
+
+def test_gradient_through_skip():
+    pipe = build_pipe(2, chunks=2)
+    x = jnp.ones((4, 2))
+    params = pipe.init(jax.random.key(0), x)
+
+    g = jax.grad(lambda x: jnp.sum(pipe(params, x)))(x)
+    # d/dx (4x + x) = 5
+    np.testing.assert_allclose(np.asarray(g), 5.0 * np.ones((4, 2)))
+
+
+def test_jit_through_skip():
+    pipe = build_pipe(2, chunks=2, checkpoint="always")
+    x = jnp.ones((4, 2))
+    params = pipe.init(jax.random.key(0), x)
+
+    out = jax.jit(lambda p, x: pipe(p, x, train=True,
+                                    key=jax.random.key(0)))(params, x)
+    np.testing.assert_allclose(np.asarray(out), 5.0 * np.ones((4, 2)))
+
+
+def test_verify_pop_before_stash():
+    with pytest.raises(TypeError, match="popped before"):
+        verify_skippables(Sequential([PopX(), StashX()]))
+
+
+def test_verify_unpopped_stash():
+    with pytest.raises(TypeError, match="never popped"):
+        verify_skippables(Sequential([StashX(), Lambda(double)]))
+
+
+def test_verify_double_stash():
+    with pytest.raises(TypeError, match="stashed twice"):
+        verify_skippables(Sequential([StashX(), StashX(), PopX(), PopX()]))
+
+
+def test_namespace_isolation():
+    ns1, ns2 = Namespace(), Namespace()
+    module = Sequential([
+        StashX().isolate(ns1),
+        StashX().isolate(ns2),
+        PopX().isolate(ns1),
+        PopX().isolate(ns2),
+    ])
+    verify_skippables(module)  # no mis-wiring: namespaces disambiguate
+    pipe = Pipe(module, chunks=2, n_stages=2)
+    x = jnp.ones((4, 2))
+    params = pipe.init(jax.random.key(0), x)
+    out = pipe(params, x)
+    # x -> stash(ns1) -> stash(ns2) -> +pop(ns1) -> +pop(ns2)
+    np.testing.assert_allclose(np.asarray(out), 3.0 * np.ones((4, 2)))
+
+
+def test_inspect_skip_layout():
+    pipe = build_pipe(4, chunks=1)
+    layout = pipe.skip_layout
+    assert layout.num_skips == 1
+    # stash in stage 0 (layer 0), pop in stage 3 (layer 3)
+    assert layout.requires_copy(0, 3)
+    assert list(layout.copy_policy(3))[0][0] == 0
+    assert layout.stashes_of(0) and layout.pops_of(3)
+    assert layout.max_hop() == 3
+
+
+def test_same_stage_skip_stays_local():
+    pipe = build_pipe(1, chunks=2)
+    assert pipe.skip_layout.num_skips == 1
+    assert pipe.skip_layout.stashes_of(0) == ()  # same-stage: no export
+    x = jnp.ones((4, 2))
+    params = pipe.init(jax.random.key(0), x)
+    np.testing.assert_allclose(np.asarray(pipe(params, x)),
+                               5.0 * np.ones((4, 2)))
+
+
+def test_tracker_double_stash_raises():
+    t = SkipTracker()
+    with t.scope(0, 0):
+        stash("a", jnp.ones(2))
+        with pytest.raises(RuntimeError, match="stashed twice"):
+            stash("a", jnp.ones(2))
+
+
+def test_pop_without_stash_raises():
+    t = SkipTracker()
+    with t.scope(0, 0):
+        with pytest.raises(RuntimeError, match="popped before stash"):
+            pop("nothing")
+
+
+def test_stash_outside_run_raises():
+    with pytest.raises(RuntimeError, match="outside a pipeline run"):
+        stash("a", jnp.ones(2))
