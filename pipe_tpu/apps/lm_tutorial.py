@@ -1,0 +1,112 @@
+"""Tutorial driver: the reference ``main.py`` flow, TPU-native.
+
+Parity walkthrough (reference ``main.py``):
+  corpus → tokenizer → vocab → batchify (``main.py:76-105``) →
+  Transformer LM (emsize 2048, nhid 2048, nlayers 16, nhead 32, dropout 0.2,
+  ``main.py:115-120``) → pipeline over stages with chunks=4
+  (``main.py:162-171``) → Adam + StepLR + clip, ~8·bptt tokens
+  (``main.py:182-234``) → optional profiler trace (``main.py:196-204``).
+
+Usage (mirrors ``python main.py <checkpoint-mode>``, ``main.py:164-169``):
+    python -m pipe_tpu.apps.lm_tutorial <never|except_last|always>
+        [--corpus FILE] [--steps N] [--stages N] [--tiny] [--profile DIR]
+        [--save DIR] [--resume DIR] [--cpu N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint", choices=["never", "except_last", "always"],
+                   help="activation-checkpoint mode (main.py:164-169)")
+    p.add_argument("--corpus", default=None,
+                   help="text file; default: deterministic synthetic corpus")
+    p.add_argument("--steps", type=int, default=8,
+                   help="train steps (~8·bptt tokens like main.py:194)")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--stages", type=int, default=2)
+    p.add_argument("--chunks", type=int, default=4)
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny model config (CI / CPU-sized)")
+    p.add_argument("--profile", default=None,
+                   help="jax.profiler trace dir (main.py:196-204 equivalent)")
+    p.add_argument("--save", default=None, help="checkpoint dir to save into")
+    p.add_argument("--resume", default=None, help="checkpoint dir to resume")
+    p.add_argument("--cpu", type=int, default=0,
+                   help="force N virtual CPU devices (testing without TPU)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    if args.cpu:
+        from pipe_tpu.utils.platform import force_cpu_platform
+        force_cpu_platform(args.cpu)
+
+    import dataclasses
+
+    import jax
+
+    from pipe_tpu.data import lm_text
+    from pipe_tpu.models.transformer_lm import LMConfig
+    from pipe_tpu.train.loop import Trainer, TrainerConfig
+    from pipe_tpu.train.state import restore_checkpoint, save_checkpoint
+
+    train_lines, val_lines, _ = lm_text.load_corpus(args.corpus)
+    vocab = lm_text.Vocab(map(lm_text.basic_english_tokenize, train_lines))
+    train_ids = lm_text.data_process(train_lines, vocab)
+    val_ids = lm_text.data_process(val_lines, vocab)
+
+    model_cfg = LMConfig(vocab=max(len(vocab), 2))
+    if args.tiny:
+        model_cfg = dataclasses.replace(
+            model_cfg.tiny(), vocab=max(len(vocab), 2),
+            n_layers=2 * args.stages)
+    cfg = TrainerConfig(chunks=args.chunks, checkpoint=args.checkpoint,
+                        n_stages=args.stages)
+    if args.tiny:
+        cfg = dataclasses.replace(cfg, batch_size=8, eval_batch_size=8,
+                                  bptt=model_cfg.seq_len, lr=1e-3)
+
+    train_data = lm_text.batchify(train_ids, cfg.batch_size)
+    val_data = lm_text.batchify(val_ids, cfg.eval_batch_size)
+
+    trainer = Trainer(model_cfg, cfg)
+    state = trainer.init_state()
+    if args.resume:
+        state = restore_checkpoint(args.resume, state)
+        print(f"resumed from step {int(state.step)}")
+    print(f"Total parameters in model: {trainer.num_params(state):,}")
+
+    prof_cm = None
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+        prof_cm = args.profile
+
+    try:
+        for epoch in range(args.epochs):
+            state, metrics = trainer.train_epoch(
+                train_data, epoch=epoch, state=state,
+                max_steps=args.steps, log_every=max(args.steps // 4, 1))
+    finally:
+        if prof_cm:
+            jax.profiler.stop_trace()
+            print(f"profiler trace written to {prof_cm}")
+
+    if val_data.shape[0] > cfg.bptt:
+        val_loss = trainer.evaluate(val_data, state, max_steps=4)
+        print(f"val loss {val_loss:.3f}")
+    if args.save:
+        save_checkpoint(args.save, state, int(state.step))
+        print(f"checkpoint saved to {args.save} @ step {int(state.step)}")
+    print(f"final train loss {metrics['loss']:.3f} "
+          f"({metrics['sec_per_step']*1000:.1f} ms/step)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

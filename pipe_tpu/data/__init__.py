@@ -1,0 +1,10 @@
+"""Input pipelines (tutorial-parity LM text processing)."""
+
+from . import lm_text
+from .lm_text import (Vocab, basic_english_tokenize, batchify, data_process,
+                      get_batch, load_corpus, num_batches, synthetic_corpus)
+
+__all__ = [
+    "lm_text", "Vocab", "basic_english_tokenize", "batchify", "data_process",
+    "get_batch", "load_corpus", "num_batches", "synthetic_corpus",
+]

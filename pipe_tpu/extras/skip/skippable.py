@@ -18,13 +18,20 @@ scope, so ``stash``/``pop`` are direct calls:
         def apply(self, params, x, ctx=StageCtx()):
             return x + pop("1to3")
 
-Two instances of the same skippable class are isolated with
-``module.isolate(Namespace())`` (reference ``Skippable.isolate``).
+Bare ``stash("name", v)`` / ``pop("name")`` resolve through the *calling
+module instance* (the decorator binds it around ``apply``), so namespace
+isolation works without threading namespaces by hand: two instances of the
+same skippable class are isolated with ``module.isolate(Namespace())``, and
+``isolate(ns, only=[...])`` moves only the listed names into ``ns``, leaving
+the rest in their current namespace (reference ``Skippable.isolate``
+semantics).
 """
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Sequence, Set, Tuple
+import contextvars
+import copy
+from typing import Dict, Iterable, Optional, Sequence, Set, Tuple
 
 from ...ops.layers import Module, Sequential
 from .namespace import Namespace
@@ -32,42 +39,51 @@ from .tracker import current_skip_tracker
 
 __all__ = ["Skippable", "skippable", "stash", "pop", "verify_skippables"]
 
-_GLOBAL_NS = Namespace()  # default namespace for un-isolated skippables
+_GLOBAL_NS = Namespace()  # default namespace for un-isolated skips
+
+# The skippable instance whose apply() is currently executing — lets bare
+# stash()/pop() resolve names through that instance's namespace map.
+_active: contextvars.ContextVar[Optional["Skippable"]] = \
+    contextvars.ContextVar("pipe_tpu_active_skippable", default=None)
 
 
 class Skippable:
     """Mixin marking a Module as stashing/popping named skips.
 
     Applied by :func:`skippable`; carries ``stashes``/``pops`` as sets of
-    ``(namespace, name)`` resolved through the instance's namespace.
+    ``(namespace, name)`` resolved through the instance's per-name namespace
+    map (``isolate`` rewrites entries of that map).
     """
 
     _stash_names: Tuple[str, ...] = ()
     _pop_names: Tuple[str, ...] = ()
 
     @property
-    def namespace(self):
-        return getattr(self, "_skip_ns", _GLOBAL_NS)
+    def namespace_map(self) -> Dict[str, Namespace]:
+        return getattr(self, "_skip_ns_map", {})
+
+    def ns_of(self, name: str) -> Namespace:
+        return self.namespace_map.get(name, _GLOBAL_NS)
 
     def isolate(self, ns: Namespace, *, only: Optional[Iterable[str]] = None):
-        """Return a copy whose skips live in ``ns`` (reference ``isolate``)."""
-        import copy
-
+        """Copy with the given (or all) skip names moved into ``ns``;
+        unselected names keep their current namespace."""
         clone = copy.copy(self)
-        clone._skip_ns = ns
-        if only is not None:
-            keep = set(only)
-            clone._stash_names = tuple(n for n in self._stash_names if n in keep)
-            clone._pop_names = tuple(n for n in self._pop_names if n in keep)
+        mapping = dict(self.namespace_map)
+        names = tuple(only) if only is not None else (
+            self._stash_names + self._pop_names)
+        for n in names:
+            mapping[n] = ns
+        clone._skip_ns_map = mapping
         return clone
 
     @property
     def stashes(self) -> Set[Tuple[object, str]]:
-        return {(self.namespace, n) for n in self._stash_names}
+        return {(self.ns_of(n), n) for n in self._stash_names}
 
     @property
     def pops(self) -> Set[Tuple[object, str]]:
-        return {(self.namespace, n) for n in self._pop_names}
+        return {(self.ns_of(n), n) for n in self._pop_names}
 
 
 def skippable(stash: Sequence[str] = (), pop: Sequence[str] = ()):
@@ -78,24 +94,44 @@ def skippable(stash: Sequence[str] = (), pop: Sequence[str] = ()):
     def decorate(cls):
         if not issubclass(cls, Module):
             raise TypeError("@skippable expects a Module subclass")
+
+        inner_apply = cls.apply
+
+        def apply(self, params, *inputs, **kwargs):
+            token = _active.set(self)
+            try:
+                return inner_apply(self, params, *inputs, **kwargs)
+            finally:
+                _active.reset(token)
+
         return type(cls.__name__, (Skippable, cls), {
             "_stash_names": stash_names,
             "_pop_names": pop_names,
+            "apply": apply,
         })
 
     return decorate
 
 
+def _resolve_ns(name: str, ns: Optional[Namespace]) -> Namespace:
+    if ns is not None:
+        return ns
+    inst = _active.get()
+    if inst is not None:
+        return inst.ns_of(name)
+    return _GLOBAL_NS
+
+
 def stash(name: str, value, ns: Optional[Namespace] = None) -> None:
     """Record ``value`` under ``name`` for a later stage's :func:`pop`."""
     scope = current_skip_tracker()
-    scope.tracker.save(scope.microbatch, ns or _GLOBAL_NS, name, value)
+    scope.tracker.save(scope.microbatch, _resolve_ns(name, ns), name, value)
 
 
 def pop(name: str, ns: Optional[Namespace] = None):
     """Retrieve (and consume) the value stashed under ``name``."""
     scope = current_skip_tracker()
-    return scope.tracker.load(scope.microbatch, ns or _GLOBAL_NS, name)
+    return scope.tracker.load(scope.microbatch, _resolve_ns(name, ns), name)
 
 
 def verify_skippables(module: Sequential) -> None:

@@ -1,0 +1,10 @@
+"""Training: jitted pipelined train step, state, checkpoint/resume."""
+
+from .loop import Trainer, TrainerConfig
+from .state import (TrainState, latest_step, restore_checkpoint,
+                    save_checkpoint)
+
+__all__ = [
+    "Trainer", "TrainerConfig", "TrainState",
+    "save_checkpoint", "restore_checkpoint", "latest_step",
+]

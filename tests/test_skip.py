@@ -24,7 +24,7 @@ class StashX(Module):
         return {}
 
     def apply(self, params, x, ctx: StageCtx = StageCtx()):
-        stash("skip", x, getattr(self, "_skip_ns", None))
+        stash("skip", x)  # bare call: namespace resolves via the instance
         return x
 
 
@@ -34,7 +34,7 @@ class PopX(Module):
         return {}
 
     def apply(self, params, x, ctx: StageCtx = StageCtx()):
-        return x + pop("skip", getattr(self, "_skip_ns", None))
+        return x + pop("skip")
 
 
 def double(x):
@@ -134,6 +134,45 @@ def test_same_stage_skip_stays_local():
     params = pipe.init(jax.random.key(0), x)
     np.testing.assert_allclose(np.asarray(pipe(params, x)),
                                5.0 * np.ones((4, 2)))
+
+
+def test_isolate_only_keeps_other_names():
+    """isolate(ns, only=[...]) moves only the listed names into ns."""
+    ns = Namespace()
+
+    @skippable(stash=["a", "b"])
+    class S2(Module):
+        def init(self, key, *inputs):
+            return {}
+
+        def apply(self, params, x, ctx: StageCtx = StageCtx()):
+            stash("a", x)
+            stash("b", 2 * x)
+            return x
+
+    iso = S2().isolate(ns, only=["a"])
+    assert iso._stash_names == ("a", "b")  # names survive
+    assert iso.ns_of("a") is ns
+    assert iso.ns_of("b") is not ns
+    assert {(n is ns, name) for n, name in iso.stashes} == {
+        (True, "a"), (False, "b")}
+
+
+def test_two_isolated_instances_of_one_class():
+    """Namespace isolation works with bare stash/pop calls (no manual ns)."""
+    ns1, ns2 = Namespace(), Namespace()
+    module = Sequential([
+        StashX().isolate(ns1),
+        StashX().isolate(ns2),
+        PopX().isolate(ns2),
+        PopX().isolate(ns1),
+    ])
+    verify_skippables(module)
+    pipe = Pipe(module, chunks=2, n_stages=4)
+    x = jnp.ones((4, 2))
+    params = pipe.init(jax.random.key(0), x)
+    out = pipe(params, x, train=True, key=jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(out), 3.0 * np.ones((4, 2)))
 
 
 def test_tracker_double_stash_raises():
