@@ -82,20 +82,19 @@ def main(argv=None) -> int:
         print(f"resumed from step {int(state.step)}")
     print(f"Total parameters in model: {trainer.num_params(state):,}")
 
-    prof_cm = None
-    if args.profile:
-        jax.profiler.start_trace(args.profile)
-        prof_cm = args.profile
+    import contextlib
 
-    try:
+    from pipe_tpu.obs import profile_trace
+
+    metrics = {"loss": float("nan"), "sec_per_step": float("nan")}
+    with (profile_trace(args.profile) if args.profile
+          else contextlib.nullcontext()):
         for epoch in range(args.epochs):
             state, metrics = trainer.train_epoch(
                 train_data, epoch=epoch, state=state,
                 max_steps=args.steps, log_every=max(args.steps // 4, 1))
-    finally:
-        if prof_cm:
-            jax.profiler.stop_trace()
-            print(f"profiler trace written to {prof_cm}")
+    if args.profile:
+        print(f"profiler trace written to {args.profile}")
 
     if val_data.shape[0] > cfg.bptt:
         val_loss = trainer.evaluate(val_data, state, max_steps=4)

@@ -1,0 +1,166 @@
+"""ctypes binding for the native corpus processor (csrc/pipetpu_io.cpp).
+
+The performance path for host-side input processing: one C++ pass builds the
+token-id stream and first-appearance vocabulary (the reference stack's data
+loading likewise bottoms out in torchtext's native kernels). The library is
+compiled on first use with g++ and cached next to the source; everything
+falls back to the pure-Python pipeline (``data.lm_text``) when a toolchain
+is unavailable, with identical token-for-token semantics (asserted by
+``tests/test_native_io.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["native_available", "NativeCorpus", "process_corpus"]
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc", "pipetpu_io.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "libpipetpu_io.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library if missing or stale; None on failure."""
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
+                 "-o", _LIB],
+                check=True, capture_output=True, timeout=120)
+        return _LIB
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.ptio_from_bytes.restype = ctypes.c_void_p
+        lib.ptio_from_bytes.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.ptio_from_file.restype = ctypes.c_void_p
+        lib.ptio_from_file.argtypes = [ctypes.c_char_p]
+        lib.ptio_num_tokens.restype = ctypes.c_int64
+        lib.ptio_num_tokens.argtypes = [ctypes.c_void_p]
+        lib.ptio_vocab_size.restype = ctypes.c_int32
+        lib.ptio_vocab_size.argtypes = [ctypes.c_void_p]
+        lib.ptio_copy_ids.restype = None
+        lib.ptio_copy_ids.argtypes = [ctypes.c_void_p, ctypes.POINTER(
+            ctypes.c_int32)]
+        lib.ptio_token.restype = ctypes.c_char_p
+        lib.ptio_token.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.ptio_lookup.restype = ctypes.c_int32
+        lib.ptio_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptio_free.restype = None
+        lib.ptio_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeCorpus:
+    """Token ids + vocabulary built by the C++ pass."""
+
+    def __init__(self, handle: int, lib: ctypes.CDLL):
+        self._h = handle
+        self._lib = lib
+
+    @classmethod
+    def from_file(cls, path: str) -> "NativeCorpus":
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native corpus library unavailable")
+        h = lib.ptio_from_file(path.encode())
+        if not h:
+            raise FileNotFoundError(
+                f"{path}: unreadable, non-seekable, or out of memory")
+        return cls(h, lib)
+
+    @classmethod
+    def from_text(cls, text: str) -> "NativeCorpus":
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native corpus library unavailable")
+        data = text.encode()
+        h = lib.ptio_from_bytes(data, len(data))
+        if not h:
+            raise MemoryError("native corpus build failed")
+        return cls(h, lib)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.ptio_free(self._h)
+            self._h = None
+
+    @property
+    def num_tokens(self) -> int:
+        return int(self._lib.ptio_num_tokens(self._h))
+
+    @property
+    def vocab_size(self) -> int:
+        return int(self._lib.ptio_vocab_size(self._h))
+
+    def ids(self) -> np.ndarray:
+        out = np.empty(self.num_tokens, np.int32)
+        self._lib.ptio_copy_ids(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+
+    def token(self, idx: int) -> str:
+        raw = self._lib.ptio_token(self._h, idx)
+        if raw is None:
+            raise IndexError(idx)
+        return raw.decode()
+
+    def lookup(self, token: str) -> int:
+        return int(self._lib.ptio_lookup(self._h, token.encode()))
+
+    def vocab_list(self) -> List[str]:
+        return [self.token(i) for i in range(self.vocab_size)]
+
+
+def process_corpus(path: Optional[str] = None, text: Optional[str] = None
+                   ) -> Tuple[np.ndarray, List[str]]:
+    """(ids, vocab) via the native pass, falling back to pure Python.
+
+    The native pass is used only for ASCII corpora — its lowercase and
+    whitespace handling are byte-wise, while the Python tokenizer is
+    Unicode-aware, so routing non-ASCII text natively would change ids.
+    """
+    if (path is None) == (text is None):
+        raise ValueError("pass exactly one of path or text")
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text_content = f.read()
+    else:
+        text_content = text
+    if native_available() and text_content.isascii():
+        c = (NativeCorpus.from_file(path) if path is not None
+             else NativeCorpus.from_text(text))
+        return c.ids(), c.vocab_list()
+    from . import lm_text
+    lines = text_content.splitlines()
+    vocab = lm_text.Vocab(map(lm_text.basic_english_tokenize, lines))
+    return lm_text.data_process(lines, vocab), \
+        [vocab.lookup_token(i) for i in range(len(vocab))]

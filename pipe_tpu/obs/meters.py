@@ -1,0 +1,98 @@
+"""Profiling and observability utilities.
+
+Capability parity with the reference's two tracing mechanisms (SURVEY §5):
+
+* kernel-level spans ``record_function("chunk%d-part%d")`` around every task
+  (reference ``pipeline.py:205-210``, removed by the local edit but
+  documented at ``README.md:263,408``) → :func:`stage_scope` emits
+  ``jax.named_scope("chunk{i}-stage{j}")``, which survives into XLA HLO op
+  names and Perfetto traces (the emulator already wraps every task in it);
+* driver-level ``torch.profiler`` with TensorBoard handler
+  (``main.py:196-204``) → :func:`profile_trace` wraps ``jax.profiler``;
+* CUDA memory-history snapshots (``main.py:263-271``) →
+  :func:`device_memory_report` via ``jax.profiler.device_memory_profile``;
+* the BASELINE.md north-star pipeline-bubble %% → :class:`BubbleMeter`
+  (analytic model now; per-stage idle extraction from traces is the
+  measured upgrade, SURVEY §7 hard part #4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional
+
+import jax
+
+from ..core.schedule import Schedule, bubble_fraction
+
+__all__ = ["stage_scope", "profile_trace", "device_memory_report",
+           "BubbleMeter"]
+
+
+def stage_scope(microbatch: int, stage: int):
+    """Named scope attributing ops to (micro-batch, stage) in traces."""
+    return jax.named_scope(f"chunk{microbatch}-stage{stage}")
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str, *, host_tracer_level: int = 2):
+    """Capture a profiler trace viewable in TensorBoard/Perfetto/XProf."""
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(logdir, profiler_options=options)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def device_memory_report(device: Optional[jax.Device] = None) -> str:
+    """Human-readable live-buffer summary (pprof textproto under the hood)."""
+    import gzip
+
+    device = device or jax.devices()[0]
+    raw = jax.profiler.device_memory_profile()
+    try:
+        raw = gzip.decompress(raw)
+    except OSError:
+        pass
+    lines = [f"device memory profile ({device}):",
+             f"  raw pprof bytes: {len(raw)}"]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if stats:
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in stats:
+                lines.append(f"  {k}: {stats[k] / 2**30:.3f} GiB")
+    return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class BubbleMeter:
+    """Pipeline-bubble accounting for a (chunks m, stages n) configuration.
+
+    ``analytic`` is the fill–drain model (n-1)/(m+n-1) (reference
+    ``_clock_cycles`` cost model, ``pipeline.py:63-79``); ``measured`` can be
+    filled from per-stage busy times (e.g. extracted from a profiler trace)
+    to report the honest number next to the model.
+    """
+
+    chunks: int
+    n_stages: int
+    schedule: Optional[Schedule] = None
+
+    @property
+    def analytic(self) -> float:
+        if self.schedule is not None:
+            return self.schedule.bubble(self.chunks, self.n_stages)
+        return bubble_fraction(self.chunks, self.n_stages)
+
+    def measured(self, stage_busy_sec, wall_sec: float) -> float:
+        """1 - busy/total from per-stage busy seconds and the step wall time."""
+        total = self.n_stages * wall_sec
+        busy = float(sum(stage_busy_sec))
+        return max(0.0, 1.0 - busy / total) if total > 0 else 0.0
+
+    def report(self) -> str:
+        return (f"bubble[m={self.chunks}, n={self.n_stages}] "
+                f"analytic={self.analytic:.2%}")

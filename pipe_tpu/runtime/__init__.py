@@ -1,0 +1,7 @@
+"""Runtime: multi-host initialization and global mesh/data placement."""
+
+from .distributed import (global_pipeline_mesh, host_local_batch, initialize,
+                          is_initialized, process_summary)
+
+__all__ = ["initialize", "is_initialized", "global_pipeline_mesh",
+           "host_local_batch", "process_summary"]

@@ -1,0 +1,141 @@
+"""Multi-host runtime: initialize, build global meshes, place host-local data.
+
+The reference's inter-node story is vestigial TensorPipe RPC — disabled with
+zero behavioral change (``pipe.py:318-323,491-494``; ``main.py:124-137``;
+``README.md:545``: "RPC is useless"). The real TPU-native multi-host plane is
+JAX's single-controller runtime: every host runs the same program,
+``jax.distributed.initialize`` wires the PJRT processes, meshes span all
+hosts' devices (ICI within a slice, DCN across slices), and the compiled
+collectives do the rest — no RPC layer to build, which is itself the design
+lesson the reference teaches.
+
+This module packages that story behind three calls:
+
+* :func:`initialize` — idempotent ``jax.distributed.initialize`` with env
+  autodetection (no-op single-process);
+* :func:`global_pipeline_mesh` — a ``(stage, data)`` mesh over ALL processes'
+  devices, stage axis laid out within a slice so inter-stage ppermute rides
+  ICI while the data axis crosses DCN (the scaling-book recipe);
+* :func:`host_local_batch` — form a global array from each host's local
+  shard (`jax.make_array_from_process_local_data`) for data loading.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, STAGE_AXIS
+
+__all__ = ["initialize", "is_initialized", "global_pipeline_mesh",
+           "host_local_batch", "process_summary"]
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Wire up the multi-host runtime (idempotent; no-op single-process).
+
+    With no arguments, resolution follows ``jax.distributed.initialize``'s
+    env autodetection (TPU metadata / cluster env vars). Single-process runs
+    (no coordinator found) proceed silently — the same code then works from
+    a laptop CPU to a multi-slice pod.
+    """
+    global _initialized
+    if _initialized:
+        return
+    explicit = coordinator_address is not None or num_processes is not None
+    if not explicit and not _cluster_hinted():
+        _initialized = True  # single-process: nothing to wire
+        return
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except (ValueError, RuntimeError) as e:
+        if explicit:
+            raise
+        import warnings
+        warnings.warn(
+            f"multi-host environment hinted but jax.distributed.initialize "
+            f"failed ({e}); continuing single-process — every host will "
+            f"train independently if this really is a pod", RuntimeWarning)
+    _initialized = True
+
+
+def _cluster_hinted() -> bool:
+    """True when the environment names an actual multi-host cluster.
+
+    A coordinator address env var counts; so does a TPU pod worker list with
+    more than one *plausible* host (dev boxes sometimes carry a
+    warning-string placeholder in TPU_WORKER_HOSTNAMES — a value with spaces
+    is not a hostname list). TPU metadata-server autodetection on real pods
+    still works by setting COORDINATOR_ADDRESS or calling with explicit
+    args; it is not attempted blindly because on non-pod machines the probe
+    can hang for minutes at import time.
+    """
+    if any(os.environ.get(k) for k in
+           ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS")):
+        return True
+    workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    hosts = [h for h in workers.split(",") if h.strip() and " " not in h.strip()]
+    return len(hosts) > 1
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def global_pipeline_mesh(n_stages: int,
+                         n_data: Optional[int] = None,
+                         *,
+                         devices: Optional[Sequence[jax.Device]] = None
+                         ) -> Mesh:
+    """A ``(stage, data)`` mesh over every process's devices.
+
+    Stage is the fastest-varying placement axis within a host/slice so the
+    stage ring's ``collective-permute`` stays on ICI; the data axis absorbs
+    the cross-host (DCN) dimension, where only gradient all-reduces travel —
+    the bandwidth-optimal split for pipeline+data parallelism.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    total = len(devices)
+    if total % n_stages:
+        raise ValueError(
+            f"{total} global devices not divisible by n_stages={n_stages}")
+    if n_data is None:
+        n_data = total // n_stages
+    if n_stages * n_data > total:
+        raise ValueError(f"mesh {n_stages}x{n_data} exceeds {total} devices")
+    # [data, stage] grid transposed so stage is contiguous per data row.
+    grid = np.asarray(devices[:n_stages * n_data]).reshape(n_data, n_stages)
+    return Mesh(grid.T, (STAGE_AXIS, DATA_AXIS))
+
+
+def host_local_batch(mesh: Mesh, local_batch: np.ndarray,
+                     batch_axis: int = 0) -> jax.Array:
+    """Assemble the global batch array from this process's local shard.
+
+    Each host loads only its slice of the batch (the data-loading contract of
+    every multi-host input pipeline); the result is a global array sharded
+    ``P(data)`` on ``batch_axis``.
+    """
+    spec = [None] * local_batch.ndim
+    spec[batch_axis] = DATA_AXIS
+    sharding = NamedSharding(mesh, P(*spec))
+    return jax.make_array_from_process_local_data(sharding, local_batch)
+
+
+def process_summary() -> str:
+    """One-line topology description for logs."""
+    return (f"process {jax.process_index()}/{jax.process_count()} | "
+            f"{jax.local_device_count()} local / "
+            f"{jax.device_count()} global devices | "
+            f"backend {jax.default_backend()}")

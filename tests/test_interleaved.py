@@ -1,0 +1,135 @@
+"""Interleaved virtual-stage pipeline tests.
+
+Transparency: the interleaved executor must match the plain (unpipelined)
+model forward and gradients exactly, for every (devices d, interleave v,
+micro-batches m >= d) combination — plus the bubble model and the
+device-major parameter permutation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.core import microbatch as mb
+from pipe_tpu.core.partition import StageCtx
+from pipe_tpu.core.schedule import InterleavedSchedule
+from pipe_tpu.ops.layers import Linear
+from pipe_tpu.parallel.interleaved import (InterleavedSpmdPipeline,
+                                           stack_interleaved_params)
+from pipe_tpu.parallel.mesh import make_mesh
+
+WIDTH = 8
+
+
+def make_stages(S, key):
+    layer = Linear(WIDTH)
+    params = [layer.init(jax.random.fold_in(key, s), jnp.zeros((1, WIDTH)))
+              for s in range(S)]
+
+    def stage_fn(p, h, ctx):
+        return jnp.tanh(layer.apply(p, h))
+
+    return stage_fn, params
+
+
+def reference(stage_fn, params, x):
+    h = x
+    for p in params:
+        h = stage_fn(p, h, StageCtx())
+    return h
+
+
+def test_stack_interleaved_layout():
+    d, v = 2, 2
+    params = [{"w": jnp.full((1,), float(s))} for s in range(d * v)]
+    stacked = stack_interleaved_params(params, d)
+    # device-major rows: device 0 -> stages (0, 2); device 1 -> (1, 3)
+    np.testing.assert_array_equal(
+        np.asarray(stacked["w"]).ravel(), [0.0, 2.0, 1.0, 3.0])
+
+
+@pytest.mark.parametrize("d,v,chunks", [(2, 2, 4), (2, 3, 2), (4, 2, 4),
+                                        (1, 4, 2), (8, 1, 8)])
+def test_forward_transparency(d, v, chunks):
+    S = d * v
+    stage_fn, params = make_stages(S, jax.random.key(0))
+    mesh = make_mesh(d, 1)
+    pipe = InterleavedSpmdPipeline(mesh, stage_fn, v=v)
+    stacked = stack_interleaved_params(params, d)
+
+    x = jax.random.normal(jax.random.key(1), (chunks * 2, WIDTH))
+    xs, bs = mb.stack_scatter(x, chunks)
+    got = mb.stack_gather(pipe(stacked, {}, {}, xs), bs)
+    exp = reference(stage_fn, params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("checkpoint", ["never", "always"])
+def test_gradient_transparency(checkpoint):
+    d, v = 2, 2
+    S = d * v
+    stage_fn, params = make_stages(S, jax.random.key(0))
+    mesh = make_mesh(d, 1)
+    pipe = InterleavedSpmdPipeline(mesh, stage_fn, v=v,
+                                   checkpoint=checkpoint)
+    stacked = stack_interleaved_params(params, d)
+
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+    xs, bs = mb.stack_scatter(x, 4)
+
+    def pipe_loss(sp):
+        return jnp.mean(mb.stack_gather(
+            pipe(sp, {}, {}, xs, train=True), bs) ** 2)
+
+    def plain_loss(ps):
+        return jnp.mean(reference(stage_fn, ps, x) ** 2)
+
+    got = jax.grad(pipe_loss)(stacked)
+    exp = stack_interleaved_params(jax.grad(plain_loss)(list(params)), d)
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(exp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_pre_post_and_data_axis():
+    d, v = 2, 2
+    stage_fn, params = make_stages(d * v, jax.random.key(0))
+    emb, dec = Linear(WIDTH), Linear(3)
+    pre_p = emb.init(jax.random.key(10), jnp.zeros((1, 5)))
+    post_p = dec.init(jax.random.key(11), jnp.zeros((1, WIDTH)))
+    mesh = make_mesh(d, 2)
+    pipe = InterleavedSpmdPipeline(
+        mesh, stage_fn, v=v,
+        pre_fn=lambda p, x, ctx: emb.apply(p, x),
+        post_fn=lambda p, h, ctx: dec.apply(p, h))
+    stacked = stack_interleaved_params(params, d)
+
+    x = jax.random.normal(jax.random.key(1), (8, 5))
+    xs, bs = mb.stack_scatter(x, 4)
+    got = mb.stack_gather(pipe(stacked, pre_p, post_p, xs), bs)
+    exp = dec.apply(post_p, reference(stage_fn, params, emb.apply(pre_p, x)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_m_less_than_d_rejected():
+    d, v = 4, 2
+    stage_fn, params = make_stages(d * v, jax.random.key(0))
+    mesh = make_mesh(d, 1)
+    pipe = InterleavedSpmdPipeline(mesh, stage_fn, v=v)
+    stacked = stack_interleaved_params(params, d)
+    x = jax.random.normal(jax.random.key(1), (4, WIDTH))
+    xs, _ = mb.stack_scatter(x, 2)  # m=2 < d=4
+    with pytest.raises(ValueError, match="micro-batches >= devices"):
+        pipe(stacked, {}, {}, xs)
+
+
+def test_bubble_improves_with_v():
+    sched = InterleavedSchedule(v=2)
+    m, d = 8, 4
+    gpipe_bubble = (d - 1) / (m + d - 1)
+    inter_bubble = sched.device_bubble(m, d)
+    assert inter_bubble < gpipe_bubble

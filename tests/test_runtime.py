@@ -1,0 +1,48 @@
+"""Multi-host runtime wrappers (single-process behavior + API contract)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.parallel.mesh import DATA_AXIS, STAGE_AXIS
+from pipe_tpu.runtime import (global_pipeline_mesh, host_local_batch,
+                              initialize, is_initialized, process_summary)
+
+
+def test_initialize_single_process_noop():
+    initialize()
+    assert is_initialized()
+    initialize()  # idempotent
+
+
+def test_global_pipeline_mesh_layout():
+    mesh = global_pipeline_mesh(4)
+    assert mesh.axis_names == (STAGE_AXIS, DATA_AXIS)
+    assert mesh.shape[STAGE_AXIS] == 4
+    assert mesh.shape[DATA_AXIS] == 2
+    # stage-contiguous: first data column is devices 0..3
+    col = mesh.devices[:, 0]
+    assert [d.id for d in col] == [0, 1, 2, 3]
+
+
+def test_global_pipeline_mesh_validation():
+    with pytest.raises(ValueError, match="not divisible"):
+        global_pipeline_mesh(3)
+    with pytest.raises(ValueError, match="exceeds"):
+        global_pipeline_mesh(4, 4)
+
+
+def test_host_local_batch_single_process():
+    mesh = global_pipeline_mesh(2)  # (stage=2, data=4)
+    local = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    arr = host_local_batch(mesh, local)
+    assert arr.shape == (8, 3)
+    np.testing.assert_array_equal(np.asarray(arr), local)
+    # sharded over data on dim 0
+    assert arr.sharding.spec[0] == DATA_AXIS
+
+
+def test_process_summary():
+    s = process_summary()
+    assert "process 0/1" in s and "8" in s
