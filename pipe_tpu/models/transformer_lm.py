@@ -47,6 +47,7 @@ class LMConfig:
     seq_len: int = 128          # bptt
     causal: bool = True
     compute_dtype: Any = jnp.float32   # set jnp.bfloat16 on TPU
+    attn_impl: str = "auto"            # auto | xla | flash (ops.layers.MHA)
 
     def tiny(self) -> "LMConfig":
         return dataclasses.replace(
@@ -79,7 +80,8 @@ def build_sequential(cfg: LMConfig) -> Sequential:
     ]
     for _ in range(cfg.n_layers):
         layers.append(TransformerEncoderLayer(
-            cfg.d_model, cfg.nhead, cfg.d_ff, cfg.dropout, causal=cfg.causal))
+            cfg.d_model, cfg.nhead, cfg.d_ff, cfg.dropout, causal=cfg.causal,
+            attn_impl=cfg.attn_impl))
     layers.append(Decoder(cfg.vocab))
     return Sequential(layers, name="transformer_lm")
 
@@ -109,7 +111,8 @@ class PipelinedLM:
         self.posenc = PositionalEncoding(
             cfg.d_model, cfg.dropout, max_len=max(5000, cfg.seq_len))
         self.block = TransformerEncoderLayer(
-            cfg.d_model, cfg.nhead, cfg.d_ff, cfg.dropout, causal=cfg.causal)
+            cfg.d_model, cfg.nhead, cfg.d_ff, cfg.dropout, causal=cfg.causal,
+            attn_impl=cfg.attn_impl)
         self.decoder = Decoder(cfg.vocab)
 
     # --- params ---

@@ -227,9 +227,12 @@ class MultiHeadAttention(Module):
     reference ``main.py:148``), batch-first: x is [batch, seq, d_model]."""
 
     def __init__(self, d_model: int, nhead: int, dropout: float = 0.0,
-                 causal: bool = True, dtype=jnp.float32, name: str = "mha"):
+                 causal: bool = True, dtype=jnp.float32, name: str = "mha",
+                 impl: str = "auto"):
         if d_model % nhead:
             raise ValueError("nhead must divide d_model")
+        if impl not in ("auto", "xla", "flash"):
+            raise ValueError(f"impl must be auto|xla|flash, got {impl!r}")
         self.d_model = d_model
         self.nhead = nhead
         self.head_dim = d_model // nhead
@@ -237,6 +240,7 @@ class MultiHeadAttention(Module):
         self.causal = causal
         self.dtype = dtype
         self.name = name
+        self.impl = impl
 
     def init(self, key, x):
         keys = jax.random.split(key, 4)
@@ -266,9 +270,26 @@ class MultiHeadAttention(Module):
         k = proj(params["wk"], params["bk"])
         v = proj(params["wv"], params["bv"])
         dk = ctx.fold(1).key if ctx.key is not None else None
-        o = dot_product_attention(q, k, v, causal=self.causal,
-                                  dropout_rate=self.dropout, dropout_key=dk,
-                                  train=ctx.train)
+        # Flash (Pallas) path when no attention-weight dropout is active and
+        # the tiling covers the sequence; the XLA path otherwise. The choice
+        # is static at trace time.
+        # Attention-weight dropout always wins: the kernel has no dropout
+        # support, so a dropout-bearing train step takes the XLA path even
+        # under impl="flash" (silently disabling regularization would be
+        # worse than the slower path).
+        dropout_active = self.dropout > 0.0 and ctx.train and dk is not None
+        use_flash = not dropout_active and (
+            self.impl == "flash"
+            or (self.impl == "auto" and jax.default_backend() == "tpu"))
+        if use_flash:
+            from .pallas_attention import flash_attention, supports
+            use_flash = supports(s)
+        if use_flash:
+            o = flash_attention(q, k, v, causal=self.causal)
+        else:
+            o = dot_product_attention(q, k, v, causal=self.causal,
+                                      dropout_rate=self.dropout,
+                                      dropout_key=dk, train=ctx.train)
         o = o.reshape(b, s, self.d_model)
         return jnp.einsum("bsd,de->bse", o, params["wo"]) + params["bo"]
 
@@ -280,8 +301,10 @@ class TransformerEncoderLayer(Module):
 
     def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
                  dropout: float = 0.0, causal: bool = True,
-                 dtype=jnp.float32, name: str = "encoder_layer"):
-        self.attn = MultiHeadAttention(d_model, nhead, dropout, causal, dtype)
+                 dtype=jnp.float32, name: str = "encoder_layer",
+                 attn_impl: str = "auto"):
+        self.attn = MultiHeadAttention(d_model, nhead, dropout, causal, dtype,
+                                       impl=attn_impl)
         self.ff1 = Linear(dim_feedforward, dtype=dtype)
         self.ff2 = Linear(d_model, dtype=dtype)
         self.ln1 = LayerNorm(dtype=dtype)
