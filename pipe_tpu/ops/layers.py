@@ -273,19 +273,21 @@ class MultiHeadAttention(Module):
         # Flash (Pallas) path when no attention-weight dropout is active and
         # the tiling covers the sequence; the XLA path otherwise. The choice
         # is static at trace time.
-        # Attention-weight dropout always wins: the kernel has no dropout
-        # support, so a dropout-bearing train step takes the XLA path even
-        # under impl="flash" (silently disabling regularization would be
-        # worse than the slower path).
+        # Flash handles attention-weight dropout only when compiled on TPU
+        # (the kernel's hardware PRNG regenerates masks in backward);
+        # interpret mode and unsupported tilings use the XLA path.
+        on_tpu = jax.default_backend() == "tpu"
         dropout_active = self.dropout > 0.0 and ctx.train and dk is not None
-        use_flash = not dropout_active and (
-            self.impl == "flash"
-            or (self.impl == "auto" and jax.default_backend() == "tpu"))
+        use_flash = (not dropout_active or on_tpu) and (
+            self.impl == "flash" or (self.impl == "auto" and on_tpu))
         if use_flash:
             from .pallas_attention import flash_attention, supports
             use_flash = supports(s)
         if use_flash:
-            o = flash_attention(q, k, v, causal=self.causal)
+            o = flash_attention(
+                q, k, v, causal=self.causal,
+                dropout_rate=self.dropout if dropout_active else 0.0,
+                dropout_key=dk if dropout_active else None)
         else:
             o = dot_product_attention(q, k, v, causal=self.causal,
                                       dropout_rate=self.dropout,

@@ -19,9 +19,12 @@ TPU-native replacement). This kernel keeps the O(s²) score matrix out of HBM:
   row statistics on ``[batch·head, 1, seq]``;
 * off-TPU the same kernels run in interpreter mode (tests stay hermetic).
 
-No attention-weight dropout inside the kernel (yet): callers route
-dropout-bearing train steps through the XLA path (``ops.layers``) and use
-this kernel for dropout-free configs and eval/inference.
+Attention-weight dropout runs *inside* the kernel on TPU (hardware PRNG
+seeded per (batch·head, q-block, k-block), so forward and backward
+regenerate identical masks without storing them; the normalizer ``l`` is
+computed pre-dropout, matching ``dropout(softmax(s)) @ v`` semantics).
+Interpret mode has no PRNG, so dropout-bearing steps off-TPU use the XLA
+path (``ops.layers``).
 """
 
 from __future__ import annotations
@@ -33,6 +36,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "supports"]
 
@@ -50,6 +54,26 @@ def supports(seq_len: int, *, block: int = 128, min_tile: int = 8) -> bool:
     return block >= seq_len or seq_len % block == 0
 
 
+def _drop_mask(seed, bh, iq, ik, shape, rate):
+    """Regenerable per-(batch*head, q-block, k-block) keep mask, scaled.
+
+    Returns keep/rate scaling factors (0 where dropped). Seeding is a pure
+    function of (seed, bh, iq, ik), so the backward kernels rebuild the
+    identical mask without storing it.
+    """
+    # One mixed scalar (multi-operand seeding miscompiles inside fori_loop
+    # on some Mosaic versions); constants are odd primes for bit dispersion.
+    mixed = (seed
+             + bh * jnp.int32(-1640531535)   # 2654435761 as int32
+             + iq * jnp.int32(40503)
+             + ik * jnp.int32(961748941))
+    pltpu.prng_seed(mixed)
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    threshold = jnp.uint32(min(int(rate * (1 << 32)), (1 << 32) - 1))
+    keep = bits >= threshold
+    return jnp.where(keep, 1.0 / (1.0 - rate), 0.0).astype(jnp.float32)
+
+
 def _causal_mask(s, q_start, k_start, bq, bk):
     qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -60,9 +84,10 @@ def _causal_mask(s, q_start, k_start, bq, bk):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
-                causal, scale):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
+                seq_len, causal, scale, dropout_rate):
     bq, d = q_ref.shape[1], q_ref.shape[2]
+    bh = pl.program_id(0)
     iq = pl.program_id(1)
     q = q_ref[0, :, :] * scale                           # [bq, d]
     q_start = iq * bq
@@ -89,7 +114,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
         p = jnp.exp(s - safe_m[:, None])
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
-        l = l * corr + jnp.sum(p, axis=-1)
+        l = l * corr + jnp.sum(p, axis=-1)   # normalizer: pre-dropout
+        if dropout_rate > 0.0:
+            p = p * _drop_mask(seed_ref[0], bh, iq, ik, p.shape,
+                               dropout_rate)
         o = o * corr[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -102,16 +130,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
                         jnp.log(jnp.maximum(l, 1e-30)))
 
 
-def _fwd(q3, k3, v3, causal, scale, bq, bk, interpret):
+def _smem_scalar_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _fwd(q3, k3, v3, seed, causal, scale, bq, bk, interpret, dropout_rate):
     bh, s, d = q3.shape
     grid = (bh, s // bq)
     qspec = pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0))
     kvspec = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_k=bk, seq_len=s, causal=causal,
-                          scale=scale),
+                          scale=scale, dropout_rate=dropout_rate),
         grid=grid,
-        in_specs=[qspec, kvspec, kvspec],
+        in_specs=[_smem_scalar_spec(), qspec, kvspec, kvspec],
         out_specs=[qspec,
                    pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j))],
         out_shape=[
@@ -119,7 +151,7 @@ def _fwd(q3, k3, v3, causal, scale, bq, bk, interpret):
             jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(seed, q3, k3, v3)
     return o, lse
 
 
@@ -127,9 +159,11 @@ def _fwd(q3, k3, v3, causal, scale, bq, bk, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_k, seq_len, causal, scale):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, block_k, seq_len, causal, scale,
+                   dropout_rate):
     bq, d = q_ref.shape[1], q_ref.shape[2]
+    bh = pl.program_id(0)
     iq = pl.program_id(1)
     q_start = iq * bq
     q = q_ref[0, :, :] * scale
@@ -152,6 +186,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dp = dp * _drop_mask(seed_ref[0], bh, iq, ik, dp.shape,
+                                 dropout_rate)
         ds = p * (dp - delta[:, None])
         return dq + jax.lax.dot_general(
             ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -161,9 +198,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0, :, :] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q, seq_len, causal, scale):
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, block_q, seq_len, causal,
+                    scale, dropout_rate):
     bk, d = k_ref.shape[1], k_ref.shape[2]
+    bh = pl.program_id(0)
     ik = pl.program_id(1)
     k_start = ik * bk
     k = k_ref[0, :, :]
@@ -184,12 +223,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = _causal_mask(s, iq * block_q, k_start, block_q, bk)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(jnp.isfinite(s), p, 0.0)
+        if dropout_rate > 0.0:
+            mask = _drop_mask(seed_ref[0], bh, iq, ik, p.shape, dropout_rate)
+            p_v = p * mask
+        else:
+            mask = None
+            p_v = p
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_v, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if mask is not None:
+            dp = dp * mask
         ds = p * (dp - delta[:, None])
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -203,8 +250,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, :, :] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, bq, bk, interpret, residuals, g):
-    q3, k3, v3, o3, lse = residuals
+def _bwd(causal, scale, bq, bk, interpret, dropout_rate, residuals, g):
+    q3, k3, v3, seed, o3, lse = residuals
     do3 = g
     bh, s, d = q3.shape
     delta = jnp.einsum("bsd,bsd->bs", do3.astype(jnp.float32),
@@ -217,26 +264,30 @@ def _bwd(causal, scale, bq, bk, interpret, residuals, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=bk, seq_len=s,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale,
+                          dropout_rate=dropout_rate),
         grid=(bh, s // bq),
-        in_specs=[qspec, full, full, qspec, row_q, row_q],
+        in_specs=[_smem_scalar_spec(), qspec, full, full, qspec, row_q,
+                  row_q],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(seed, q3, k3, v3, do3, lse, delta)
 
     kspec = pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, seq_len=s,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale,
+                          dropout_rate=dropout_rate),
         grid=(bh, s // bk),
-        in_specs=[full, kspec, kspec, full, row_full, row_full],
+        in_specs=[_smem_scalar_spec(), full, kspec, kspec, full, row_full,
+                  row_full],
         out_specs=[kspec, kspec],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v3.dtype)],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
-    return dq, dk, dv
+    )(seed, q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv, None
 
 
 # ---------------------------------------------------------------------------
@@ -244,30 +295,41 @@ def _bwd(causal, scale, bq, bk, interpret, residuals, g):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _make(causal: bool, scale: float, bq: int, bk: int, interpret: bool):
+def _make(causal: bool, scale: float, bq: int, bk: int, interpret: bool,
+          dropout_rate: float):
     @jax.custom_vjp
-    def attend(q3, k3, v3):
-        o, _ = _fwd(q3, k3, v3, causal, scale, bq, bk, interpret)
+    def attend(q3, k3, v3, seed):
+        o, _ = _fwd(q3, k3, v3, seed, causal, scale, bq, bk, interpret,
+                    dropout_rate)
         return o
 
-    def fwd(q3, k3, v3):
-        o, lse = _fwd(q3, k3, v3, causal, scale, bq, bk, interpret)
-        return o, (q3, k3, v3, o, lse)
+    def fwd(q3, k3, v3, seed):
+        o, lse = _fwd(q3, k3, v3, seed, causal, scale, bq, bk, interpret,
+                      dropout_rate)
+        return o, (q3, k3, v3, seed, o, lse)
 
     attend.defvjp(fwd, functools.partial(_bwd, causal, scale, bq, bk,
-                                         interpret))
+                                         interpret, dropout_rate))
     return attend
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
+                    dropout_rate: float = 0.0,
+                    dropout_key: Optional[jax.Array] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Flash attention over ``[batch, seq, heads, head_dim]`` inputs.
 
     ``interpret`` defaults to True off-TPU (tests/dev boxes) and False on
     TPU. Raises for shapes the tiling cannot cover — gate with
     :func:`supports` and fall back to the XLA path.
+
+    ``dropout_rate`` > 0 applies attention-weight dropout *inside* the
+    kernel (TPU hardware PRNG; masks are a pure function of
+    ``dropout_key`` and block indices, so the backward kernels regenerate
+    them bit-identically). Only available compiled on TPU — interpret mode
+    has no PRNG — so callers must keep dropout off the interpret path.
     """
     b, s, h, d = q.shape
     if not supports(s, block=min(block_q, block_k)):
@@ -276,6 +338,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             f"use ops.layers.dot_product_attention")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    if dropout_rate > 0.0:
+        if interpret:
+            raise NotImplementedError(
+                "flash_attention dropout needs the TPU PRNG; interpret "
+                "mode must use ops.layers.dot_product_attention")
+        if dropout_key is None:
+            raise ValueError("dropout_rate > 0 requires dropout_key")
+        kd = jax.random.key_data(dropout_key).astype(jnp.uint32).ravel()
+        seed = (kd[0] ^ kd[-1]).astype(jnp.int32).reshape((1,))
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
     scale = float(scale if scale is not None else 1.0 / math.sqrt(d))
     bq = min(block_q, s)
     bk = min(block_k, s)
@@ -283,5 +358,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     def to3(x):  # [b, s, h, d] -> [b*h, s, d]
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    o3 = _make(causal, scale, bq, bk, bool(interpret))(to3(q), to3(k), to3(v))
+    o3 = _make(causal, scale, bq, bk, bool(interpret),
+               float(dropout_rate))(to3(q), to3(k), to3(v), seed)
     return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)

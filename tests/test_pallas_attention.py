@@ -90,3 +90,106 @@ def test_matches_layers_attention():
     exp = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_dropout_requires_tpu_in_interpret_mode():
+    q, k, v = qkv(jax.random.key(6), s=32)
+    with pytest.raises(NotImplementedError, match="TPU PRNG"):
+        flash_attention(q, k, v, dropout_rate=0.2,
+                        dropout_key=jax.random.key(0), interpret=True)
+    with pytest.raises(ValueError, match="requires dropout_key"):
+        flash_attention(q, k, v, dropout_rate=0.2, interpret=False)
+
+
+def test_mha_dropout_routes_xla_on_cpu():
+    """On CPU, a dropout-bearing train step must use the XLA path (flash
+    interpret mode has no PRNG) — this exercises the routing, not numerics."""
+    from pipe_tpu.core.partition import StageCtx
+    from pipe_tpu.ops.layers import MultiHeadAttention
+    x = jax.random.normal(jax.random.key(0), (2, 32, 64))
+    mha = MultiHeadAttention(64, 4, dropout=0.5, impl="flash")
+    p = mha.init(jax.random.key(1), x)
+    ctx = StageCtx(key=jax.random.key(2), train=True)
+    out = mha.apply(p, x, ctx=ctx)  # would raise if routed to flash interpret
+    assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_dropout_vjp_algebra_with_stub_mask(monkeypatch, causal):
+    """Full dropout fwd+bwd algebra on CPU via a deterministic mask stub.
+
+    Replaces the TPU PRNG mask with a pure jnp function of
+    (seed, bh, iq, ik), reconstructs the identical full-matrix mask for an
+    XLA oracle `(softmax(s) [causal-masked]) * mask @ v`, and checks forward
+    and all three gradients — covering the seeding consistency of the three
+    kernels and the pre-dropout-normalizer gradient algebra that only ever
+    runs compiled on TPU.
+    """
+    import math as _math
+
+    from pipe_tpu.ops import pallas_attention as pa
+
+    rate = 0.3
+
+    def fake_mask(seed, bh, iq, ik, shape, r):
+        a = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        b = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        z = (a * 7 + b * 13 + bh * 31 + iq * 17 + ik * 11 + seed) % 10
+        keep = z >= jnp.int32(r * 10)
+        return jnp.where(keep, 1.0 / (1.0 - r), 0.0).astype(jnp.float32)
+
+    monkeypatch.setattr(pa, "_drop_mask", fake_mask)
+    pa._make.cache_clear()
+
+    b, s, h, d = 1, 32, 2, 8
+    bq = bk = 16
+    key = jax.random.key(0)
+    q, k, v = qkv(key, b=b, s=s, h=h, d=d)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    scale = 1.0 / _math.sqrt(d)
+    attend = pa._make(causal, scale, bq, bk, True, rate)
+    seed0 = jnp.zeros((1,), jnp.int32)
+
+    # oracle: assemble the identical full mask per (bh, q-block, k-block)
+    mask_full = np.zeros((b * h, s, s), np.float32)
+    for bh_i in range(b * h):
+        for iq in range(s // bq):
+            for ik in range(s // bk):
+                blk = fake_mask(0, bh_i, iq, ik, (bq, bk), rate)
+                mask_full[bh_i, iq * bq:(iq + 1) * bq,
+                          ik * bk:(ik + 1) * bk] = np.asarray(blk)
+    mask_full = jnp.asarray(mask_full)
+
+    def oracle(q3, k3, v3):
+        sc = jnp.einsum("zqd,zkd->zqk", q3, k3) * scale
+        if causal:
+            cm = jnp.tril(jnp.ones((s, s), bool))
+            sc = jnp.where(cm, sc, -jnp.inf)
+        w = jax.nn.softmax(sc, axis=-1)
+        return jnp.einsum("zqk,zkd->zqd", w * mask_full, v3)
+
+    q3, k3, v3 = to3(q), to3(k), to3(v)
+    got = attend(q3, k3, v3, seed0)
+    exp = oracle(q3, k3, v3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                               rtol=2e-5, atol=2e-6)
+
+    g_got = jax.grad(lambda q3, k3, v3: jnp.sum(
+        attend(q3, k3, v3, seed0) ** 2), argnums=(0, 1, 2))(q3, k3, v3)
+    g_exp = jax.grad(lambda q3, k3, v3: jnp.sum(
+        oracle(q3, k3, v3) ** 2), argnums=(0, 1, 2))(q3, k3, v3)
+    for a, e in zip(g_got, g_exp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=5e-4, atol=1e-5)
+    pa._make.cache_clear()
+
+
+def test_dropout_rate_validation():
+    q, k, v = qkv(jax.random.key(8), s=16)
+    for bad in (1.0, 1.5, -0.1):
+        with pytest.raises(ValueError, match="dropout_rate"):
+            flash_attention(q, k, v, dropout_rate=bad,
+                            dropout_key=jax.random.key(0))
