@@ -1,0 +1,160 @@
+"""Long-context Transformer LM: pipeline stages × ring-attention context shards.
+
+The composition the task calls first-class and the reference lacks entirely
+(SURVEY §5 "Long-context / sequence parallelism": absent, seq len is a plain
+dim): the sequence axis is sharded over a ``context`` mesh axis *inside*
+every pipeline stage, so one model trains with
+
+* **PP** over ``stage`` (the ppermute activation ring between stages), and
+* **CP** over ``context`` (the ppermute K/V ring *within* each stage's
+  attention, ``ops.ring_attention``) —
+
+two nested ICI rings in one compiled program. Peak per-chip sequence memory
+drops by the context factor while the math stays exactly softmax attention
+(ring parity tests), so sequences far beyond one chip's HBM train without
+approximation.
+
+Usage mirrors :class:`~pipe_tpu.models.transformer_lm.PipelinedLM`, with a
+``(stage, data, context)`` mesh (``make_mesh(n_stages, n_data,
+n_context=...)``) and ``SpmdPipeline(context_axis="context")`` so input
+token/target leaves arrive sequence-sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.partition import StageCtx
+from ..ops.ring_attention import ring_attention
+from ..parallel.mesh import CONTEXT_AXIS
+from .transformer_lm import LMConfig
+
+__all__ = ["ContextParallelLM"]
+
+
+def _axis_index_or_zero(axis: str):
+    """axis_index, or 0 when no mesh axis is bound.
+
+    SpmdPipeline computes output *specs* by eval_shape outside shard_map;
+    only shapes matter there, so the shard offset can be anything.
+    """
+    try:
+        return jax.lax.axis_index(axis)
+    except NameError:
+        return jnp.int32(0)
+
+
+def _pmean_or_identity(x, axis: str):
+    try:
+        return jax.lax.pmean(x, axis)
+    except NameError:
+        return x
+
+
+class ContextParallelLM:
+    """Embed | k ring-attention blocks per stage | loss, all context-sharded.
+
+    Functions run under ``shard_map`` with ``stage``/``data``/``context``
+    axes bound. Activations are ``[rows, seq_local, d_model]``; attention is
+    exact over the *global* sequence via the context ring; the loss pmean's
+    over context so every shard returns the identical per-row value.
+    """
+
+    def __init__(self, cfg: LMConfig, n_stages: int):
+        if cfg.n_layers % n_stages:
+            raise ValueError(f"n_layers={cfg.n_layers} must divide into "
+                             f"n_stages={n_stages}")
+        self.cfg = cfg
+        self.n_stages = n_stages
+        self.layers_per_stage = cfg.n_layers // n_stages
+
+    # --- params (reuse the standard LM's structure) ---
+
+    def init(self, key: jax.Array):
+        from .transformer_lm import PipelinedLM
+        return PipelinedLM(self.cfg, self.n_stages).init(key)
+
+    # --- pieces ---
+
+    def _posenc(self, h, seq_offset):
+        d = self.cfg.d_model
+        pos = (seq_offset
+               + jnp.arange(h.shape[-2], dtype=jnp.float32))[:, None]
+        div = jnp.exp(jnp.arange(0, d, 2, dtype=jnp.float32)
+                      * (-jnp.log(10000.0) / d))
+        angles = pos * div[None, :]
+        pe = jnp.zeros((h.shape[-2], d), jnp.float32)
+        pe = pe.at[:, 0::2].set(jnp.sin(angles))
+        pe = pe.at[:, 1::2].set(jnp.cos(angles))
+        return h + pe.astype(h.dtype)
+
+    def pre_fn(self, pre_params, x_mb, ctx: StageCtx):
+        tokens = x_mb["tokens"] if isinstance(x_mb, dict) else x_mb
+        table = pre_params["embed"]["table"]
+        h = jnp.take(table, tokens, axis=0)
+        h = h * jnp.asarray(jnp.sqrt(jnp.float32(self.cfg.d_model)), h.dtype)
+        # global positions: offset by this context shard's start
+        seq_local = tokens.shape[-1]
+        offset = _axis_index_or_zero(CONTEXT_AXIS) * seq_local
+        h = self._posenc(h, offset.astype(jnp.float32))
+        return h.astype(self.cfg.compute_dtype)
+
+    def _block(self, bp, h, ctx: StageCtx):
+        """One transformer block with ring attention over the context axis.
+
+        Same math as ``ops.layers.TransformerEncoderLayer`` (post-LN, ReLU
+        FFN) with the attention swapped for the context ring; dropout is
+        omitted on this long-context path (rate 0 configs) to keep the ring
+        exact.
+        """
+        cfg = self.cfg
+        rows, s_local, d = h.shape
+        hd = d // cfg.nhead
+
+        def proj(w, b):
+            return (jnp.einsum("bsd,de->bse", h, w) + b).reshape(
+                rows, s_local, cfg.nhead, hd)
+
+        a = ring_attention(
+            proj(bp["attn"]["wq"], bp["attn"]["bq"]),
+            proj(bp["attn"]["wk"], bp["attn"]["bk"]),
+            proj(bp["attn"]["wv"], bp["attn"]["bv"]),
+            CONTEXT_AXIS, causal=cfg.causal)
+        a = a.reshape(rows, s_local, d)
+        a = jnp.einsum("bsd,de->bse", a, bp["attn"]["wo"]) + bp["attn"]["bo"]
+
+        def ln(p, x):
+            mu = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.var(x, axis=-1, keepdims=True)
+            return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
+
+        x = ln(bp["ln1"], h + a)
+        f = jax.nn.relu(jnp.einsum("bsd,do->bso", x, bp["ff1"]["w"])
+                        + bp["ff1"]["b"])
+        f = jnp.einsum("bso,od->bsd", f, bp["ff2"]["w"]) + bp["ff2"]["b"]
+        return ln(bp["ln2"], x + f)
+
+    def stage_fn(self, blocks, h, ctx: StageCtx):
+        cd = self.cfg.compute_dtype
+        for l, bp in enumerate(blocks):
+            bp = jax.tree_util.tree_map(lambda p: p.astype(cd), bp)
+            h = self._block(bp, h, ctx.fold(l))
+        return h
+
+    def loss_post_fn(self, post_params, h, x_mb, ctx: StageCtx):
+        """Per-row mean token CE over the GLOBAL sequence (pmean'd)."""
+        w = post_params["decoder"]["w"]
+        b = post_params["decoder"]["b"]
+        logits = (jnp.einsum("bsd,dv->bsv", h.astype(jnp.float32), w)
+                  + b).astype(jnp.float32)
+        targets = x_mb["targets"]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        local_mean = jnp.mean(logz - gold, axis=-1)          # [rows]
+        return _pmean_or_identity(local_mean, CONTEXT_AXIS)  # global mean
+
+    def num_params(self, params_tuple) -> int:
+        return sum(int(p.size) for p in jax.tree_util.tree_leaves(params_tuple))

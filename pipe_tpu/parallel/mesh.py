@@ -17,35 +17,45 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "STAGE_AXIS", "DATA_AXIS"]
+__all__ = ["make_mesh", "STAGE_AXIS", "DATA_AXIS", "CONTEXT_AXIS"]
 
 STAGE_AXIS = "stage"
 DATA_AXIS = "data"
+CONTEXT_AXIS = "context"
 
 
 def make_mesh(n_stages: int,
               n_data: Optional[int] = None,
               *,
+              n_context: Optional[int] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a ``(stage[, data])`` mesh.
+    """Build a ``(stage[, data][, context])`` mesh.
 
     With ``n_data=None`` the data axis is sized to use all remaining devices
-    (``len(devices) // n_stages``); pass ``n_data=1`` for a pure pipeline mesh.
-    Stage is the *outer* axis so consecutive stages land on ICI-adjacent
-    devices in the common case.
+    (``len(devices) // (n_stages * n_context)``); pass ``n_data=1`` for a
+    pure pipeline mesh. Stage is the *outer* axis so consecutive stages land
+    on ICI-adjacent devices in the common case; the context axis (sequence
+    parallelism) is innermost so its K/V ring also stays ICI-local.
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_stages <= 0:
         raise ValueError("n_stages must be positive")
-    if len(devices) % n_stages:
+    if n_context is not None and n_context <= 0:
+        raise ValueError("n_context must be positive (or None for no axis)")
+    ctx = n_context or 1
+    if len(devices) % (n_stages * ctx):
         raise ValueError(
-            f"{len(devices)} devices not divisible by n_stages={n_stages}")
+            f"{len(devices)} devices not divisible by "
+            f"n_stages*n_context={n_stages * ctx}")
     if n_data is None:
-        n_data = len(devices) // n_stages
-    used = n_stages * n_data
+        n_data = len(devices) // (n_stages * ctx)
+    used = n_stages * n_data * ctx
     if used > len(devices):
         raise ValueError(
-            f"mesh {n_stages}x{n_data} needs {used} devices, "
+            f"mesh {n_stages}x{n_data}x{ctx} needs {used} devices, "
             f"have {len(devices)}")
-    grid = np.asarray(devices[:used]).reshape(n_stages, n_data)
-    return Mesh(grid, (STAGE_AXIS, DATA_AXIS))
+    if n_context is None:
+        grid = np.asarray(devices[:used]).reshape(n_stages, n_data)
+        return Mesh(grid, (STAGE_AXIS, DATA_AXIS))
+    grid = np.asarray(devices[:used]).reshape(n_stages, n_data, ctx)
+    return Mesh(grid, (STAGE_AXIS, DATA_AXIS, CONTEXT_AXIS))

@@ -91,11 +91,25 @@ class SpmdPipeline:
     post_with_batch: bool = False
     checkpoint: str = "never"
     remat_policy: Any = None
+    # Context (sequence) parallelism: name of a mesh axis over which dim
+    # ``context_dim`` of every input leaf with enough rank is sharded. Stage
+    # bodies then see local sequence shards and use ring collectives
+    # (ops.ring_attention) over that axis — PP x CP composition.
+    # CONTRACT: with context_axis set, ``post_fn``'s output MUST be
+    # context-invariant (reduce over the axis, e.g. ``lax.pmean`` like
+    # ContextParallelLM.loss_post_fn) — out_specs assemble assuming context
+    # replication and vma checking is off, so a still-sharded output (e.g.
+    # raw per-token logits) would silently return one shard's values.
+    context_axis: Optional[str] = None
+    context_dim: int = 2
 
     def __post_init__(self):
         validate_mode(self.checkpoint)
         if STAGE_AXIS not in self.mesh.axis_names:
             raise ValueError(f"mesh must have a {STAGE_AXIS!r} axis")
+        if self.context_axis and self.context_axis not in self.mesh.axis_names:
+            raise ValueError(
+                f"mesh has no {self.context_axis!r} axis for context_axis")
         self.n_stages = self.mesh.shape[STAGE_AXIS]
         self.has_data_axis = DATA_AXIS in self.mesh.axis_names
         self._pre = self.pre_fn or _identity
@@ -137,13 +151,19 @@ class SpmdPipeline:
             lambda p, h, a: self._post(p, h, a, ctx0),
             post_params, h_spec, x_mb_spec)
 
+        def x_spec(l):
+            # [m, mb_rows, (seq,) ...]: rows sharded over data; with context
+            # parallelism, dim ``context_dim`` also sharded over context.
+            spec = [None, data] + [None] * (l.ndim - 2)
+            if self.context_axis and l.ndim > self.context_dim:
+                spec[self.context_dim] = self.context_axis
+            return P(*spec)
+
         in_specs = (
             jax.tree_util.tree_map(lambda _: P(STAGE_AXIS), stage_params),
             jax.tree_util.tree_map(lambda _: P(), pre_params),
             jax.tree_util.tree_map(lambda _: P(), post_params),
-            # x leaves: [m, mb_rows, ...] — micro-batch rows sharded over data
-            jax.tree_util.tree_map(
-                lambda l: P(*([None, data] + [None] * (l.ndim - 2))), x),
+            jax.tree_util.tree_map(x_spec, x),
             P(),                          # key
         )
         # result leaves: [stage, m, mb_rows_out, ...]

@@ -47,6 +47,8 @@ class TrainerConfig:
     lr_gamma: float = 0.95     # StepLR(1.0, gamma=0.95), main.py:185
     grad_clip: float = 0.5     # main.py:219
     seed: int = 1234
+    schedule: str = "gpipe"    # gpipe | interleaved
+    interleave: int = 2        # virtual stages per device (interleaved only)
 
 
 class Trainer:
@@ -57,11 +59,31 @@ class Trainer:
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.mesh = make_mesh(cfg.n_stages, cfg.n_data, devices=devices)
-        self.model = PipelinedLM(model_cfg, cfg.n_stages)
-        self.pipe = SpmdPipeline(
-            self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
-            post_fn=self.model.loss_post_fn, post_with_batch=True,
-            checkpoint=cfg.checkpoint)
+        if cfg.schedule == "interleaved":
+            # n_stages devices, each hosting `interleave` virtual stages:
+            # the model factors into n_stages*interleave stage bodies.
+            from ..parallel.interleaved import InterleavedSpmdPipeline
+            self.n_virtual = cfg.n_stages * cfg.interleave
+            self.model = PipelinedLM(model_cfg, self.n_virtual)
+            self.pipe = InterleavedSpmdPipeline(
+                self.mesh, self.model.stage_fn, v=cfg.interleave,
+                pre_fn=self.model.pre_fn, post_fn=self.model.loss_post_fn,
+                post_with_batch=True, checkpoint=cfg.checkpoint)
+        elif cfg.schedule == "1f1b":
+            raise ValueError(
+                "schedule='1f1b' is not a distinct compiled executor: the "
+                "compiled path realizes 1F1B's forward order as GPipe "
+                "fill-drain (see core.schedule.OneFOneBSchedule); use "
+                "'gpipe', or 'interleaved' for the bubble reduction")
+        elif cfg.schedule == "gpipe":
+            self.n_virtual = cfg.n_stages
+            self.model = PipelinedLM(model_cfg, cfg.n_stages)
+            self.pipe = SpmdPipeline(
+                self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
+                post_fn=self.model.loss_post_fn, post_with_batch=True,
+                checkpoint=cfg.checkpoint)
+        else:
+            raise ValueError(f"unknown schedule {cfg.schedule!r}")
         self.eval_pipe = dataclasses.replace(self.pipe, checkpoint="never") \
             if cfg.checkpoint != "never" else self.pipe
 
@@ -80,7 +102,12 @@ class Trainer:
     def init_state(self, key: Optional[jax.Array] = None) -> TrainState:
         key = key if key is not None else jax.random.key(self.cfg.seed)
         sp, prep, postp = self.model.init(key)
-        params = self._place((stack_stage_params(sp), prep, postp))
+        if self.cfg.schedule == "interleaved":
+            from ..parallel.interleaved import stack_interleaved_params
+            stacked = stack_interleaved_params(sp, self.cfg.n_stages)
+        else:
+            stacked = stack_stage_params(sp)
+        params = self._place((stacked, prep, postp))
         # tx.init's zeros_like inherits the placement; freshly-created leaves
         # (adam's count, the step counter) get replicated explicitly. Every
         # leaf then carries a mesh sharding — required both for checkpoint
@@ -120,6 +147,14 @@ class Trainer:
     def num_params(self, state: TrainState) -> int:
         return sum(int(a.size) for a in jax.tree_util.tree_leaves(
             state.params))
+
+    def analytic_bubble(self) -> float:
+        cfg = self.cfg
+        if cfg.schedule == "interleaved":
+            from ..core.schedule import InterleavedSchedule
+            return InterleavedSchedule(v=cfg.interleave).device_bubble(
+                cfg.chunks, cfg.n_stages)
+        return bubble_fraction(cfg.chunks, cfg.n_stages)
 
     # --- steps ---
 
@@ -183,7 +218,7 @@ class Trainer:
                        f"| ms/batch {dt*1000:.1f} "
                        f"| tok/s {tokens_per_step/dt:,.0f} "
                        f"| loss {l:.3f} | ppl {np.exp(min(l, 20.0)):.2f} "
-                       f"| bubble {bubble_fraction(cfg.chunks, cfg.n_stages):.1%}")
+                       f"| bubble {self.analytic_bubble():.1%}")
         final = float(losses[-1]) if losses else float("nan")
         return state, {"loss": final,
                        "steps": len(losses),

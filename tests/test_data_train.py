@@ -137,3 +137,20 @@ def test_steplr_decays_per_epoch(corpus):
     # log prints lr with 3 decimals; compare at that resolution
     assert lr0 == pytest.approx(cfg.lr, abs=5e-4)
     assert lr3 == pytest.approx(cfg.lr * cfg.lr_gamma ** 3, abs=5e-4)
+
+
+def test_interleaved_trainer(corpus):
+    """Trainer with the interleaved schedule trains and resumes."""
+    source, _ = corpus
+    model_cfg = dataclasses.replace(LMConfig().tiny(), n_layers=4)
+    cfg = TrainerConfig(batch_size=8, eval_batch_size=8,
+                        bptt=model_cfg.seq_len, chunks=2, n_stages=2,
+                        n_data=1, lr=1e-2, schedule="interleaved",
+                        interleave=2)
+    trainer = Trainer(model_cfg, cfg)
+    assert trainer.n_virtual == 4
+    assert trainer.analytic_bubble() < 1 / 3  # better than gpipe's (n-1)/(m+n-1)
+    state, m = trainer.train_epoch(source, max_steps=8, log_every=0)
+    assert m["loss"] < np.log(model_cfg.vocab)
+    l_eval = trainer.evaluate(source, state, max_steps=2)
+    assert np.isfinite(l_eval)
