@@ -8,11 +8,15 @@ Adam).
 
 Prints ONE JSON line:
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
-``vs_baseline`` is pipelined throughput / plain (unpipelined) single-chip
-throughput of the identical model and step — i.e. how much the pipeline
-machinery costs (or saves) against the no-framework ideal; >= 1.0 means the
-pipeline adds no overhead. The reference publishes no numbers (BASELINE.md),
-so the baseline must be measured, not copied.
+``vs_baseline`` is pipelined throughput / plain single-chip throughput of
+the identical computation: the plain step processes the same ``CHUNKS``
+micro-batches by gradient accumulation (what a single-device user runs when
+the full batch does not fit) with the same per-stage remat — so the ratio
+isolates the pipeline *machinery* cost at equal matmul granularity; >= 1.0
+means the machinery adds no overhead. ``vs_fullbatch`` (extra key) compares
+against one full-batch step instead (granularity difference included). The
+reference publishes no numbers (BASELINE.md), so baselines are measured,
+not copied.
 """
 
 from __future__ import annotations
@@ -33,8 +37,8 @@ from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
 from pipe_tpu.parallel.mesh import make_mesh
 from pipe_tpu.parallel.spmd import SpmdPipeline, stack_stage_params
 
-CHUNKS = 4
-BATCH = 32
+CHUNKS = int(os.environ.get("BENCH_CHUNKS", "4"))
+BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 # `python main.py except_last` parity: at 520M params the no-remat config
 # does not fit one 16G chip (the reference used 2 larger GPUs), so remat is
 # the realistic headline mode; override with BENCH_CHECKPOINT=never etc.
@@ -64,8 +68,13 @@ def make_step(model, spmd, tx):
     return jax.jit(train_step, donate_argnums=(0, 1))
 
 
-def make_plain_step(model, tx):
-    """The unpipelined ideal: same model, same step, no pipeline machinery."""
+def make_plain_step(model, tx, microbatches: int = 1):
+    """The unpipelined ideal: same model and remat, no pipeline machinery.
+
+    ``microbatches > 1`` processes the batch as that many gradient-
+    accumulation steps — the single-device equivalent of the pipeline's
+    micro-batching, with identical matmul shapes.
+    """
 
     def forward(params, tokens, targets, key):
         from pipe_tpu.core.partition import StageCtx
@@ -84,8 +93,29 @@ def make_plain_step(model, tx):
                                      ctx.fold(99))
         return jnp.mean(per_row)
 
+    grad_fn = jax.value_and_grad(forward)
+
     def train_step(params, opt_state, tokens, targets, key):
-        loss, grads = jax.value_and_grad(forward)(params, tokens, targets, key)
+        if microbatches == 1:
+            loss, grads = grad_fn(params, tokens, targets, key)
+        else:
+            mb_tok = tokens.reshape(microbatches, -1, tokens.shape[-1])
+            mb_tgt = targets.reshape(microbatches, -1, targets.shape[-1])
+
+            def acc(carry, inp):
+                g_sum, l_sum = carry
+                t, tg, i = inp
+                l, g = grad_fn(params, t, tg, jax.random.fold_in(key, i))
+                return (jax.tree_util.tree_map(jnp.add, g_sum, g),
+                        l_sum + l), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (grads, l_sum), _ = jax.lax.scan(
+                acc, (zeros, 0.0),
+                (mb_tok, mb_tgt, jnp.arange(microbatches)))
+            grads = jax.tree_util.tree_map(
+                lambda g: g / microbatches, grads)
+            loss = l_sum / microbatches
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
@@ -147,21 +177,32 @@ def main():
     tokens_per_step = BATCH * cfg.seq_len
     pipe_tps_chip = tokens_per_step / sec_per_step / n_stages
 
+    vs_baseline = vs_fullbatch = 0.0
     try:
-        plain = make_plain_step(model, tx)
-        plain_sec, _ = time_steps(
-            plain, plain_params, tx.init(plain_params), (tokens, targets, key))
-        plain_tps_chip = tokens_per_step / plain_sec  # single chip
-        vs_baseline = pipe_tps_chip / plain_tps_chip
+        plain_acc = make_plain_step(model, tx, microbatches=CHUNKS)
+        acc_params = jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True), plain_params)
+        acc_sec, _ = time_steps(
+            plain_acc, acc_params, tx.init(acc_params),
+            (tokens, targets, key))
+        vs_baseline = pipe_tps_chip / (tokens_per_step / acc_sec)
+        if CHUNKS > 1:
+            plain = make_plain_step(model, tx)
+            plain_sec, _ = time_steps(
+                plain, plain_params, tx.init(plain_params),
+                (tokens, targets, key))
+            vs_fullbatch = pipe_tps_chip / (tokens_per_step / plain_sec)
+        else:
+            vs_fullbatch = vs_baseline
     except Exception as e:  # baseline OOM etc. — report pipeline number alone
         print(f"plain baseline failed: {e}", file=sys.stderr)
-        vs_baseline = 0.0
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(pipe_tps_chip, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs_baseline, 4),
+        "vs_fullbatch": round(vs_fullbatch, 4),
         "platform": platform,
         "n_stages": n_stages,
         "chunks": CHUNKS,

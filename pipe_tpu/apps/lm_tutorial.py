@@ -30,6 +30,13 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--stages", type=int, default=2)
     p.add_argument("--chunks", type=int, default=4)
+    p.add_argument("--schedule", choices=["gpipe", "interleaved"],
+                   default="gpipe")
+    p.add_argument("--lr", type=float, default=None,
+                   help="override the reference's Adam lr=5.0 (main.py:183), "
+                        "which diverges at full scale; try 1e-4")
+    p.add_argument("--interleave", type=int, default=2,
+                   help="virtual stages per device (interleaved schedule)")
     p.add_argument("--tiny", action="store_true",
                    help="tiny model config (CI / CPU-sized)")
     p.add_argument("--profile", default=None,
@@ -67,10 +74,16 @@ def main(argv=None) -> int:
             model_cfg.tiny(), vocab=max(len(vocab), 2),
             n_layers=2 * args.stages)
     cfg = TrainerConfig(chunks=args.chunks, checkpoint=args.checkpoint,
-                        n_stages=args.stages)
+                        n_stages=args.stages, schedule=args.schedule,
+                        interleave=args.interleave)
     if args.tiny:
         cfg = dataclasses.replace(cfg, batch_size=8, eval_batch_size=8,
                                   bptt=model_cfg.seq_len, lr=1e-3)
+    if args.lr is not None:  # explicit --lr beats the tiny default
+        cfg = dataclasses.replace(cfg, lr=args.lr)
+    if args.schedule == "interleaved" and args.tiny:
+        model_cfg = dataclasses.replace(
+            model_cfg, n_layers=args.stages * args.interleave)
 
     train_data = lm_text.batchify(train_ids, cfg.batch_size)
     val_data = lm_text.batchify(val_ids, cfg.eval_batch_size)

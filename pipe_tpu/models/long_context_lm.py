@@ -70,6 +70,18 @@ class ContextParallelLM:
         self.cfg = cfg
         self.n_stages = n_stages
         self.layers_per_stage = cfg.n_layers // n_stages
+        # Build sublayers (and especially PositionalEncoding's constant
+        # table) EAGERLY: creating them lazily inside a traced function
+        # would turn the table into a jit tracer that cannot cross into
+        # shard_map bodies.
+        from ..ops import layers as L
+        self._layers_cache = dict(
+            embed=L.Embedding(cfg.vocab, cfg.d_model, scale=True),
+            posenc=L.PositionalEncoding(cfg.d_model, 0.0,
+                                        max_len=max(5000, cfg.seq_len)),
+            ff1=L.Linear(cfg.d_ff), ff2=L.Linear(cfg.d_model),
+            ln=L.LayerNorm(),
+        )
 
     # --- params (reuse the standard LM's structure) ---
 
@@ -77,40 +89,36 @@ class ContextParallelLM:
         from .transformer_lm import PipelinedLM
         return PipelinedLM(self.cfg, self.n_stages).init(key)
 
-    # --- pieces ---
+    # --- pieces (layer math reused from ops.layers; only the attention and
+    # the position offset are context-parallel-specific) ---
+
+    @property
+    def _layers(self):
+        """Shared sublayer instances (built eagerly in __init__)."""
+        return self._layers_cache
 
     def _posenc(self, h, seq_offset):
-        d = self.cfg.d_model
-        pos = (seq_offset
-               + jnp.arange(h.shape[-2], dtype=jnp.float32))[:, None]
-        div = jnp.exp(jnp.arange(0, d, 2, dtype=jnp.float32)
-                      * (-jnp.log(10000.0) / d))
-        angles = pos * div[None, :]
-        pe = jnp.zeros((h.shape[-2], d), jnp.float32)
-        pe = pe.at[:, 0::2].set(jnp.sin(angles))
-        pe = pe.at[:, 1::2].set(jnp.cos(angles))
-        return h + pe.astype(h.dtype)
+        """PositionalEncoding's precomputed table, sliced at the shard offset."""
+        pe = self._layers["posenc"].pe  # [max_len, d]
+        s_local = h.shape[-2]
+        sl = jax.lax.dynamic_slice_in_dim(
+            pe, jnp.asarray(seq_offset, jnp.int32), s_local, axis=0)
+        return h + sl.astype(h.dtype)
 
     def pre_fn(self, pre_params, x_mb, ctx: StageCtx):
         tokens = x_mb["tokens"] if isinstance(x_mb, dict) else x_mb
-        table = pre_params["embed"]["table"]
-        h = jnp.take(table, tokens, axis=0)
-        h = h * jnp.asarray(jnp.sqrt(jnp.float32(self.cfg.d_model)), h.dtype)
+        h = self._layers["embed"].apply(pre_params["embed"], tokens, ctx=ctx)
         # global positions: offset by this context shard's start
-        seq_local = tokens.shape[-1]
-        offset = _axis_index_or_zero(CONTEXT_AXIS) * seq_local
-        h = self._posenc(h, offset.astype(jnp.float32))
+        offset = _axis_index_or_zero(CONTEXT_AXIS) * tokens.shape[-1]
+        h = self._posenc(h, offset)
         return h.astype(self.cfg.compute_dtype)
 
     def _block(self, bp, h, ctx: StageCtx):
-        """One transformer block with ring attention over the context axis.
-
-        Same math as ``ops.layers.TransformerEncoderLayer`` (post-LN, ReLU
-        FFN) with the attention swapped for the context ring; dropout is
-        omitted on this long-context path (rate 0 configs) to keep the ring
-        exact.
-        """
+        """ops.layers.TransformerEncoderLayer math with the attention swapped
+        for the context ring (dropout omitted on this path — rate-0 configs —
+        to keep the ring exact)."""
         cfg = self.cfg
+        L = self._layers
         rows, s_local, d = h.shape
         hd = d // cfg.nhead
 
@@ -126,16 +134,10 @@ class ContextParallelLM:
         a = a.reshape(rows, s_local, d)
         a = jnp.einsum("bsd,de->bse", a, bp["attn"]["wo"]) + bp["attn"]["bo"]
 
-        def ln(p, x):
-            mu = jnp.mean(x, axis=-1, keepdims=True)
-            var = jnp.var(x, axis=-1, keepdims=True)
-            return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
-
-        x = ln(bp["ln1"], h + a)
-        f = jax.nn.relu(jnp.einsum("bsd,do->bso", x, bp["ff1"]["w"])
-                        + bp["ff1"]["b"])
-        f = jnp.einsum("bso,od->bsd", f, bp["ff2"]["w"]) + bp["ff2"]["b"]
-        return ln(bp["ln2"], x + f)
+        x = L["ln"].apply(bp["ln1"], h + a)
+        f = jax.nn.relu(L["ff1"].apply(bp["ff1"], x))
+        f = L["ff2"].apply(bp["ff2"], f)
+        return L["ln"].apply(bp["ln2"], x + f)
 
     def stage_fn(self, blocks, h, ctx: StageCtx):
         cd = self.cfg.compute_dtype

@@ -110,6 +110,11 @@ class SpmdPipeline:
         if self.context_axis and self.context_axis not in self.mesh.axis_names:
             raise ValueError(
                 f"mesh has no {self.context_axis!r} axis for context_axis")
+        if self.context_axis and self.post_fn is None:
+            raise ValueError(
+                "context_axis requires a post_fn whose output is context-"
+                "invariant (e.g. a pmean'd loss); the identity post would "
+                "silently return one context shard's activations")
         self.n_stages = self.mesh.shape[STAGE_AXIS]
         self.has_data_axis = DATA_AXIS in self.mesh.axis_names
         self._pre = self.pre_fn or _identity

@@ -210,9 +210,12 @@ class Trainer:
                                         jax.random.fold_in(key, b),
                                         jnp.float32(lr))
             losses.append(loss)
-            if log_every and (b + 1) % log_every == 0:
+            if b == 0:
+                float(loss)               # sync out the compile
+                t0 = time.perf_counter()  # steady-state timing from step 2
+            if log_every and (b + 1) % log_every == 0 and b >= 1:
                 l = float(losses[-1])
-                dt = (time.perf_counter() - t0) / (b + 1)
+                dt = (time.perf_counter() - t0) / b
                 log_fn(f"| epoch {epoch} | step {b+1}/{n} "
                        f"| lr {lr:.3f} "
                        f"| ms/batch {dt*1000:.1f} "
@@ -220,10 +223,11 @@ class Trainer:
                        f"| loss {l:.3f} | ppl {np.exp(min(l, 20.0)):.2f} "
                        f"| bubble {self.analytic_bubble():.1%}")
         final = float(losses[-1]) if losses else float("nan")
+        # t0 was reset after step 0, so elapsed covers len(losses)-1 steps
         return state, {"loss": final,
                        "steps": len(losses),
                        "sec_per_step": (time.perf_counter() - t0)
-                       / max(len(losses), 1)}
+                       / max(len(losses) - 1, 1)}
 
     def evaluate(self, source: np.ndarray, state: TrainState,
                  max_steps: Optional[int] = None) -> float:

@@ -26,28 +26,24 @@ def tiny_cfg(seq_len=32):
 
 
 def plain_reference_loss(model, params, tokens, targets):
-    """Single-device oracle: embed -> blocks -> per-row CE on full seq."""
+    """Single-device oracle: the INDEPENDENT ops.layers implementation.
+
+    Uses TransformerEncoderLayer (full XLA attention, same param structure)
+    rather than the model's own block code, so a divergence in the
+    context-parallel math cannot cancel out in the comparison.
+    """
+    from pipe_tpu.ops.layers import TransformerEncoderLayer
+
+    cfg = model.cfg
     sp, prep, postp = params
     table = prep["embed"]["table"]
-    h = jnp.take(table, tokens, axis=0) * jnp.sqrt(
-        jnp.float32(model.cfg.d_model))
-    h = model._posenc(h, 0.0)
-    ctx = StageCtx()
+    h = jnp.take(table, tokens, axis=0) * jnp.sqrt(jnp.float32(cfg.d_model))
+    h = model._posenc(h, 0)
+    tel = TransformerEncoderLayer(cfg.d_model, cfg.nhead, cfg.d_ff, 0.0,
+                                  causal=cfg.causal)
     for blocks in sp:
-        # run block math on the full sequence with a 1-member "ring"
-        import pipe_tpu.models.long_context_lm as lc
-
-        def fake_ring(q, k, v, axis, causal=True, scale=None):
-            from pipe_tpu.ops.ring_attention import \
-                blockwise_attention_reference
-            return blockwise_attention_reference(q, k, v, causal=causal)
-
-        orig = lc.ring_attention
-        lc.ring_attention = fake_ring
-        try:
-            h = model.stage_fn(blocks, h, ctx)
-        finally:
-            lc.ring_attention = orig
+        for bp in blocks:
+            h = tel.apply(bp, h, ctx=StageCtx())
     w = postp["decoder"]["w"]
     b = postp["decoder"]["b"]
     logits = jnp.einsum("bsd,dv->bsv", h.astype(jnp.float32), w) + b

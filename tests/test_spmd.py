@@ -200,3 +200,52 @@ def test_loss_in_post_fn():
         (reference_forward(stage_fn, params, x) - 1.0) ** 2, axis=-1)
     np.testing.assert_allclose(np.asarray(per_row.reshape(-1)),
                                np.asarray(expected), rtol=1e-5, atol=1e-5)
+
+
+def test_skip_as_carried_pytree_lane():
+    """Skip connections on the compiled path: the activation is a pytree and
+    a skip is an extra leaf riding the same ppermute ring (the SPMD
+    equivalent of the emulator's portal dataflow — reference skip/ package).
+
+    Stage 0 stashes its input into the skip lane; the last stage pops it as
+    a residual. Transparency vs the same computation done serially.
+    """
+    n_stages = 4
+    key = jax.random.key(0)
+    layer = Linear(WIDTH)
+    params = [layer.init(jax.random.fold_in(key, j), jnp.zeros((1, WIDTH)))
+              for j in range(n_stages)]
+
+    def stage_fn(p, h, ctx):
+        j = jax.lax.axis_index("stage")
+        act, skip = h["act"], h["skip"]
+        skip = jnp.where(j == 0, act, skip)          # stash at stage 0
+        act = jnp.tanh(layer.apply(p, act))
+        act = jnp.where(j == n_stages - 1, act + skip, act)  # pop at last
+        return {"act": act, "skip": skip}
+
+    def pre_fn(p, x, ctx):
+        return {"act": x, "skip": jnp.zeros_like(x)}
+
+    def post_fn(p, h, ctx):
+        return h["act"]
+
+    mesh = make_mesh(n_stages, 1)
+    pipe = SpmdPipeline(mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn)
+    stacked = stack_stage_params(params)
+
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+    xs, bs = mb.stack_scatter(x, 4)
+    got = mb.stack_gather(pipe(stacked, {}, {}, xs), bs)
+
+    h = x
+    for j, p in enumerate(params):
+        h = jnp.tanh(layer.apply(p, h))
+    expected = h + x   # skip residual from stage 0's input
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-6)
+
+    # gradients flow through the skip lane
+    g = jax.grad(lambda x: jnp.sum(pipe(stacked, {}, {},
+                                        mb.stack_scatter(x, 4)[0])))(x)
+    assert np.isfinite(np.asarray(g)).all()
