@@ -354,6 +354,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     scale = float(scale if scale is not None else 1.0 / math.sqrt(d))
     bq = min(block_q, s)
     bk = min(block_k, s)
+    # The kernels iterate s // bq and s // bk grids; a non-dividing block
+    # (possible with mismatched non-default block_q/block_k) would silently
+    # skip trailing positions instead of erroring (ADVICE r1).
+    if s % bq or s % bk:
+        raise ValueError(
+            f"flash_attention: seq_len {s} must be divisible by block_q={bq} "
+            f"and block_k={bk}; use ops.layers.dot_product_attention")
 
     def to3(x):  # [b, s, h, d] -> [b*h, s, d]
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)

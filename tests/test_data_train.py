@@ -139,6 +139,39 @@ def test_steplr_decays_per_epoch(corpus):
     assert lr3 == pytest.approx(cfg.lr * cfg.lr_gamma ** 3, abs=5e-4)
 
 
+def test_nondivisible_batch_loss_masks_padding(corpus):
+    """batch 10, chunks 4 -> stack_scatter pads to 12 rows; the two fake
+    rows must not contaminate the loss: trainer loss == plain-model loss
+    over the 10 real rows (VERDICT r1 #7)."""
+    source, _ = corpus  # batchified with 8 lanes
+    wide = lm_text.batchify(np.concatenate([source.T.ravel()] * 2), 10)
+    model_cfg = dataclasses.replace(LMConfig().tiny(), n_layers=2)
+    cfg4 = TrainerConfig(batch_size=10, eval_batch_size=10,
+                         bptt=model_cfg.seq_len, chunks=4, n_stages=2,
+                         n_data=1, lr=1e-2)
+    trainer4 = Trainer(model_cfg, cfg4)
+    state = trainer4.init_state()
+    data, target = lm_text.get_batch(wide, 0, cfg4.bptt)
+    assert data.shape[0] == 10
+    x, w = trainer4._make_x(data, target)
+    assert float(jnp.sum(w)) == 10.0
+    got = float(trainer4._eval_fn(state.params, x, w))
+
+    # plain (unpipelined, unpadded) reference on the same params
+    from pipe_tpu.core.partition import StageCtx
+    sp, prep, postp = state.params
+    model = trainer4.model
+    ctx = StageCtx(key=None, train=False)
+    h = model.pre_fn(prep, jnp.asarray(data), ctx)
+    for j in range(cfg4.n_stages):
+        blocks = jax.tree_util.tree_map(lambda p: p[j], sp)
+        h = model.stage_fn(blocks, h, ctx)
+    per_row = model.loss_post_fn(postp, h,
+                                 {"targets": jnp.asarray(target)}, ctx)
+    expected = float(jnp.mean(per_row))
+    assert got == pytest.approx(expected, rel=1e-5)
+
+
 def test_interleaved_trainer(corpus):
     """Trainer with the interleaved schedule trains and resumes."""
     source, _ = corpus
