@@ -15,7 +15,9 @@ configs #4) slot in without touching the executors.
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
 
 __all__ = [
     "clock_cycles",
@@ -25,7 +27,25 @@ __all__ = [
     "OneFOneBSchedule",
     "InterleavedSchedule",
     "get_schedule",
+    "verify_op_tables",
+    "IDLE",
+    "FWD",
+    "BWD",
 ]
+
+# Op codes for the (cycle, stage) tables driving the manual fwd+bwd executor
+# (parallel.scheduled.ScheduledPipeline).
+IDLE, FWD, BWD = 0, 1, 2
+
+
+def _place(op: np.ndarray, mbi: np.ndarray, t: int, j: int,
+           code: int, i: int) -> None:
+    if op[t, j] != IDLE:
+        raise AssertionError(
+            f"schedule collision at cycle {t}, stage {j}: "
+            f"op {op[t, j]} already placed, tried {code} (mb {i})")
+    op[t, j] = code
+    mbi[t, j] = i
 
 
 def clock_cycles(m: int, n: int) -> Iterator[List[Tuple[int, int]]]:
@@ -64,6 +84,27 @@ class Schedule:
         busy = m * n
         return (total - busy) / total
 
+    # --- manual fwd+bwd executor contract (parallel.scheduled) ---
+
+    def op_tables(self, m: int, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """``(op[T, n], mb[T, n])`` over ``T = 2(m+n-1)`` uniform slots.
+
+        ``op[t, j]`` says what stage ``j`` does at cycle ``t`` (IDLE/FWD/BWD)
+        and ``mb[t, j]`` on which micro-batch. Invariants every table must
+        satisfy (asserted by construction + :func:`verify_op_tables`):
+
+        * each (i, j) appears exactly once as FWD and once as BWD;
+        * FWD of (i, j) happens strictly after FWD of (i, j-1);
+        * BWD of (i, j) happens exactly one cycle after BWD of (i, j+1)
+          (gradients ride a reverse ppermute with no buffering);
+        * BWD of (i, j) happens after FWD of (i, j).
+        """
+        raise NotImplementedError
+
+    def stash_slots(self, m: int, n: int) -> int:
+        """Max simultaneously-live stashed input activations per stage."""
+        raise NotImplementedError
+
 
 @dataclasses.dataclass(frozen=True)
 class GPipeSchedule(Schedule):
@@ -74,17 +115,45 @@ class GPipeSchedule(Schedule):
     def cycles(self, m: int, n: int) -> List[List[Tuple[int, int]]]:
         return [list(c) for c in clock_cycles(m, n)]
 
+    def op_tables(self, m: int, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Fill–drain forward then full reverse wavefront backward.
+
+        Forward is the reference wavefront (FWD of (i, j) at cycle ``i + j``,
+        ``pipeline.py:63-79``); backward is its mirror, the order the autograd
+        engine discovers at runtime in the reference (LIFO per stage,
+        ``pipeline.py:128-132``) — here precomputed as data.
+        """
+        T = 2 * (m + n - 1)
+        op = np.full((T, n), IDLE, np.int32)
+        mbi = np.zeros((T, n), np.int32)
+        for j in range(n):
+            for i in range(m):
+                _place(op, mbi, i + j, j, FWD, i)
+                _place(op, mbi, (m + n - 1) + (m - 1 - i) + (n - 1 - j),
+                       j, BWD, i)
+        return op, mbi
+
+    def stash_slots(self, m: int, n: int) -> int:
+        """All m forwards complete before any backward: O(m) live inputs."""
+        return m
+
 
 @dataclasses.dataclass(frozen=True)
 class OneFOneBSchedule(Schedule):
-    """1F1B forward ordering.
+    """1F1B: one-forward-one-backward with at most ``min(m, n)`` micro-batches
+    in flight per stage (the memory property the reference's fork/join
+    machinery exists to enable, ``pipeline.py:128-132``; torchgpipe lineage
+    ``pipe.py:230-232``).
 
-    Forward cycles are identical to GPipe's wavefront (the forward pass of 1F1B
-    is the same fill); the memory win comes from interleaving backward
-    micro-batches, which in this framework is realized by the remat policy and
-    the compiled backward of the SPMD executor rather than a runtime queue.
-    Kept as a distinct schedule so the executor can cap in-flight activations at
-    ``n`` instead of ``m``.
+    Stage ``j`` runs ``min(m, n-j)`` warm-up forwards, then alternates
+    backward/forward, then drains backwards:
+
+    * FWD of (i, j) at cycle ``i + j``        for ``i <  n - j`` (warm-up)
+    * FWD of (i, j) at cycle ``2i + j``       for ``i >= n - j`` (steady)
+    * BWD of (i, j) at cycle ``2n - 1 - j + 2i``
+
+    Same ``2(m+n-1)`` total slots — and hence the same bubble — as GPipe;
+    the win is the activation-memory cap, not the bubble.
     """
 
     name: str = "1f1b"
@@ -92,7 +161,20 @@ class OneFOneBSchedule(Schedule):
     def cycles(self, m: int, n: int) -> List[List[Tuple[int, int]]]:
         return [list(c) for c in clock_cycles(m, n)]
 
-    def max_live_microbatches(self, m: int, n: int) -> int:
+    def op_tables(self, m: int, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        T = 2 * (m + n - 1)
+        op = np.full((T, n), IDLE, np.int32)
+        mbi = np.zeros((T, n), np.int32)
+        for j in range(n):
+            for i in range(m):
+                tf = i + j if i < n - j else 2 * i + j
+                _place(op, mbi, tf, j, FWD, i)
+                _place(op, mbi, 2 * n - 1 - j + 2 * i, j, BWD, i)
+        return op, mbi
+
+    def stash_slots(self, m: int, n: int) -> int:
+        """BWD of i precedes FWD of i + min(m, n) at every stage, so a
+        ``min(m, n)``-slot ring buffer of stashed inputs never collides."""
         return min(m, n)
 
 
@@ -126,6 +208,50 @@ class InterleavedSchedule(Schedule):
         """Per-device fill/drain bubble ≈ (d-1)/(m·v + d-1): v× smaller fill."""
         d = n_devices
         return (d - 1) / (m * self.v + d - 1)
+
+
+def verify_op_tables(op: np.ndarray, mbi: np.ndarray, m: int, n: int,
+                     stash_slots: Optional[int] = None) -> None:
+    """Check the :meth:`Schedule.op_tables` invariants (see docstring there).
+
+    A table passing this check — *including* the stash-capacity check, so
+    pass the schedule's ``stash_slots(m, n)`` — executes correctly on the
+    manual executor; new schedules only need to emit valid tables.
+    """
+    t_fwd = np.full((m, n), -1)
+    t_bwd = np.full((m, n), -1)
+    for t in range(op.shape[0]):
+        for j in range(n):
+            if op[t, j] == FWD:
+                assert t_fwd[mbi[t, j], j] == -1, (t, j)
+                t_fwd[mbi[t, j], j] = t
+            elif op[t, j] == BWD:
+                assert t_bwd[mbi[t, j], j] == -1, (t, j)
+                t_bwd[mbi[t, j], j] = t
+    assert (t_fwd >= 0).all() and (t_bwd >= 0).all(), "missing ops"
+    for i in range(m):
+        for j in range(n):
+            assert t_bwd[i, j] > t_fwd[i, j], f"bwd before fwd at {(i, j)}"
+            if j + 1 < n:
+                # fwd must be strictly earlier upstream; bwd exactly one
+                # cycle later downstream (ring transport, no grad buffering)
+                assert t_fwd[i, j] < t_fwd[i, j + 1], (i, j)
+                assert t_bwd[i, j] == t_bwd[i, j + 1] + 1, (i, j)
+    if stash_slots is not None:
+        # Slot i % S parks micro-batch i's input from its arrival (one cycle
+        # after the upstream FWD; its own FWD cycle on stage 0) until its BWD
+        # reads it — micro-batch i + S must not arrive before that read.
+        S = stash_slots
+        t_arrive = np.where(
+            np.arange(n)[None, :] == 0, t_fwd,
+            np.roll(t_fwd, 1, axis=1) + 1)
+        for j in range(n):
+            for i in range(m - S):
+                assert t_arrive[i + S, j] > t_bwd[i, j], (
+                    f"stash slot clobber: micro-batch {i + S} arrives at "
+                    f"stage {j} (t={t_arrive[i + S, j]}) before micro-batch "
+                    f"{i}'s backward reads the slot (t={t_bwd[i, j]}); "
+                    f"stash_slots={S} is too small for this table")
 
 
 _SCHEDULES = {

@@ -1,0 +1,402 @@
+"""Schedule-table executor: hand-scheduled forward+backward in ONE scan.
+
+The reference has no backward scheduler at all — backward order is discovered
+at runtime by the C++ autograd engine walking fork/join/Copy/Wait nodes
+(``pipeline.py:128-132``; ``README.md:106-183,219-237``), which is precisely
+why its 1F1B-style memory release works: each micro-batch's backward runs as
+soon as its gradient arrives, freeing activations early. The AD executor
+(:mod:`.spmd`) gets correctness from ``jax.grad``-of-``scan`` but inherits
+GPipe's O(m) activation liveness: every micro-batch's residuals survive until
+the scan's backward.
+
+This module instead compiles the *whole* training step — forward, backward,
+loss, gradient accumulation — as one ``lax.scan`` over ``2(m+n-1)`` uniform
+clock slots, driven by static (cycle, stage) → (op, micro-batch) tables
+emitted by :meth:`core.schedule.Schedule.op_tables`. Per cycle each device
+either
+
+* **FWD**: runs its stage on one micro-batch (stashing the stage *input* in a
+  ring buffer), or
+* **BWD**: re-runs the stage from the stashed input under ``jax.vjp`` and
+  applies the cotangent arriving from the next stage (manual remat — the
+  compiled analogue of ``Recompute.backward`` re-running forward just before
+  ``Checkpoint.backward`` consumes it, ``README.md:450-537``), or
+* **IDLE**: passes through (a fill/drain bubble slot).
+
+Transport is two ``ppermute`` rings — activations j→j+1, cotangents j+1→j —
+shifted every cycle; the tables guarantee a value is consumed exactly when it
+arrives (gradients) or is parked in the stash until its cycle (activations).
+
+What this buys over the AD executor:
+
+* **True 1F1B**: with ``schedule='1f1b'`` the stashed-input buffer holds at
+  most ``min(m, n)`` micro-batches (vs GPipe's ``m``) — the activation-memory
+  cap that is the entire point of the reference's fork/join machinery.
+* **Exact ``except_last``**: per-micro-batch remat policy with *uniform*
+  per-cycle code: micro-batch m-1's vjp residuals are saved at forward time
+  (a flattened-``vjp_fn`` pytree carried in the scan), every other micro-batch
+  recomputes — sidestepping the jax 0.9.0 ``cond``+remat+PRNG bug that forces
+  the AD executor's static remat (see ``spmd.py`` module docstring). Matches
+  the reference mode map ``pipe.py:354`` exactly on the compiled path.
+* **Schedules as data**: any table satisfying
+  :func:`core.schedule.verify_op_tables` runs unmodified.
+
+Checkpoint-mode → storage map (per stage):
+
+=============  =====================  ==========================
+mode           stashed inputs         stored vjp residuals
+=============  =====================  ==========================
+always         S slots                none (recompute all)
+except_last    S slots                1 slot (micro-batch m-1)
+never          S slots                S slots (recompute none)
+=============  =====================  ==========================
+
+with S = ``schedule.stash_slots(m, n)`` = m for GPipe, min(m, n) for 1F1B.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.partition import StageCtx
+from ..core.remat import validate_mode
+from ..core.schedule import (BWD, FWD, GPipeSchedule, OneFOneBSchedule,
+                             Schedule, get_schedule)
+from .mesh import DATA_AXIS, STAGE_AXIS
+
+__all__ = ["ScheduledPipeline"]
+
+
+def _index(tree, i):
+    return jax.tree_util.tree_map(
+        lambda l: jax.lax.dynamic_index_in_dim(l, i, 0, keepdims=False), tree)
+
+
+@dataclasses.dataclass
+class ScheduledPipeline:
+    """Training executor: ``loss_and_grad`` on a ``(stage[, data])`` mesh.
+
+    Args:
+      mesh: mesh with a ``stage`` axis (and optionally ``data``/others).
+      stage_fn: ``(params_j, h, ctx) -> h`` homogeneous stage body (ring
+        invariant: input/output activation shapes identical).
+      pre_fn: ``(pre_params, x_mb, ctx) -> h``, run on stage 0 (embed).
+      post_fn: ``(post_params, h, x_mb, ctx) -> per-row loss [rows]``, run on
+        stage n-1. Training executors always compute loss in-pipeline (the
+        reference moves targets to the last GPU for the same reason,
+        ``main.py:216``).
+      checkpoint: ``always | except_last | never`` — exact per-micro-batch
+        policy (reference ``pipe.py:354``).
+      schedule: ``'gpipe' | '1f1b'`` or a :class:`Schedule` with op tables.
+    """
+
+    mesh: Mesh
+    stage_fn: Callable
+    pre_fn: Callable
+    post_fn: Callable
+    checkpoint: str = "except_last"
+    schedule: Any = "1f1b"
+    context_axis: Optional[str] = None
+    context_dim: int = 2
+
+    def __post_init__(self):
+        validate_mode(self.checkpoint)
+        if STAGE_AXIS not in self.mesh.axis_names:
+            raise ValueError(f"mesh must have a {STAGE_AXIS!r} axis")
+        if isinstance(self.schedule, str):
+            self.schedule = get_schedule(self.schedule)
+        if not isinstance(self.schedule, (GPipeSchedule, OneFOneBSchedule)):
+            # anything emitting valid op tables works; these two are shipped
+            if not hasattr(self.schedule, "op_tables"):
+                raise ValueError(
+                    f"schedule {self.schedule!r} has no op_tables")
+        self.n_stages = self.mesh.shape[STAGE_AXIS]
+        self.has_data_axis = DATA_AXIS in self.mesh.axis_names
+        if self.context_axis and self.context_axis not in self.mesh.axis_names:
+            raise ValueError(
+                f"mesh has no {self.context_axis!r} axis for context_axis")
+
+    # -----------------------------------------------------------------
+    def memory_plan(self, m: int) -> dict:
+        """Static per-stage buffer counts — the memory story, inspectable."""
+        n = self.n_stages
+        S = self.schedule.stash_slots(m, n)
+        R = {"always": 0, "except_last": 1, "never": S}[self.checkpoint]
+        return {"cycles": 2 * (m + n - 1), "stash_slots": S,
+                "residual_slots": R}
+
+    # -----------------------------------------------------------------
+    def loss_and_grad(self, stage_params, pre_params, post_params, x, w,
+                      *, key: Optional[jax.Array] = None):
+        """One pipelined step: returns ``(loss, (g_stage, g_pre, g_post))``.
+
+        ``x``: pytree of ``[m, rows, ...]`` micro-batched arrays;
+        ``w``: ``[m, rows]`` per-row loss weights (0 for padding rows — the
+        loss is ``sum(w * per_row) / sum(w)``).
+        """
+        x_leaves = jax.tree_util.tree_leaves(x)
+        if not x_leaves:
+            raise TypeError("x must contain at least one array leaf")
+        m = x_leaves[0].shape[0]
+        key = key if key is not None else jax.random.key(0)
+        data = DATA_AXIS if self.has_data_axis else None
+
+        def x_spec(l):
+            spec = [None, data] + [None] * (l.ndim - 2)
+            if self.context_axis and l.ndim > self.context_dim:
+                spec[self.context_dim] = self.context_axis
+            return P(*spec)
+
+        in_specs = (
+            jax.tree_util.tree_map(lambda _: P(STAGE_AXIS), stage_params),
+            jax.tree_util.tree_map(lambda _: P(), pre_params),
+            jax.tree_util.tree_map(lambda _: P(), post_params),
+            jax.tree_util.tree_map(x_spec, x),
+            P(None, data),                # w
+            P(),                          # key
+        )
+        out_specs = (
+            P(),                          # loss
+            (jax.tree_util.tree_map(lambda _: P(STAGE_AXIS), stage_params),
+             jax.tree_util.tree_map(lambda _: P(), pre_params),
+             jax.tree_util.tree_map(lambda _: P(), post_params)),
+        )
+        run = jax.shard_map(
+            functools.partial(self._device_program, m=m),
+            mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False)
+        return run(stage_params, pre_params, post_params, x, w, key)
+
+    # -----------------------------------------------------------------
+    def _f_full(self, params_j, prep, postp, h_in, x_mb, w_mb, kij, j):
+        """The per-(cycle, stage) forward: pre (stage 0 only) → body → loss
+        contribution (stage n-1 only). Everything the backward needs to
+        differentiate is an explicit argument — no closure over device state
+        (in particular no collective-derived values like the global weight
+        sum, which would change the vjp residual structure under shard_map) —
+        so the residual structure is derivable abstractly. The contribution is
+        UNNORMALIZED (``sum(w * per_row)``); the executor divides the loss and
+        scales the backward seed by ``1/sum(w)``."""
+        n = self.n_stages
+        train = True
+        h0 = jax.lax.cond(
+            j == 0,
+            lambda: self.pre_fn(prep, x_mb,
+                                StageCtx(key=jax.random.fold_in(kij, 0),
+                                         train=train)),
+            lambda: h_in)
+        h1 = self.stage_fn(params_j, h0,
+                           StageCtx(key=jax.random.fold_in(kij, 1),
+                                    train=train))
+        contrib = jax.lax.cond(
+            j == n - 1,
+            lambda: jnp.sum(
+                w_mb * self.post_fn(postp, h1, x_mb,
+                                    StageCtx(key=jax.random.fold_in(kij, 2),
+                                             train=train))
+            ).astype(jnp.float32),
+            lambda: jnp.zeros((), jnp.float32))
+        return h1, contrib
+
+    def _vjp_wrt(self, params_j, prep, postp, h_in, x_mb, w_mb, kij, j):
+        """vjp of :meth:`_f_full` w.r.t. (stage params, pre, post, h_in)."""
+        return jax.vjp(
+            lambda a, b, c, d: self._f_full(a, b, c, d, x_mb, w_mb, kij, j),
+            params_j, prep, postp, h_in)
+
+    # -----------------------------------------------------------------
+    def _device_program(self, stage_params, pre_params, post_params, x, w,
+                        key, *, m):
+        n = self.n_stages
+        j = jax.lax.axis_index(STAGE_AXIS)
+        params_j = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        plan = self.memory_plan(m)
+        S, R = plan["stash_slots"], plan["residual_slots"]
+        mode = self.checkpoint
+
+        # Total loss weight, global over the data axis (w is replicated over
+        # stage/context) — contributions are pre-divided so loss and grads
+        # come out as the masked mean.
+        wsum = jnp.sum(w).astype(jnp.float32)
+        if self.has_data_axis:
+            wsum = jax.lax.psum(wsum, DATA_AXIS)
+
+        # --- local shape specs -------------------------------------------
+        ctx0 = StageCtx(key=None, train=True)
+        x_mb_spec = jax.eval_shape(lambda a: _index_spec(a), x)
+        w_mb_spec = jax.eval_shape(lambda a: _index_spec(a), w)
+        h_spec = jax.eval_shape(
+            lambda p, a: self.pre_fn(p, a, ctx0), pre_params, x_mb_spec)
+
+        # Canonical vjp structure (abstract — no tracers leak in):
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        key_spec = jax.eval_shape(lambda: jax.random.key(0))
+        (_, _), vjp_fn_spec = jax.eval_shape(
+            self._vjp_wrt, params_j, pre_params, post_params, h_spec,
+            x_mb_spec, w_mb_spec, key_spec, i32)
+        res_specs, res_treedef = jax.tree_util.tree_flatten(vjp_fn_spec)
+        inv_wsum = 1.0 / wsum
+
+        # --- schedule tables (static data → scan xs) ---------------------
+        op_np, mb_np = self.schedule.op_tables(m, n)
+        T = op_np.shape[0]
+        # rx[t, j]: the ring value arriving at stage j at cycle t is stage
+        # j-1's cycle-(t-1) output — a real activation iff that was a FWD.
+        rxop_np = np.full((T, n), 0, np.int32)
+        rxmb_np = np.zeros((T, n), np.int32)
+        rxop_np[1:, 1:] = (op_np[:-1, :-1] == FWD).astype(np.int32)
+        rxmb_np[1:, 1:] = mb_np[:-1, :-1]
+        xs = (jnp.asarray(op_np), jnp.asarray(mb_np),
+              jnp.asarray(rxop_np), jnp.asarray(rxmb_np))
+
+        # --- carry -------------------------------------------------------
+        def zeros_of(spec):
+            return jnp.zeros(spec.shape, spec.dtype)
+
+        def slots_of(spec, k):
+            # one extra garbage slot so masked writes need no read-back
+            return jnp.zeros((k + 1,) + tuple(spec.shape), spec.dtype)
+
+        h_ring = jax.tree_util.tree_map(zeros_of, h_spec)
+        g_ring = jax.tree_util.tree_map(zeros_of, h_spec)
+        stash = jax.tree_util.tree_map(lambda s: slots_of(s, S), h_spec)
+        res_store = ([slots_of(s, R if mode == "never" else 1)
+                      for s in res_specs] if mode != "always" else [])
+        g_sp = jax.tree_util.tree_map(jnp.zeros_like, params_j)
+        g_pre = jax.tree_util.tree_map(jnp.zeros_like, pre_params)
+        g_post = jax.tree_util.tree_map(jnp.zeros_like, post_params)
+        loss0 = jnp.zeros((), jnp.float32)
+
+        fwd_perm = [(k, k + 1) for k in range(n - 1)]
+        bwd_perm = [(k + 1, k) for k in range(n - 1)]
+
+        def res_slot_for(i):
+            """Where micro-batch i's residuals live (garbage slot if unsaved)."""
+            if mode == "never":
+                return i % S
+            # except_last: slot 0 holds micro-batch m-1, slot 1 is garbage
+            return jnp.where(i == m - 1, 0, 1)
+
+        def cycle(carry, row):
+            h_ring, g_ring, stash, res_store, g_sp, g_pre, g_post, loss = carry
+            op_r, mb_r, rxop_r, rxmb_r = row
+            opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
+            i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
+            rxv = jax.lax.dynamic_index_in_dim(rxop_r, j, 0, keepdims=False)
+            rxi = jax.lax.dynamic_index_in_dim(rxmb_r, j, 0, keepdims=False)
+
+            # 1) park the arriving activation (garbage slot when not real)
+            rslot = jnp.where(rxv == 1, rxi % S, S)
+            stash = jax.tree_util.tree_map(
+                lambda st, hr: jax.lax.dynamic_update_index_in_dim(
+                    st, hr, rslot, 0), stash, h_ring)
+
+            kij = jax.random.fold_in(jax.random.fold_in(key, i), j)
+            x_mb = _index(x, i)
+            w_mb = _index(w, i)
+            h_in = jax.tree_util.tree_map(
+                lambda st: jax.lax.dynamic_index_in_dim(
+                    st, i % S, 0, keepdims=False), stash)
+
+            def fwd_branch():
+                if mode == "always":
+                    h1, contrib = self._f_full(
+                        params_j, pre_params, post_params, h_in, x_mb, w_mb,
+                        kij, j)
+                    new_res = res_store
+                else:
+                    (h1, contrib), vjp_fn = self._vjp_wrt(
+                        params_j, pre_params, post_params, h_in, x_mb, w_mb,
+                        kij, j)
+                    leaves = jax.tree_util.tree_leaves(vjp_fn)
+                    assert [(l.shape, l.dtype) for l in leaves] == \
+                        [(s.shape, s.dtype) for s in res_specs], \
+                        "vjp residual structure drifted from abstract spec"
+                    slot = res_slot_for(i) if mode == "except_last" else i % S
+                    new_res = [
+                        jax.lax.dynamic_update_index_in_dim(st, l, slot, 0)
+                        for st, l in zip(res_store, leaves)]
+                return (new_res, g_sp, g_pre, g_post, loss + contrib,
+                        h1, g_ring)
+
+            def bwd_branch():
+                seed_h = jax.tree_util.tree_map(
+                    lambda g: jnp.where(j == n - 1, jnp.zeros_like(g), g),
+                    g_ring)
+                # contribution cotangent: d(masked mean)/d(contrib) = 1/sum(w)
+                seed = (seed_h, inv_wsum)
+
+                def apply_stored():
+                    slot = res_slot_for(i) if mode == "except_last" else i % S
+                    leaves = [
+                        jax.lax.dynamic_index_in_dim(st, slot, 0,
+                                                     keepdims=False)
+                        for st in res_store]
+                    vjp_fn = jax.tree_util.tree_unflatten(res_treedef, leaves)
+                    return vjp_fn(seed)
+
+                def apply_recomputed():
+                    _, vjp_fn = self._vjp_wrt(
+                        params_j, pre_params, post_params, h_in, x_mb, w_mb,
+                        kij, j)
+                    return vjp_fn(seed)
+
+                if mode == "never":
+                    gp, gpre, gpost, gh = apply_stored()
+                elif mode == "always":
+                    gp, gpre, gpost, gh = apply_recomputed()
+                else:  # except_last: stored for m-1, recomputed otherwise
+                    gp, gpre, gpost, gh = jax.lax.cond(
+                        i == m - 1, apply_stored, apply_recomputed)
+                add = functools.partial(jax.tree_util.tree_map, jnp.add)
+                return (res_store, add(g_sp, gp), add(g_pre, gpre),
+                        add(g_post, gpost), loss, h_ring, gh)
+
+            def idle_branch():
+                return (res_store, g_sp, g_pre, g_post, loss, h_ring, g_ring)
+
+            res_store2, g_sp2, g_pre2, g_post2, loss2, tx_h, tx_g = \
+                jax.lax.switch(opj, [idle_branch, fwd_branch, bwd_branch])
+
+            if n > 1:
+                tx_h = jax.tree_util.tree_map(
+                    lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm), tx_h)
+                tx_g = jax.tree_util.tree_map(
+                    lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm), tx_g)
+            return (tx_h, tx_g, stash, res_store2, g_sp2, g_pre2, g_post2,
+                    loss2), None
+
+        carry0 = (h_ring, g_ring, stash, res_store, g_sp, g_pre, g_post,
+                  loss0)
+        (_, _, _, _, g_sp, g_pre, g_post, loss), _ = jax.lax.scan(
+            cycle, carry0, xs)
+
+        # --- cross-device reductions ------------------------------------
+        # stage grads: per-stage shards stay put; replicas over other axes sum
+        other_axes = tuple(a for a in self.mesh.axis_names if a != STAGE_AXIS)
+        if other_axes:
+            g_sp = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, other_axes), g_sp)
+        # pre/post grads + loss: only edge stages contributed; psum collects
+        reduce_axes = (STAGE_AXIS,) + other_axes
+        g_pre = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, reduce_axes), g_pre)
+        g_post = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, reduce_axes), g_post)
+        loss_axes = ((STAGE_AXIS, DATA_AXIS) if self.has_data_axis
+                     else (STAGE_AXIS,))
+        loss = jax.lax.psum(loss, loss_axes) * inv_wsum
+
+        g_sp = jax.tree_util.tree_map(lambda g: g[None], g_sp)
+        return loss, (g_sp, g_pre, g_post)
+
+
+def _index_spec(tree):
+    return jax.tree_util.tree_map(lambda l: l[0], tree)

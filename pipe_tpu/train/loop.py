@@ -47,7 +47,7 @@ class TrainerConfig:
     lr_gamma: float = 0.95     # StepLR(1.0, gamma=0.95), main.py:185
     grad_clip: float = 0.5     # main.py:219
     seed: int = 1234
-    schedule: str = "gpipe"    # gpipe | interleaved
+    schedule: str = "gpipe"    # gpipe | 1f1b | interleaved
     interleave: int = 2        # virtual stages per device (interleaved only)
 
 
@@ -70,11 +70,16 @@ class Trainer:
                 pre_fn=self.model.pre_fn, post_fn=self.model.loss_post_fn,
                 post_with_batch=True, checkpoint=cfg.checkpoint)
         elif cfg.schedule == "1f1b":
-            raise ValueError(
-                "schedule='1f1b' is not a distinct compiled executor: the "
-                "compiled path realizes 1F1B's forward order as GPipe "
-                "fill-drain (see core.schedule.OneFOneBSchedule); use "
-                "'gpipe', or 'interleaved' for the bubble reduction")
+            # True 1F1B: the manual fwd+bwd executor caps live activations at
+            # min(chunks, n_stages) per stage and applies the exact
+            # per-micro-batch checkpoint policy (parallel.scheduled).
+            from ..parallel.scheduled import ScheduledPipeline
+            self.n_virtual = cfg.n_stages
+            self.model = PipelinedLM(model_cfg, cfg.n_stages)
+            self.pipe = ScheduledPipeline(
+                self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
+                post_fn=self.model.loss_post_fn, checkpoint=cfg.checkpoint,
+                schedule="1f1b")
         elif cfg.schedule == "gpipe":
             self.n_virtual = cfg.n_stages
             self.model = PipelinedLM(model_cfg, cfg.n_stages)
@@ -84,8 +89,18 @@ class Trainer:
                 checkpoint=cfg.checkpoint)
         else:
             raise ValueError(f"unknown schedule {cfg.schedule!r}")
-        self.eval_pipe = dataclasses.replace(self.pipe, checkpoint="never") \
-            if cfg.checkpoint != "never" else self.pipe
+        self._scheduled = cfg.schedule == "1f1b"
+        if self._scheduled:
+            # The manual executor is training-only; eval (no grads, no remat)
+            # runs the AD forward executor on the same mesh and params.
+            self.eval_pipe = SpmdPipeline(
+                self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
+                post_fn=self.model.loss_post_fn, post_with_batch=True,
+                checkpoint="never")
+        else:
+            self.eval_pipe = dataclasses.replace(self.pipe,
+                                                 checkpoint="never") \
+                if cfg.checkpoint != "never" else self.pipe
 
         # StepLR per epoch (reference main.py:185): the per-epoch learning
         # rate is a traced argument of the jitted step, not a Python
@@ -163,13 +178,23 @@ class Trainer:
         zero-padded for non-divisible batches, so fake rows never contaminate
         loss or gradients (VERDICT r1 #7)."""
         sp, prep, postp = params
+        if train and self._scheduled:
+            # The manual executor has no forward-only path; its loss comes
+            # with grads attached (the hot path, _train_step, uses both).
+            loss, _ = self.pipe.loss_and_grad(sp, prep, postp, x, w, key=key)
+            return loss
         pipe = self.pipe if train else self.eval_pipe
         per_row = pipe(sp, prep, postp, x, key=key, train=train)
         return jnp.sum(per_row * w) / jnp.sum(w)
 
     def _train_step(self, state: TrainState, x, w, key, lr):
-        loss, grads = jax.value_and_grad(self._loss)(
-            state.params, x, w, key, True)
+        if self._scheduled:
+            sp, prep, postp = state.params
+            loss, grads = self.pipe.loss_and_grad(sp, prep, postp, x, w,
+                                                  key=key)
+        else:
+            loss, grads = jax.value_and_grad(self._loss)(
+                state.params, x, w, key, True)
         updates, opt_state = self.tx.update(grads, state.opt_state,
                                             state.params)
         updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)

@@ -172,6 +172,25 @@ def test_nondivisible_batch_loss_masks_padding(corpus):
     assert got == pytest.approx(expected, rel=1e-5)
 
 
+def test_1f1b_trainer(corpus):
+    """Trainer with the true-1F1B scheduled executor trains, evals, and its
+    first-step loss matches the gpipe (AD) trainer bitwise (same key scheme)."""
+    source, _ = corpus
+    trainer, model_cfg, _ = tiny_trainer(schedule="1f1b")
+    assert trainer.pipe.memory_plan(2)["stash_slots"] == 2
+    state, m = trainer.train_epoch(source, max_steps=8, log_every=0)
+    assert m["loss"] < np.log(model_cfg.vocab)
+    assert np.isfinite(trainer.evaluate(source, state, max_steps=2))
+
+    t_gpipe, _, _ = tiny_trainer(schedule="gpipe")
+    s0 = trainer.init_state()
+    s0g = t_gpipe.init_state()
+    _, l_1f1b = trainer.train_epoch(source, state=s0, max_steps=1, log_every=0)
+    _, l_gpipe = t_gpipe.train_epoch(source, state=s0g, max_steps=1,
+                                     log_every=0)
+    assert l_1f1b["loss"] == l_gpipe["loss"]
+
+
 def test_interleaved_trainer(corpus):
     """Trainer with the interleaved schedule trains and resumes."""
     source, _ = corpus

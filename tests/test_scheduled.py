@@ -1,0 +1,307 @@
+"""ScheduledPipeline tests: the manual fwd+bwd executor.
+
+The properties under test, per VERDICT r1 items #2 and #5:
+
+* loss/grad transparency vs the plain model across schedules (gpipe, 1f1b),
+  checkpoint modes (always/except_last/never), stage counts, and m < n;
+* REAL 1F1B memory: the stashed-activation buffer is min(m, n) slots vs
+  GPipe's m (structural), and compiled FLOPs show the remat policy is exact
+  per micro-batch (always > except_last > never);
+* bitwise agreement with the AD executor (same key-folding scheme), so the
+  two compiled paths are interchangeable;
+* data-parallel composition and padded-row masking.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.core import microbatch as mb
+from pipe_tpu.core.partition import StageCtx
+from pipe_tpu.core.schedule import get_schedule, verify_op_tables
+from pipe_tpu.ops.layers import Dropout, Linear
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.scheduled import ScheduledPipeline
+from pipe_tpu.parallel.spmd import SpmdPipeline, stack_stage_params
+
+WIDTH = 8
+
+
+def make_stage(n_stages, key, dropout=0.0):
+    layer = Linear(WIDTH)
+    drop = Dropout(dropout) if dropout else None
+    params = [layer.init(jax.random.fold_in(key, j), jnp.zeros((1, WIDTH)))
+              for j in range(n_stages)]
+
+    def stage_fn(p, h, ctx):
+        h = jnp.tanh(layer.apply(p, h))
+        if drop is not None:
+            h = drop.apply({}, h, ctx=ctx)
+        return h
+
+    return stage_fn, params
+
+
+def pre_fn(p, x, ctx):
+    return x
+
+
+def post_fn(p, h, x_mb, ctx):
+    return jnp.sum((h - 1.0) ** 2, axis=-1)
+
+
+def plain_loss_fn(stage_fn, params, x):
+    h = x
+    for p in params:
+        h = stage_fn(p, h, StageCtx())
+    return jnp.mean(jnp.sum((h - 1.0) ** 2, axis=-1))
+
+
+# ---------- op tables ----------
+
+@pytest.mark.parametrize("name", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("m,n", [(4, 2), (8, 4), (3, 3), (1, 2), (2, 4),
+                                 (8, 1), (16, 8)])
+def test_op_tables_valid(name, m, n):
+    s = get_schedule(name)
+    op, mbi = s.op_tables(m, n)
+    verify_op_tables(op, mbi, m, n, stash_slots=s.stash_slots(m, n))
+
+
+def test_verify_op_tables_catches_undersized_stash():
+    """GPipe tables with a 1F1B-sized stash must be rejected (the capacity
+    invariant is part of the executor contract, not just op placement)."""
+    m, n = 8, 2
+    op, mbi = get_schedule("gpipe").op_tables(m, n)
+    with pytest.raises(AssertionError, match="stash slot clobber"):
+        verify_op_tables(op, mbi, m, n, stash_slots=min(m, n))
+
+
+def test_1f1b_stash_cap():
+    """The schedule guarantee behind the min(m, n) buffer: BWD of i lands
+    before FWD of i + min(m, n) at every stage."""
+    s = get_schedule("1f1b")
+    for m, n in [(8, 2), (8, 4), (16, 8)]:
+        S = s.stash_slots(m, n)
+        assert S == min(m, n)
+        op, mbi = s.op_tables(m, n)
+        t_of = {}
+        for t in range(op.shape[0]):
+            for j in range(n):
+                if op[t, j]:
+                    t_of[(op[t, j], mbi[t, j], j)] = t
+        from pipe_tpu.core.schedule import BWD, FWD
+        for j in range(n):
+            for i in range(m - S):
+                assert t_of[(BWD, i, j)] < t_of[(FWD, i + S, j)]
+
+
+# ---------- transparency ----------
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("checkpoint", ["always", "except_last", "never"])
+@pytest.mark.parametrize("n_stages,m", [(2, 8), (4, 8), (4, 2)])
+def test_loss_and_grad_transparency(schedule, checkpoint, n_stages, m):
+    stage_fn, params = make_stage(n_stages, jax.random.key(0))
+    mesh = make_mesh(n_stages, 1)
+    x = jax.random.normal(jax.random.key(1), (2 * m, WIDTH))
+    xs, bs = mb.stack_scatter(x, m)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    stacked = stack_stage_params(params)
+
+    l_ref, g_ref = jax.value_and_grad(
+        lambda ps: plain_loss_fn(stage_fn, ps, x))(params)
+    g_ref = stack_stage_params(g_ref)
+
+    pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
+                             checkpoint=checkpoint, schedule=schedule)
+    loss, (gsp, _, _) = jax.jit(pipe.loss_and_grad)(
+        stacked, {}, {}, xs, w, key=jax.random.key(9))
+    np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(gsp),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pre_post_param_grads():
+    """Grads reach pre (embed-like) and post (loss-head) params, matching
+    the plain composition."""
+    n_stages, m = 2, 4
+    stage_fn, params = make_stage(n_stages, jax.random.key(0))
+    emb = Linear(WIDTH)
+    pre_p = emb.init(jax.random.key(10), jnp.zeros((1, 5)))
+    head = Linear(1)
+    post_p = head.init(jax.random.key(11), jnp.zeros((1, WIDTH)))
+
+    def pre(p, x, ctx):
+        return emb.apply(p, x)
+
+    def post(p, h, x_mb, ctx):
+        return jnp.squeeze(head.apply(p, h), -1) ** 2
+
+    mesh = make_mesh(n_stages, 1)
+    x = jax.random.normal(jax.random.key(1), (8, 5))
+    xs, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    stacked = stack_stage_params(params)
+
+    def plain(ps, pre_p, post_p):
+        h = emb.apply(pre_p, x)
+        for p in ps:
+            h = stage_fn(p, h, StageCtx())
+        return jnp.mean(jnp.squeeze(head.apply(post_p, h), -1) ** 2)
+
+    l_ref, (g_ps, g_pre_ref, g_post_ref) = jax.value_and_grad(
+        plain, argnums=(0, 1, 2))(params, pre_p, post_p)
+
+    pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre, post_fn=post,
+                             checkpoint="except_last", schedule="1f1b")
+    loss, (gsp, gpre, gpost) = jax.jit(pipe.loss_and_grad)(
+        stacked, pre_p, post_p, xs, w)
+    np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(gsp),
+                    jax.tree_util.tree_leaves(stack_stage_params(g_ps))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    for got, ref in ((gpre, g_pre_ref), (gpost, g_post_ref)):
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("checkpoint", ["always", "except_last", "never"])
+def test_dropout_matches_ad_executor_bitwise(checkpoint):
+    """Same key-folding scheme as SpmdPipeline → identical dropout masks →
+    identical loss, across executors. (The remat'd recompute replays the same
+    key — the reference's save/restore_rng_states, README.md:528-537.)"""
+    n_stages, m = 2, 4
+    stage_fn, params = make_stage(n_stages, jax.random.key(0), dropout=0.5)
+    mesh = make_mesh(n_stages, 1)
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+    xs, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    stacked = stack_stage_params(params)
+    key = jax.random.key(42)
+
+    sched = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
+                              checkpoint=checkpoint, schedule="1f1b")
+    loss_s, _ = jax.jit(sched.loss_and_grad)(stacked, {}, {}, xs, w, key=key)
+
+    ad = SpmdPipeline(mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
+                      post_with_batch=True, checkpoint=checkpoint)
+    per_row = ad(stacked, {}, {}, xs, key=key, train=True)
+    loss_ad = jnp.sum(per_row * w) / jnp.sum(w)
+    np.testing.assert_array_equal(np.asarray(loss_s), np.asarray(loss_ad))
+
+    # determinism: same key → same loss; different key → different
+    loss_s2, _ = jax.jit(sched.loss_and_grad)(stacked, {}, {}, xs, w, key=key)
+    np.testing.assert_array_equal(np.asarray(loss_s), np.asarray(loss_s2))
+    loss_s3, _ = jax.jit(sched.loss_and_grad)(
+        stacked, {}, {}, xs, w, key=jax.random.key(7))
+    assert not np.allclose(np.asarray(loss_s), np.asarray(loss_s3))
+
+
+# ---------- the memory story ----------
+
+def test_memory_plan_1f1b_caps_stash():
+    mesh = make_mesh(2, 1)
+    stage_fn, _ = make_stage(2, jax.random.key(0))
+    kw = dict(pre_fn=pre_fn, post_fn=post_fn)
+    m = 16
+    g = ScheduledPipeline(mesh, stage_fn, checkpoint="always",
+                          schedule="gpipe", **kw)
+    f = ScheduledPipeline(mesh, stage_fn, checkpoint="always",
+                          schedule="1f1b", **kw)
+    assert g.memory_plan(m)["stash_slots"] == m
+    assert f.memory_plan(m)["stash_slots"] == 2  # min(m, n): the 1F1B cap
+    # residual slots follow the checkpoint mode exactly
+    assert ScheduledPipeline(mesh, stage_fn, checkpoint="never",
+                             schedule="1f1b", **kw).memory_plan(m)[
+        "residual_slots"] == 2
+    assert ScheduledPipeline(mesh, stage_fn, checkpoint="except_last",
+                             schedule="1f1b", **kw).memory_plan(m)[
+        "residual_slots"] == 1
+
+
+def test_except_last_is_exact_per_microbatch():
+    """Count actual stage-body executions via a debug callback: always
+    recomputes every micro-batch at backward, except_last all but the last,
+    never none — the reference mode map (pipe.py:354) realized EXACTLY on the
+    compiled path, which the AD executor cannot do (static remat, spmd.py
+    docstring). Total executions = m*n forward + recomputed*n backward."""
+    n_stages, m = 2, 4
+    base_fn, params = make_stage(n_stages, jax.random.key(0))
+    mesh = make_mesh(n_stages, 1)
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+    xs, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    stacked = stack_stage_params(params)
+
+    calls = []
+
+    def stage_fn(p, h, ctx):
+        jax.debug.callback(lambda: calls.append(1))
+        return base_fn(p, h, ctx)
+
+    expected = {"always": m * n_stages + m * n_stages,
+                "except_last": m * n_stages + (m - 1) * n_stages,
+                "never": m * n_stages}
+    for mode, want in expected.items():
+        pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn,
+                                 post_fn=post_fn, checkpoint=mode,
+                                 schedule="1f1b")
+        calls.clear()
+        loss, grads = pipe.loss_and_grad(stacked, {}, {}, xs, w)
+        jax.block_until_ready((loss, grads))
+        jax.effects_barrier()
+        assert len(calls) == want, (mode, len(calls), want)
+
+
+# ---------- composition ----------
+
+def test_data_parallel_grads():
+    n_stages, n_data, m = 2, 2, 4
+    stage_fn, params = make_stage(n_stages, jax.random.key(0))
+    mesh = make_mesh(n_stages, n_data)
+    x = jax.random.normal(jax.random.key(1), (16, WIDTH))
+    xs, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    stacked = stack_stage_params(params)
+
+    l_ref, g_ref = jax.value_and_grad(
+        lambda ps: plain_loss_fn(stage_fn, ps, x))(params)
+    g_ref = stack_stage_params(g_ref)
+
+    pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
+                             checkpoint="except_last", schedule="1f1b")
+    loss, (gsp, _, _) = jax.jit(pipe.loss_and_grad)(stacked, {}, {}, xs, w)
+    np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(gsp),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_padded_row_masking():
+    """Zero-weighted (padding) rows contribute nothing: loss equals the
+    plain model on the real rows only."""
+    n_stages, m = 2, 4
+    stage_fn, params = make_stage(n_stages, jax.random.key(0))
+    mesh = make_mesh(n_stages, 1)
+    x10 = jax.random.normal(jax.random.key(1), (10, WIDTH))
+    xs, bs = mb.stack_scatter(x10, m)       # pads 10 -> 12 rows
+    assert bs == 10 and xs.shape[:2] == (4, 3)
+    idx = jnp.arange(12).reshape(4, 3)
+    w = (idx < 10).astype(jnp.float32)
+    stacked = stack_stage_params(params)
+
+    pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
+                             checkpoint="never", schedule="1f1b")
+    loss, _ = jax.jit(pipe.loss_and_grad)(stacked, {}, {}, xs, w)
+    l_ref = plain_loss_fn(stage_fn, params, x10)
+    np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
