@@ -142,12 +142,24 @@ class HeteroSpmdPipeline:
         dyn = {str(p): x for p, (x, k) in enumerate(zip(inputs, kinds))
                if k != "static"}
         stacked, bs = mb.stack_scatter(dyn, m)
-        mb_rows = next(v.shape[1] for p, v in stacked.items()
-                       if kinds[int(p)] == "array")
-        if mb_rows % self.n_data:
-            raise ValueError(
-                f"micro-batch rows {mb_rows} not divisible by data axis "
-                f"{self.n_data}")
+        true_rows = next(v.shape[1] for p, v in stacked.items()
+                         if kinds[int(p)] == "array")
+        # Rows must divide the data axis; zero-pad the shortfall (tiny
+        # batches / batch < chunks) and slice it back off after gather.
+        # Padded rows DO flow through the stages zeroed (as stack_scatter's
+        # chunk padding already does): row-wise math is unaffected after the
+        # slice, but cross-row batch statistics would see them — the same
+        # class of hazard micro-batching itself poses to BatchNorm, which is
+        # why Pipe routes stat-bearing models to deferred-BN (emulator-only).
+        mb_rows = -(-true_rows // self.n_data) * self.n_data
+        if mb_rows != true_rows:
+            def pad_rows(p, v):
+                if kinds[int(p)] != "array":
+                    return v
+                pad = [(0, 0), (0, mb_rows - true_rows)] + \
+                    [(0, 0)] * (v.ndim - 2)
+                return jnp.pad(v, pad)
+            stacked = {p: pad_rows(p, v) for p, v in stacked.items()}
         local_rows = mb_rows // self.n_data
 
         # --- local per-micro-batch boundary chain (+ skip lane specs) ----
@@ -169,11 +181,7 @@ class HeteroSpmdPipeline:
         specs = vals0
         with use_skip_tracker(spec_tracker):
             for jdx, part in enumerate(self.partitions):
-                out = part.out_spec(params[jdx],
-                                    *[s for s in specs
-                                      if isinstance(s, jax.ShapeDtypeStruct)]
-                                    ) if False else part.out_spec(
-                                        params[jdx], *specs)
+                out = part.out_spec(params[jdx], *specs)
                 specs = list(out) if isinstance(out, (tuple, list)) else [out]
                 boundaries.append(specs)
         lane_specs = [spec_tracker._store[(0, ns, name)]
@@ -221,6 +229,8 @@ class HeteroSpmdPipeline:
         stacked_out = run(tuple(params), stacked, key)
         # device n-1's slice holds the real outputs: [n, m, rows...] -> [m, ...]
         outs = tuple(o[-1] for o in stacked_out)
+        if mb_rows != true_rows:  # drop data-axis padding before gather
+            outs = tuple(o[:, :true_rows] for o in outs)
         gathered = tuple(mb.stack_gather(o, bs) for o in outs)
         return gathered if len(gathered) > 1 else gathered[0]
 

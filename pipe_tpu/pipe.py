@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Any, List, Optional, Sequence
 
 import jax
+from jax.sharding import Mesh
 
 from .core import microbatch as mb
 from .core.partition import (Stage, StageCtx, split_balance, verify_splitting,
@@ -50,8 +51,11 @@ class Pipe:
     ``(params, *inputs)`` to outputs. Executor selection:
 
     * no mesh (default): serial clock-cycle emulator, any stage shapes;
-    * ``mesh=``: SPMD shard_map executor over the ``stage`` axis (homogeneous
-      stage stack; see ``pipe_tpu.parallel.spmd``).
+    * ``mesh=``: compiled SPMD executor over the mesh's ``stage`` axis —
+      heterogeneous partitions via ``lax.switch`` stage bodies, uneven
+      balance, ``@skippable`` lanes, optional ``data`` axis (see
+      ``pipe_tpu.parallel.hetero``). Homogeneous stage-stacked models at
+      memory scale use ``pipe_tpu.parallel.spmd`` / ``.scheduled`` directly.
     """
 
     def __init__(self,
@@ -59,6 +63,7 @@ class Pipe:
                  chunks: int = 1,
                  checkpoint: str = "except_last",
                  *,
+                 mesh: Optional[Mesh] = None,
                  n_stages: Optional[int] = None,
                  balance: Optional[Sequence[int]] = None,
                  schedule: str = "gpipe",
@@ -89,10 +94,33 @@ class Pipe:
 
         if balance is not None and n_stages is None:
             n_stages = len(balance)
+        if mesh is not None:
+            from .parallel.mesh import STAGE_AXIS
+            if STAGE_AXIS not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh must have a {STAGE_AXIS!r} axis to drive a Pipe")
+            mesh_stages = mesh.shape[STAGE_AXIS]
+            if n_stages is None:
+                n_stages = mesh_stages
+            elif n_stages != mesh_stages:
+                raise ValueError(
+                    f"n_stages={n_stages} does not match the mesh's "
+                    f"{mesh_stages}-device stage axis")
+            if deferred_batch_norm:
+                raise NotImplementedError(
+                    "deferred_batch_norm requires the whole-minibatch stat "
+                    "commit, which only the serial emulator path performs; "
+                    "drop mesh= or deferred_batch_norm")
+            if schedule != "gpipe":
+                raise NotImplementedError(
+                    f"schedule={schedule!r} with mesh=: the hetero executor "
+                    "runs the GPipe wavefront; memory-capped 1F1B lives in "
+                    "pipe_tpu.parallel.scheduled (homogeneous stages)")
         if n_stages is None:
             n_stages = 1
         self.balance = split_balance(len(module), n_stages, balance)
         self.n_stages = n_stages
+        self.mesh = mesh
 
         # Partition the Sequential into per-stage sub-Sequentials
         # (reference _split_module/_assemble_partition, pipe.py:181-218).
@@ -118,6 +146,15 @@ class Pipe:
         # After verify_skippables, every declared stash/pop resolves to a
         # layout pair, so this single flag decides tracker creation.
         self._needs_skip_tracker = self.skip_layout.num_skips > 0
+
+        # mesh= selects the compiled SPMD executor (the reference's flagship
+        # multi-device product: Pipe.__init__ builds the multi-device
+        # Pipeline, pipe.py:344-356; forward runs it, pipe.py:431-494).
+        self._executor = None
+        if mesh is not None:
+            from .parallel.hetero import HeteroSpmdPipeline
+            self._executor = HeteroSpmdPipeline(
+                mesh, self.partitions, self.skip_layout, chunks, checkpoint)
 
     # --- container protocol (reference pipe.py:358-386) ---
 
@@ -170,6 +207,9 @@ class Pipe:
                  remat_policy=None):
         from .extras.norm import DeferredBatchNorm, commit_batchnorm_stats
 
+        if self._executor is not None:
+            return self._executor(params, *inputs, key=key, train=train,
+                                  remat_policy=remat_policy)
         mb.check(*inputs)
         batches = mb.scatter(inputs, self.chunks)
         has_bn = any(isinstance(l, DeferredBatchNorm) for l in self)
