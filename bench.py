@@ -53,6 +53,46 @@ def tutorial_config(platform: str) -> LMConfig:
                     seq_len=64)
 
 
+def train_flops_per_token(cfg: LMConfig, checkpoint: str):
+    """(required, hardware) FLOPs per trained token.
+
+    MAC counting: per layer, QKV+out projections 4*d^2 and FFN 2*d*d_ff; the
+    attention score/value matmuls add seq*d per token (causal halves the
+    window); the decoder projection d*vocab. One MAC = 2 FLOPs; backward
+    costs 2x forward. ``required`` is the standard MFU numerator (3x forward,
+    no recompute); ``hardware`` adds the remat re-forward the compiled AD
+    executor actually runs — every micro-batch's *stage body* whenever the
+    mode asks for any remat (spmd.py module docstring). Only the per-layer
+    term remats: jax.checkpoint wraps the stage body, not embed/decoder.
+    """
+    d, ff, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
+    eff_s = cfg.seq_len / 2 if cfg.causal else cfg.seq_len
+    layer_macs = L * (4 * d * d + 2 * d * ff + 2 * eff_s * d)
+    macs = layer_macs + d * V
+    remat = {"never": 0.0, "except_last": 1.0, "always": 1.0}[checkpoint]
+    required = 2 * macs * 3
+    hardware = required + 2 * layer_macs * remat
+    return required, hardware
+
+
+# bf16 peak FLOP/s per chip by device kind (dense; conservative defaults).
+_PEAK_BF16 = (
+    ("v6", 918e12),     # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5lite", 197e12),
+    ("v4", 275e12),
+)
+
+
+def peak_flops_per_chip() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, peak in _PEAK_BF16:
+        if tag in kind:
+            return peak
+    return 197e12  # unknown kind: assume v5e-class
+
+
 def make_step(model, spmd, tx):
     def train_step(params, opt_state, x, key):
         sp, prep, postp = params
@@ -177,6 +217,43 @@ def main():
     tokens_per_step = BATCH * cfg.seq_len
     pipe_tps_chip = tokens_per_step / sec_per_step / n_stages
 
+    # Measured bubble (slope method): re-time with 2x the micro-batch count
+    # at the same per-micro-batch shape, so (t_2m - t_m)/m is the real
+    # per-cycle cost. At n_stages=1 the analytic model says 0; this reports
+    # the honest machinery/dispatch residue.
+    from pipe_tpu.obs.meters import measured_bubble_slope
+    measured_bubble = None
+    try:
+        tokens2 = jnp.concatenate([tokens, tokens], axis=0)
+        targets2 = jnp.roll(tokens2, -1, axis=-1)
+        x2, _ = mb.stack_scatter({"tokens": tokens2, "targets": targets2},
+                                 2 * CHUNKS)
+        p2 = jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True),
+                                    plain_params)
+        p2 = (stack_stage_params(p2[0]), p2[1], p2[2])
+        sec_2m, _ = time_steps(step, p2, tx.init(p2), (x2, key))
+        measured_bubble = measured_bubble_slope(sec_per_step, sec_2m, CHUNKS)
+    except Exception as e:
+        print(f"bubble slope timing failed: {e}", file=sys.stderr)
+
+    # Multi-stage measured bubble: the one real chip cannot host a ppermute
+    # ring, so probe a 4-stage pipeline on the virtual 8-CPU mesh.
+    bubble_multistage = None
+    try:
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-m", "pipe_tpu.obs.bubble_probe", "4", "8"],
+            capture_output=True, text=True, timeout=600, env=env)
+        if out.returncode == 0:
+            bubble_multistage = json.loads(out.stdout.strip().splitlines()[-1])
+        else:
+            print(f"multi-stage bubble probe rc={out.returncode}: "
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"multi-stage bubble probe failed: {e}", file=sys.stderr)
+
     vs_baseline = vs_fullbatch = 0.0
     try:
         plain_acc = make_plain_step(model, tx, microbatches=CHUNKS)
@@ -197,6 +274,12 @@ def main():
     except Exception as e:  # baseline OOM etc. — report pipeline number alone
         print(f"plain baseline failed: {e}", file=sys.stderr)
 
+    req_tok, hw_tok = train_flops_per_token(cfg, CHECKPOINT)
+    model_flops = req_tok * tokens_per_step
+    peak = peak_flops_per_chip()
+    mfu = (req_tok * pipe_tps_chip) / peak
+    hfu = (hw_tok * pipe_tps_chip) / peak
+
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(pipe_tps_chip, 2),
@@ -204,11 +287,18 @@ def main():
         "vs_baseline": round(vs_baseline, 4),
         "vs_fullbatch": round(vs_fullbatch, 4),
         "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
         "n_stages": n_stages,
         "chunks": CHUNKS,
         "checkpoint": CHECKPOINT,
         "params": n_params,
+        "model_flops": model_flops,
+        "mfu": round(mfu, 4),
+        "hfu": round(hfu, 4),
         "analytic_bubble": round(bubble_fraction(CHUNKS, n_stages), 4),
+        "measured_bubble": (round(measured_bubble, 4)
+                            if measured_bubble is not None else None),
+        "measured_bubble_multistage": bubble_multistage,
         "final_loss": round(loss, 4),
         "config": dataclasses.asdict(
             dataclasses.replace(cfg, compute_dtype=str(cfg.compute_dtype))),

@@ -1,7 +1,7 @@
 """Observability: profiler scopes, bubble measurement, memory reporting."""
 
-from .meters import (BubbleMeter, device_memory_report, profile_trace,
-                     stage_scope)
+from .meters import (BubbleMeter, device_memory_report, measured_bubble_slope,
+                     profile_trace, stage_busy_from_trace, stage_scope)
 
-__all__ = ["BubbleMeter", "device_memory_report", "profile_trace",
-           "stage_scope"]
+__all__ = ["BubbleMeter", "device_memory_report", "measured_bubble_slope",
+           "profile_trace", "stage_busy_from_trace", "stage_scope"]

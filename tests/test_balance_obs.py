@@ -114,3 +114,43 @@ def test_profile_trace_writes(tmp_path):
 def test_device_memory_report():
     r = device_memory_report()
     assert "device memory profile" in r
+
+
+def test_measured_bubble_slope():
+    from pipe_tpu.obs.meters import measured_bubble_slope
+
+    # ideal pipeline: t(m) = a*(m+n-1) -> slope recovers analytic bubble
+    a, m, n = 0.01, 8, 4
+    t_m, t_2m = a * (m + n - 1), a * (2 * m + n - 1)
+    assert measured_bubble_slope(t_m, t_2m, m) == pytest.approx(
+        (n - 1) / (m + n - 1))
+    # pure constant overhead, no per-cycle cost -> bubble 1
+    assert measured_bubble_slope(1.0, 1.0, m) == pytest.approx(1.0)
+    # degenerate inputs
+    assert measured_bubble_slope(0.0, 1.0, m) == 0.0
+    # n=1, zero overhead: t scales linearly with m -> bubble 0
+    assert measured_bubble_slope(0.08, 0.16, 8) == pytest.approx(0.0)
+
+
+def test_merge_busy_ns_unions_overlaps():
+    from pipe_tpu.obs.meters import _merge_busy_ns
+
+    assert _merge_busy_ns([]) == 0.0
+    assert _merge_busy_ns([(0.0, 10.0), (5.0, 15.0)]) == pytest.approx(15.0)
+    assert _merge_busy_ns([(20.0, 30.0), (0.0, 10.0)]) == pytest.approx(20.0)
+    assert _merge_busy_ns([(0.0, 5.0), (1.0, 2.0)]) == pytest.approx(5.0)
+
+
+def test_stage_busy_from_trace_cpu(tmp_path):
+    """On the virtual CPU platform there are no /device: planes; the parser
+    must return cleanly with just the span key (slope method is the CPU
+    fallback)."""
+    from pipe_tpu.obs.meters import stage_busy_from_trace
+
+    logdir = str(tmp_path / "trace")
+    with profile_trace(logdir):
+        jax.block_until_ready(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+    busy = stage_busy_from_trace(logdir)
+    assert "_span" in busy
+    for k, v in busy.items():
+        assert v >= 0.0
