@@ -79,15 +79,15 @@ def _accepts_ctx(fn: Callable) -> bool:
 
 @dataclasses.dataclass
 class Stage:
-    """One pipeline stage: a pure function plus its parameter pytree.
+    """One pipeline stage: a pure function applied to per-stage params.
 
     ``fn(params, *inputs, ctx=StageCtx)`` maps the micro-batch payload to the
     stage output (the reference's "partition forward", ``README.md:291-314``).
-    Plain functions without a ``ctx`` parameter are adapted automatically.
+    Plain functions without a ``ctx`` parameter are adapted automatically;
+    params are always passed at call time (pure-program convention).
     """
 
     fn: Callable
-    params: Any = None
     name: str = "stage"
 
     def __post_init__(self):

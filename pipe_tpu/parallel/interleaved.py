@@ -96,6 +96,24 @@ class InterleavedSpmdPipeline:
             self._post = lambda p, h, x_mb, ctx: self.post_fn(p, h, ctx)
 
     # -----------------------------------------------------------------
+    def memory_plan(self, m: int) -> dict:
+        """Static per-device buffer counts — the memory story, inspectable.
+
+        The bubble/v win is bought with O(m) per-device buffers: every
+        micro-batch needs an activation slot because each device revisits it
+        once per interleave group (plus AD residuals across the
+        ``m*v + d - 1``-cycle scan), and the schedule needs ``m >= d`` so a
+        slot frees before its next-group replacement arrives. GPipe's AD
+        executor carries no slot buffer at all (its O(m) liveness is in AD
+        residuals); the memory-capped alternative is
+        :class:`~pipe_tpu.parallel.scheduled.ScheduledPipeline` (1F1B,
+        ``min(m, n)`` stashed inputs).
+        """
+        d, v = self.n_devices, self.v
+        return {"cycles": m * v + d - 1, "activation_slots": m,
+                "out_slots": m, "min_microbatches": d}
+
+    # -----------------------------------------------------------------
     def __call__(self, stage_params, pre_params, post_params, x,
                  *, key: Optional[jax.Array] = None, train: bool = False):
         """Run on micro-batched ``x`` ([m, mb, ...] pytree); returns stacked
@@ -161,10 +179,12 @@ class InterleavedSpmdPipeline:
             post_params, h_spec, x_mb_spec)
 
         zeros = lambda s: jnp.zeros(s.shape, s.dtype)
+        # Slot m is a garbage slot: masked writes go there unconditionally
+        # instead of a per-cycle lax.cond around each buffer update.
         buf = jax.tree_util.tree_map(
-            lambda s: jnp.zeros((m,) + tuple(s.shape), s.dtype), h_spec)
+            lambda s: jnp.zeros((m + 1,) + tuple(s.shape), s.dtype), h_spec)
         outbuf = jax.tree_util.tree_map(
-            lambda s: jnp.zeros((m,) + tuple(s.shape), s.dtype), out_spec)
+            lambda s: jnp.zeros((m + 1,) + tuple(s.shape), s.dtype), out_spec)
 
         def idx_tree(tree, i):
             return jax.tree_util.tree_map(
@@ -172,12 +192,10 @@ class InterleavedSpmdPipeline:
                                                        keepdims=False), tree)
 
         def set_tree(tree, i, val, pred):
+            widx = jnp.where(pred, i, m)
             return jax.tree_util.tree_map(
-                lambda buf_l, v_l: jax.lax.cond(
-                    pred,
-                    lambda: jax.lax.dynamic_update_index_in_dim(
-                        buf_l, v_l.astype(buf_l.dtype), i, 0),
-                    lambda: buf_l),
+                lambda buf_l, v_l: jax.lax.dynamic_update_index_in_dim(
+                    buf_l, v_l.astype(buf_l.dtype), widx, 0),
                 tree, val)
 
         def body(params_g, k, h):
@@ -237,4 +255,5 @@ class InterleavedSpmdPipeline:
 
         (buf, outbuf), _ = jax.lax.scan(
             cycle, (buf, outbuf), jnp.arange(m * v + d - 1))
-        return jax.tree_util.tree_map(lambda b: b[None], outbuf)
+        # drop the garbage slot before stacking under the stage axis
+        return jax.tree_util.tree_map(lambda b: b[:m][None], outbuf)

@@ -102,6 +102,12 @@ class SpmdPipeline:
     # raw per-token logits) would silently return one shard's values.
     context_axis: Optional[str] = None
     context_dim: int = 2
+    # Debug mode for the context-invariance contract above: verify at run
+    # time that post_fn's output really is identical across context shards
+    # (vma checking is off, so a forgotten pmean would otherwise silently
+    # return one shard's values). On violation every inexact output leaf is
+    # poisoned with NaN and a debug line is printed — loud by construction.
+    debug_context_check: bool = False
 
     def __post_init__(self):
         validate_mode(self.checkpoint)
@@ -124,6 +130,45 @@ class SpmdPipeline:
             self._post = self.post_fn
         else:
             self._post = lambda p, h, x_mb, ctx: self.post_fn(p, h, ctx)
+        # _post_spec: the unchecked form, for eval_shape outside shard_map
+        # (the checker's pmean needs the mesh axis bound).
+        self._post_spec = self._post
+        if self.context_axis and self.debug_context_check:
+            self._post = self._context_checked(self._post)
+
+    def _context_checked(self, post):
+        """Wrap post so a context-variant output turns into NaN + a print.
+
+        A correct post ends in a collective over the context axis (pmean /
+        psum), which by definition leaves every shard with the same value —
+        so any cross-shard deviation is a contract violation, not noise.
+        """
+        axis = self.context_axis
+
+        def checked(p, h, x_mb, ctx):
+            out = post(p, h, x_mb, ctx)
+            leaves = [o for o in jax.tree_util.tree_leaves(out)
+                      if jnp.issubdtype(o.dtype, jnp.inexact)]
+            if not leaves:
+                return out
+            delta = jnp.max(jnp.stack([
+                jnp.max(jnp.abs((o - jax.lax.pmean(o, axis))
+                                .astype(jnp.float32))) for o in leaves]))
+            bad = delta > 1e-5
+            jax.lax.cond(
+                bad,
+                lambda: jax.debug.print(
+                    "pipe_tpu context-invariance VIOLATION: post_fn output "
+                    "differs across context shards by {d:.3e}; it must end "
+                    "in a pmean/psum over the context axis. Outputs are "
+                    "poisoned with NaN.", d=delta),
+                lambda: None)
+            poison = jnp.where(bad, jnp.float32(jnp.nan), jnp.float32(0))
+            return jax.tree_util.tree_map(
+                lambda o: o + poison.astype(o.dtype)
+                if jnp.issubdtype(o.dtype, jnp.inexact) else o, out)
+
+        return checked
 
     # -----------------------------------------------------------------
     def __call__(self, stage_params, pre_params, post_params, x,
@@ -153,7 +198,7 @@ class SpmdPipeline:
         h_spec = jax.eval_shape(
             lambda p, a: self._pre(p, a, ctx0), pre_params, x_mb_spec)
         out_spec = jax.eval_shape(
-            lambda p, h, a: self._post(p, h, a, ctx0),
+            lambda p, h, a: self._post_spec(p, h, a, ctx0),
             post_params, h_spec, x_mb_spec)
 
         def x_spec(l):
@@ -203,7 +248,7 @@ class SpmdPipeline:
         h_spec = jax.eval_shape(
             lambda p, a: self._pre(p, a, ctx0), pre_params, x_mb_spec)
         out_spec = jax.eval_shape(
-            lambda p, h, a: self._post(p, h, a, ctx0),
+            lambda p, h, a: self._post_spec(p, h, a, ctx0),
             post_params, h_spec, x_mb_spec)
 
         h0 = jax.tree_util.tree_map(

@@ -118,3 +118,47 @@ def test_pp_cp_trains():
         p3, l = step(p3)
         losses.append(float(l))
     assert losses[-1] < losses[0], losses
+
+
+def test_debug_context_check_passes_and_poisons():
+    """debug_context_check: a pmean'd post passes untouched; a post that
+    forgets the context reduction is poisoned with NaN instead of silently
+    returning one shard's values (the check_vma=False contract, made loud)."""
+    n_stages, n_context, chunks, seq, rows = 2, 2, 2, 32, 4
+    cfg = dataclasses.replace(tiny_cfg(seq), n_layers=n_stages)
+    model = ContextParallelLM(cfg, n_stages)
+    sp, prep, postp = model.init(jax.random.key(0))
+    stacked = stack_stage_params(sp)
+    mesh = make_mesh(n_stages, 1, n_context=n_context)
+    tokens = jax.random.randint(jax.random.key(1), (rows * chunks, seq),
+                                0, cfg.vocab, jnp.int32)
+    x, _ = mb.stack_scatter({"tokens": tokens,
+                             "targets": jnp.roll(tokens, -1, -1)}, chunks)
+
+    good = SpmdPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
+                        post_fn=model.loss_post_fn, post_with_batch=True,
+                        context_axis=CONTEXT_AXIS, debug_context_check=True)
+    out = good(stacked, prep, postp, x)
+    assert np.isfinite(np.asarray(out)).all()
+
+    def bad_post(p, h, x_mb, ctx):
+        # context-VARIANT: each shard returns its own first local token id
+        # (different global positions per shard; no pmean reduction)
+        return x_mb["tokens"][:, 0].astype(jnp.float32)
+
+    bad = SpmdPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
+                       post_fn=bad_post, post_with_batch=True,
+                       context_axis=CONTEXT_AXIS, debug_context_check=True)
+    out = bad(stacked, prep, postp, x)
+    assert np.isnan(np.asarray(out)).all(), \
+        "context-variant post must be poisoned"
+
+
+def test_interleaved_memory_plan():
+    from pipe_tpu.parallel.interleaved import InterleavedSpmdPipeline
+
+    mesh = make_mesh(2, 1)
+    pipe = InterleavedSpmdPipeline(mesh, lambda p, h, ctx: h, v=2)
+    plan = pipe.memory_plan(8)
+    assert plan == {"cycles": 8 * 2 + 1, "activation_slots": 8,
+                    "out_slots": 8, "min_microbatches": 2}
