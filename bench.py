@@ -170,19 +170,27 @@ def make_plain_step(model, tx, microbatches: int = 1):
 
 
 def time_steps(step_fn, params, opt_state, args, warmup=2, iters=8):
-    """Per-step wall time with a host value fetch every step.
+    """Per-step wall time with a ONE-STEP-LAGGED host value fetch.
 
-    ``float(loss)`` forces a real device->host read of computed data each
-    iteration — immune to async-dispatch/readiness quirks of remote-execution
-    PJRT bridges, unlike ``block_until_ready`` bulk timing.
+    Every step's loss is still read back to the host (real computed data —
+    immune to async-dispatch/readiness quirks of remote-execution PJRT
+    bridges), but step i's fetch happens while step i+1 executes, so the
+    host<->device round-trip overlaps compute instead of serializing after
+    it. Over the remote-TPU tunnel the synchronous fetch costs ~95 ms/step
+    (~30% of a step) of pure RTT that never touches the chip; measured
+    lagged == bulk ``block_until_ready`` timing to <1%.
     """
     for _ in range(warmup):
         params, opt_state, loss = step_fn(params, opt_state, *args)
     float(loss)
+    prev = None
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, loss = step_fn(params, opt_state, *args)
-        last = float(loss)
+        if prev is not None:
+            float(prev)
+        prev = loss
+    last = float(prev)
     return (time.perf_counter() - t0) / iters, last
 
 

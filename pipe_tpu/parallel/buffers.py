@@ -1,0 +1,42 @@
+"""Sentinel-slot buffer idiom shared by the compiled executors.
+
+A per-cycle conditional buffer update (``lax.cond`` around a
+``dynamic_update_index_in_dim``) costs a real branch in the scan hot loop.
+The executors instead allocate one extra *sentinel* slot and always write,
+masking only the index::
+
+    buf   = slot_buffer(spec_tree, m)          # m real slots + 1 sentinel
+    buf   = masked_slot_write(buf, val, i, pred, m)
+    real  = drop_sentinel(buf, m)              # [:m]
+
+Invalid cycles land in slot ``m`` (never read, dropped at the end), valid
+ones in their real slot — uniform per-cycle code, no branch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["slot_buffer", "masked_slot_write", "drop_sentinel"]
+
+
+def slot_buffer(spec_tree, slots: int):
+    """Zeros of ``[slots + 1, *leaf.shape]`` per leaf (last slot = sentinel)."""
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros((slots + 1,) + tuple(s.shape), s.dtype),
+        spec_tree)
+
+
+def masked_slot_write(buf_tree, val_tree, index, pred, sentinel: int):
+    """Write ``val`` at ``index`` where ``pred``, else into the sentinel."""
+    widx = jnp.where(pred, index, sentinel)
+    return jax.tree_util.tree_map(
+        lambda buf, v: jax.lax.dynamic_update_index_in_dim(
+            buf, v.astype(buf.dtype), widx, 0),
+        buf_tree, val_tree)
+
+
+def drop_sentinel(buf_tree, slots: int):
+    """The real slots: ``leaf[:slots]`` per leaf."""
+    return jax.tree_util.tree_map(lambda b: b[:slots], buf_tree)

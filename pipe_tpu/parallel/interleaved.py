@@ -178,13 +178,13 @@ class InterleavedSpmdPipeline:
             lambda pp, h, a: self._post(pp, h, a, ctx0),
             post_params, h_spec, x_mb_spec)
 
+        from .buffers import drop_sentinel, masked_slot_write, slot_buffer
+
         zeros = lambda s: jnp.zeros(s.shape, s.dtype)
-        # Slot m is a garbage slot: masked writes go there unconditionally
+        # Slot m is the sentinel: masked writes go there unconditionally
         # instead of a per-cycle lax.cond around each buffer update.
-        buf = jax.tree_util.tree_map(
-            lambda s: jnp.zeros((m + 1,) + tuple(s.shape), s.dtype), h_spec)
-        outbuf = jax.tree_util.tree_map(
-            lambda s: jnp.zeros((m + 1,) + tuple(s.shape), s.dtype), out_spec)
+        buf = slot_buffer(h_spec, m)
+        outbuf = slot_buffer(out_spec, m)
 
         def idx_tree(tree, i):
             return jax.tree_util.tree_map(
@@ -192,11 +192,7 @@ class InterleavedSpmdPipeline:
                                                        keepdims=False), tree)
 
         def set_tree(tree, i, val, pred):
-            widx = jnp.where(pred, i, m)
-            return jax.tree_util.tree_map(
-                lambda buf_l, v_l: jax.lax.dynamic_update_index_in_dim(
-                    buf_l, v_l.astype(buf_l.dtype), widx, 0),
-                tree, val)
+            return masked_slot_write(tree, val, i, pred, m)
 
         def body(params_g, k, h):
             return self.stage_fn(params_g, h,
@@ -255,5 +251,6 @@ class InterleavedSpmdPipeline:
 
         (buf, outbuf), _ = jax.lax.scan(
             cycle, (buf, outbuf), jnp.arange(m * v + d - 1))
-        # drop the garbage slot before stacking under the stage axis
-        return jax.tree_util.tree_map(lambda b: b[:m][None], outbuf)
+        # drop the sentinel slot before stacking under the stage axis
+        return jax.tree_util.tree_map(
+            lambda b: b[None], drop_sentinel(outbuf, m))

@@ -251,17 +251,25 @@ class SpmdPipeline:
             lambda p, h, a: self._post_spec(p, h, a, ctx0),
             post_params, h_spec, x_mb_spec)
 
+        from .buffers import drop_sentinel, masked_slot_write, slot_buffer
+
         h0 = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), h_spec)
-        # One extra garbage slot so invalid cycles write unconditionally
+        # Sentinel slot: invalid cycles write unconditionally into slot m
         # (masked index instead of a per-cycle lax.cond around the update).
-        outbuf = jax.tree_util.tree_map(
-            lambda s: jnp.zeros((m + 1,) + tuple(s.shape), s.dtype), out_spec)
+        outbuf = slot_buffer(out_spec, m)
+
+        # Stage 0's ingest slices ride the scan's xs; the same buffer (its
+        # first m slices) serves the last stage's x_i gathers — one copy,
+        # padded with repeats of the final micro-batch for the drain cycles.
+        x_fill = jax.tree_util.tree_map(
+            lambda l: jnp.concatenate([l] + [l[-1:]] * (n - 1), axis=0)
+            if n > 1 else l, x)
 
         def index_x(idx):
             return jax.tree_util.tree_map(
                 lambda l: jax.lax.dynamic_index_in_dim(
-                    l, idx, 0, keepdims=False), x)
+                    l, idx, 0, keepdims=False), x_fill)
 
         def body(p, k, h):
             return self.stage_fn(p, h, StageCtx(key=k, train=train))
@@ -272,12 +280,13 @@ class SpmdPipeline:
             body = jax.checkpoint(body, policy=self.remat_policy) \
                 if self.remat_policy is not None else jax.checkpoint(body)
 
-        def single_stage_cycle(carry, t):
+        def single_stage_cycle(_, xs_t):
             # n == 1: no ring, no fill/drain, every cycle valid — degrade to
             # straight-line micro-batch accumulation with zero schedule
             # machinery (this is what the vs_baseline contract measures).
-            h, outbuf = carry
-            x_t = index_x(t)
+            # x rides the scan's xs and out its stacked ys: no carry, no
+            # per-cycle gathers or buffer updates.
+            x_t, t = xs_t
             ctx_key = jax.random.fold_in(jax.random.fold_in(key, t), 0)
             h = self._pre(pre_params, x_t,
                           StageCtx(key=jax.random.fold_in(ctx_key, 0),
@@ -286,15 +295,13 @@ class SpmdPipeline:
             out_t = self._post(post_params, h, x_t,
                                StageCtx(key=jax.random.fold_in(ctx_key, 2),
                                         train=train))
-            outbuf = jax.tree_util.tree_map(
-                lambda buf, o: jax.lax.dynamic_update_index_in_dim(
-                    buf, o, t, 0), outbuf, out_t)
-            return (h, outbuf), None
+            return None, out_t
 
-        def cycle(carry, t):
+        def cycle(carry, xs_t):
             h, outbuf = carry
-            # --- stage 0 ingests micro-batch t (clamped during drain) ---
-            x_t = index_x(jnp.clip(t, 0, m - 1))
+            # --- stage 0 ingests micro-batch t (clamped during drain);
+            # its slice rides the scan's xs, not a per-cycle gather ---
+            x_t, t = xs_t
             i = t - j  # micro-batch index in flight on this device
             ctx_key = jax.random.fold_in(jax.random.fold_in(key, i), j)
 
@@ -308,20 +315,19 @@ class SpmdPipeline:
 
             h = body(params_j, jax.random.fold_in(ctx_key, 1), h)
 
-            # --- last stage emits output for valid micro-batches ---
+            # --- last stage emits output for valid micro-batches (the x_i
+            # gather lives inside the branch: only the last stage pays) ---
             valid = (j == n - 1) & (i >= 0) & (i < m)
-            x_i = index_x(jnp.clip(i, 0, m - 1))
             out_t = jax.lax.cond(
                 valid,
-                lambda: self._post(post_params, h, x_i,
+                lambda: self._post(post_params, h,
+                                   index_x(jnp.clip(i, 0, m - 1)),
                                    StageCtx(key=jax.random.fold_in(ctx_key, 2),
                                             train=train)),
                 lambda: jax.tree_util.tree_map(
                     lambda s: jnp.zeros(s.shape, s.dtype), out_spec))
-            widx = jnp.where(valid, jnp.clip(i, 0, m - 1), m)
-            outbuf = jax.tree_util.tree_map(
-                lambda buf, o: jax.lax.dynamic_update_index_in_dim(
-                    buf, o, widx, 0), outbuf, out_t)
+            outbuf = masked_slot_write(outbuf, out_t,
+                                       jnp.clip(i, 0, m - 1), valid, m)
 
             # --- ring shift: stage j -> j+1 (XLA collective-permute) ---
             perm = [(k, k + 1) for k in range(n - 1)]
@@ -329,10 +335,14 @@ class SpmdPipeline:
                 lambda a: jax.lax.ppermute(a, STAGE_AXIS, perm), h)
             return (h, outbuf), None
 
+        if n == 1:
+            _, outs = jax.lax.scan(single_stage_cycle, None,
+                                   (x, jnp.arange(m)))
+            return jax.tree_util.tree_map(lambda b: b[None], outs)
         (h, outbuf), _ = jax.lax.scan(
-            single_stage_cycle if n == 1 else cycle,
-            (h0, outbuf), jnp.arange(m + n - 1))
-        # Drop the garbage slot; stack on a leading stage axis so
+            cycle, (h0, outbuf), (x_fill, jnp.arange(m + n - 1)))
+        # Drop the sentinel slot; stack on a leading stage axis so
         # out_specs=P(stage,...) is exact (device j contributes its outbuf as
         # slice j; only j=n-1 is real).
-        return jax.tree_util.tree_map(lambda b: b[:m][None], outbuf)
+        return jax.tree_util.tree_map(
+            lambda b: b[None], drop_sentinel(outbuf, m))

@@ -173,6 +173,21 @@ def test_skip_gradients_through_mesh(checkpoint):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_skip_pop_at_middle_stage_through_mesh():
+    """Pop at an interior stage (1 of 4): the lane leaves the ring mid-
+    pipeline while later stages keep computing."""
+    seq = Sequential([StashLong(), Linear(WIDTH), PopLong(), Linear(WIDTH),
+                      Linear(WIDTH), Linear(WIDTH)])
+    mesh_pipe = Pipe(seq, chunks=2, checkpoint="never", mesh=stage_mesh(4),
+                     balance=[1, 2, 2, 1])
+    emu_pipe = Pipe(seq, chunks=2, checkpoint="never", balance=[1, 2, 2, 1])
+    sp = mesh_pipe.init(jax.random.key(2), jnp.zeros((2, WIDTH)))
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+    np.testing.assert_allclose(np.asarray(mesh_pipe(sp, x)),
+                               np.asarray(emu_pipe(sp, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_two_namespaced_skips_through_mesh():
     """Two instances of the same skippable pair, isolated by Namespace —
     two independent lanes on the ring."""
@@ -307,3 +322,47 @@ def test_mesh_deferred_batch_norm_rejected():
     seq, _ = make_mlp(jax.random.key(0))
     with pytest.raises(NotImplementedError):
         Pipe(seq, chunks=2, mesh=stage_mesh(2), deferred_batch_norm=True)
+
+
+# ---------- the reference's headline use: the tutorial LM through Pipe ----
+
+def test_tutorial_lm_through_pipe_mesh():
+    """Encoder + blocks + Decoder (reference main.py:139-157) driven by
+    Pipe(mesh=...) — heterogeneous partitions (embed / blocks / decode) on
+    the compiled executor, matching the plain Sequential."""
+    import dataclasses
+
+    from pipe_tpu.models.transformer_lm import LMConfig, build_sequential
+
+    cfg = dataclasses.replace(LMConfig().tiny(), n_layers=2, dropout=0.0)
+    seq = build_sequential(cfg)
+    # 5 layers (embed, posenc, 2 blocks, decoder) over 2 uneven stages
+    pipe = Pipe(seq, chunks=2, checkpoint="except_last",
+                mesh=stage_mesh(2), balance=[3, 2])
+    tokens = jnp.zeros((2, cfg.seq_len), jnp.int32)
+    sp = pipe.init(jax.random.key(0), tokens)
+
+    x = jax.random.randint(jax.random.key(1), (4, cfg.seq_len),
+                           0, cfg.vocab, jnp.int32)
+    flat = [p for stage in sp for p in stage]
+    expected = seq.apply(flat, x)
+    got = pipe(sp, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+
+    emu = Pipe(seq, chunks=2, checkpoint="except_last", balance=[3, 2])
+
+    def loss_mesh(p):
+        logits = pipe(p, x, key=jax.random.key(3), train=True)
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    def loss_emu(p):
+        logits = emu(p, x, key=jax.random.key(3), train=True)
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    gm = jax.grad(loss_mesh)(sp)
+    ge = jax.grad(loss_emu)(sp)
+    for a, b in zip(jax.tree_util.tree_leaves(gm),
+                    jax.tree_util.tree_leaves(ge)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5)

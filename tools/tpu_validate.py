@@ -28,15 +28,23 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def xla_attention(q, k, v, causal):
+def xla_attention(q, k, v, causal, precision=jax.lax.Precision.HIGHEST):
+    """Reference attention. On TPU a default-precision f32 einsum already
+    runs as ONE bf16-input MXU pass (f32 accumulate), so the true-f32
+    reference must force ``Precision.HIGHEST`` (bf16x3 passes); calling with
+    ``Precision.DEFAULT`` instead yields exactly the single-pass hardware
+    semantics — that is the accuracy yardstick the kernel is held to."""
     s = q.shape[1]
     scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=precision,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         mask = np.tril(np.ones((s, s), bool))
         logits = jnp.where(mask[None, None], logits, -1e30)
     w = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(q.dtype), v)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(q.dtype), v,
+                      precision=precision,
+                      preferred_element_type=jnp.float32)
 
 
 def max_err(a, b):
@@ -59,14 +67,22 @@ def main() -> int:
     k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
     v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
 
-    # 1) forward parity
+    # 1) forward parity. Yardstick: the error the MXU's own single-pass
+    # bf16-input semantics (Precision.DEFAULT) makes against the forced-f32
+    # reference (Precision.HIGHEST, bf16x3). The kernel's matmuls use the
+    # same single-pass hardware mode, so it must land within 1.5x of that.
     for causal in (True, False):
         err = max_err(jax.jit(lambda q, k, v: flash_attention(
             q, k, v, causal=causal))(q, k, v),
             xla_attention(q, k, v, causal))
+        hw_err = max_err(
+            xla_attention(q, k, v, causal, jax.lax.Precision.DEFAULT),
+            xla_attention(q, k, v, causal))
+        tol = max(2e-3, 1.5 * hw_err)
         results["checks"][f"fwd_parity_causal={causal}"] = {
-            "max_abs_err": err, "pass": err < 2e-3}
-        ok &= err < 2e-3
+            "max_abs_err": err, "hardware_mode_err": hw_err,
+            "tol": tol, "pass": err < tol}
+        ok &= err < tol
 
     # 2) gradient parity
     def loss_flash(q, k, v):
@@ -77,10 +93,15 @@ def main() -> int:
 
     gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
     gx = jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2)))(q, k, v)
+    # per-tensor relative error (dq/dk/dv scales differ; normalizing the
+    # joint max by one tensor's scale would give spurious verdicts)
+    rels = [max_err(a, b) / max(float(jnp.max(jnp.abs(b))), 1e-9)
+            for a, b in zip(gf, gx)]
     err = max(max_err(a, b) for a, b in zip(gf, gx))
-    rel = err / max(float(jnp.max(jnp.abs(gx[0]))), 1e-9)
-    results["checks"]["grad_parity"] = {"max_abs_err": err,
-                                        "rel": rel, "pass": rel < 2e-2}
+    rel = max(rels)
+    results["checks"]["grad_parity"] = {
+        "max_abs_err": err, "rel_per_tensor": [round(r, 6) for r in rels],
+        "rel": rel, "pass": rel < 2e-2}
     ok &= rel < 2e-2
 
     if backend != "tpu":
