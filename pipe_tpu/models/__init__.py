@@ -1,0 +1,25 @@
+"""Model zoo: the tutorial LM plus the BASELINE.json config families.
+
+* :mod:`.transformer_lm` — WikiText-2 tutorial parity (reference main.py).
+* :mod:`.long_context_lm` — ring-attention context-parallel LM (PP x CP).
+* :mod:`.gpt2` — GPT-2 small/medium causal LM, optional @skippable
+  embedding shortcut (BASELINE config #3).
+* :mod:`.bert` — BERT-large MLM pretraining, interleave-ready (config #4).
+* :mod:`.vit` — ViT-L/16 image classification, non-LM shapes (config #5).
+"""
+
+from .bert import BertConfig, PipelinedBERT, mask_tokens
+from .common import PipelinedTransformer, per_row_ce
+from .gpt2 import GPT2Config, PipelinedGPT2
+from .long_context_lm import ContextParallelLM
+from .transformer_lm import LMConfig, PipelinedLM
+from .vit import PipelinedViT, ViTConfig
+
+__all__ = [
+    "BertConfig", "PipelinedBERT", "mask_tokens",
+    "ContextParallelLM",
+    "GPT2Config", "PipelinedGPT2",
+    "LMConfig", "PipelinedLM",
+    "PipelinedTransformer", "per_row_ce",
+    "PipelinedViT", "ViTConfig",
+]

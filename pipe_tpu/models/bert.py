@@ -1,0 +1,162 @@
+"""BERT model family, pipelined (BASELINE.json config #4: 8-stage
+BERT-large pretraining, chunks=32, interleaved schedule).
+
+Architecture: word + learned position embeddings -> LayerNorm -> dropout,
+post-LN bidirectional encoder blocks with GELU (the BERT lineage is post-LN,
+so the tutorial's :class:`~pipe_tpu.ops.layers.TransformerEncoderLayer` is
+the stage body with ``causal=False``), and an MLM head (dense + GELU + LN +
+vocab projection). Pretraining here is masked-LM only; the NSP head and
+segment-pair plumbing are out of scope (modern BERT-lineage pretraining
+drops NSP anyway), documented divergence.
+
+The in-pipeline loss contract: ``x_mb = {"tokens": masked input ids,
+"targets": original ids, "mlm_weights": [rows, seq] 1.0 at masked
+positions}`` — per-row masked mean CE so only the ~15% masked positions
+contribute. :func:`mask_tokens` implements the 80/10/10 corruption.
+
+``PipelinedBERT(cfg, n_virtual)`` factors the 24 layers into any divisor —
+8 devices x interleave 3 covers the BASELINE 8-stage interleaved config via
+``InterleavedSpmdPipeline(v=3)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.partition import StageCtx
+from ..ops.layers import (Dropout, LayerNorm, Linear, Module,
+                          Sequential, TransformerEncoderLayer, spec)
+from .common import PipelinedTransformer, per_row_ce
+
+__all__ = ["BertConfig", "mask_tokens", "build_sequential", "PipelinedBERT"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    """BERT-large by default (340M: 24 layers, d=1024, 16 heads)."""
+
+    vocab: int = 30522
+    d_model: int = 1024
+    nhead: int = 16
+    d_ff: int = 4096
+    n_layers: int = 24
+    dropout: float = 0.1
+    seq_len: int = 512
+    mask_token_id: int = 103       # [MASK] in the WordPiece vocab
+    compute_dtype: Any = jnp.float32
+
+    def tiny(self) -> "BertConfig":
+        return dataclasses.replace(
+            self, vocab=101, d_model=16, nhead=2, d_ff=64, n_layers=4,
+            seq_len=16, dropout=0.0, mask_token_id=1)
+
+
+def mask_tokens(key: jax.Array, tokens: jax.Array, cfg: BertConfig,
+                mask_rate: float = 0.15) -> Tuple[jax.Array, jax.Array]:
+    """BERT 80/10/10 corruption: returns ``(masked_tokens, mlm_weights)``.
+
+    Of the ``mask_rate`` selected positions, 80% become ``[MASK]``, 10% a
+    random id, 10% stay unchanged; ``mlm_weights`` is 1.0 exactly at the
+    selected positions (the loss targets).
+    """
+    ks, km, kr = jax.random.split(key, 3)
+    selected = jax.random.bernoulli(ks, mask_rate, tokens.shape)
+    roll = jax.random.uniform(km, tokens.shape)
+    random_ids = jax.random.randint(kr, tokens.shape, 0, cfg.vocab,
+                                    tokens.dtype)
+    corrupted = jnp.where(
+        roll < 0.8, jnp.asarray(cfg.mask_token_id, tokens.dtype),
+        jnp.where(roll < 0.9, random_ids, tokens))
+    masked = jnp.where(selected, corrupted, tokens)
+    return masked, selected.astype(jnp.float32)
+
+
+class BertEmbed(Module):
+    """Word + learned position embeddings, LayerNorm, dropout."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+        self.ln = LayerNorm()
+        self.drop = Dropout(cfg.dropout)
+        self.name = "bert_embed"
+
+    def init(self, key, tokens):
+        cfg = self.cfg
+        kw, kp, kl = jax.random.split(key, 3)
+        h = jax.ShapeDtypeStruct(jnp.shape(tokens) + (cfg.d_model,),
+                                 jnp.float32)
+        return {
+            "word": 0.02 * jax.random.normal(
+                kw, (cfg.vocab, cfg.d_model), jnp.float32),
+            "pos": 0.02 * jax.random.normal(
+                kp, (cfg.seq_len, cfg.d_model), jnp.float32),
+            "ln": self.ln.init(kl, h),
+        }
+
+    def apply(self, params, tokens, ctx: StageCtx = StageCtx()):
+        s = tokens.shape[-1]
+        h = jnp.take(params["word"], tokens, axis=0) + params["pos"][:s]
+        h = self.ln.apply(params["ln"], h, ctx=ctx)
+        return self.drop.apply({}, h, ctx=ctx).astype(self.cfg.compute_dtype)
+
+
+class MLMHead(Module):
+    """Transform (dense + GELU + LN) then vocab projection."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+        self.dense = Linear(cfg.d_model)
+        self.ln = LayerNorm()
+        self.proj = Linear(cfg.vocab)
+        self.name = "mlm_head"
+
+    def init(self, key, h):
+        kd, kl, kp = jax.random.split(key, 3)
+        h = spec(h)
+        return {"dense": self.dense.init(kd, h), "ln": self.ln.init(kl, h),
+                "proj": self.proj.init(kp, h)}
+
+    def apply(self, params, h, ctx: StageCtx = StageCtx()):
+        h = jax.nn.gelu(self.dense.apply(params["dense"],
+                                         h.astype(jnp.float32), ctx=ctx))
+        h = self.ln.apply(params["ln"], h, ctx=ctx)
+        return self.proj.apply(params["proj"], h, ctx=ctx)
+
+
+def build_sequential(cfg: BertConfig) -> Sequential:
+    layers: List[Module] = [BertEmbed(cfg)]
+    for _ in range(cfg.n_layers):
+        layers.append(TransformerEncoderLayer(
+            cfg.d_model, cfg.nhead, cfg.d_ff, cfg.dropout, causal=False,
+            activation="gelu"))
+    layers.append(MLMHead(cfg))
+    return Sequential(layers, name="bert")
+
+
+class PipelinedBERT(PipelinedTransformer):
+    """Homogeneous factorization over ``n_virtual`` stage bodies.
+
+    Pass ``n_virtual = n_devices * v`` and stack with
+    ``stack_interleaved_params(sp, n_devices)`` for the interleaved
+    executor, or ``n_virtual = n_stages`` + ``stack_stage_params`` for the
+    plain ones.
+    """
+
+    def __init__(self, cfg: BertConfig, n_virtual: int):
+        self.embed = BertEmbed(cfg)
+        self.block = TransformerEncoderLayer(
+            cfg.d_model, cfg.nhead, cfg.d_ff, cfg.dropout, causal=False,
+            activation="gelu")
+        self.head = MLMHead(cfg)
+        super().__init__(cfg, n_virtual)
+        self.n_virtual = n_virtual
+
+    def loss_post_fn(self, post_params, h, x_mb, ctx: StageCtx):
+        """Per-row masked-mean MLM CE [mb_rows]."""
+        logits = self.head.apply(post_params["head"], h, ctx=ctx)
+        return per_row_ce(logits, x_mb["targets"],
+                          weights=x_mb["mlm_weights"])

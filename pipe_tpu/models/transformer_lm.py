@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from ..core.partition import StageCtx
 from ..ops.layers import (Decoder, Embedding, PositionalEncoding, Sequential,
                           TransformerEncoderLayer)
+from .common import PipelinedTransformer, per_row_ce
 
 __all__ = ["LMConfig", "build_sequential", "PipelinedLM", "cross_entropy"]
 
@@ -90,7 +91,7 @@ def build_sequential(cfg: LMConfig) -> Sequential:
 # SPMD path: homogeneous stacked stages
 # ---------------------------------------------------------------------------
 
-class PipelinedLM:
+class PipelinedLM(PipelinedTransformer):
     """The SPMD-ready factorization: embed | k blocks per stage | decode.
 
     ``init`` returns ``(stage_params, pre_params, post_params)`` where
@@ -98,15 +99,9 @@ class PipelinedLM:
     pytrees — feed through ``stack_stage_params`` and ``SpmdPipeline``.
     """
 
+    post_key = "decoder"
+
     def __init__(self, cfg: LMConfig, n_stages: int):
-        if cfg.n_layers % n_stages:
-            raise ValueError(
-                f"n_layers={cfg.n_layers} must divide evenly into "
-                f"n_stages={n_stages} for the homogeneous SPMD path "
-                f"(use Pipe/emulator for uneven splits)")
-        self.cfg = cfg
-        self.n_stages = n_stages
-        self.layers_per_stage = cfg.n_layers // n_stages
         self.embed = Embedding(cfg.vocab, cfg.d_model, scale=True)
         self.posenc = PositionalEncoding(
             cfg.d_model, cfg.dropout, max_len=max(5000, cfg.seq_len))
@@ -114,41 +109,16 @@ class PipelinedLM:
             cfg.d_model, cfg.nhead, cfg.d_ff, cfg.dropout, causal=cfg.causal,
             attn_impl=cfg.attn_impl)
         self.decoder = Decoder(cfg.vocab)
+        self.head = self.decoder  # base-class alias (init/post param slot)
+        super().__init__(cfg, n_stages)
 
-    # --- params ---
-
-    def init(self, key: jax.Array):
-        cfg = self.cfg
-        x_spec = jax.ShapeDtypeStruct((1, cfg.seq_len), jnp.int32)
-        h_spec = jax.ShapeDtypeStruct((1, cfg.seq_len, cfg.d_model),
-                                      jnp.float32)
-        pre_params = {"embed": self.embed.init(jax.random.fold_in(key, 0),
-                                               x_spec)}
-        post_params = {"decoder": self.decoder.init(
-            jax.random.fold_in(key, 1), h_spec)}
-        stage_params: List[Any] = []
-        for s in range(self.n_stages):
-            blocks = []
-            for l in range(self.layers_per_stage):
-                lkey = jax.random.fold_in(key, 2 + s * self.layers_per_stage + l)
-                blocks.append(self.block.init(lkey, h_spec))
-            stage_params.append(blocks)
-        return stage_params, pre_params, post_params
-
-    # --- SPMD stage functions ---
+    # --- SPMD stage functions (pre adds the tutorial's posenc) ---
 
     def pre_fn(self, pre_params, x_mb, ctx: StageCtx):
         tokens = x_mb["tokens"] if isinstance(x_mb, dict) else x_mb
         h = self.embed.apply(pre_params["embed"], tokens, ctx=ctx)
         h = self.posenc.apply({}, h, ctx=ctx.fold(1))
         return h.astype(self.cfg.compute_dtype)
-
-    def stage_fn(self, blocks, h, ctx: StageCtx):
-        cd = self.cfg.compute_dtype
-        for l, bp in enumerate(blocks):
-            bp = jax.tree_util.tree_map(lambda p: p.astype(cd), bp)
-            h = self.block.apply(bp, h, ctx=ctx.fold(l))
-        return h
 
     def post_fn(self, post_params, h, ctx: StageCtx):
         return self.decoder.apply(post_params["decoder"],
@@ -165,11 +135,4 @@ class PipelinedLM:
         """
         logits = self.decoder.apply(post_params["decoder"],
                                     h.astype(jnp.float32), ctx=ctx)
-        targets = x_mb["targets"]
-        logits = logits.astype(jnp.float32)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(logz - gold, axis=-1)  # mean over seq -> [mb_rows]
-
-    def num_params(self, params_tuple) -> int:
-        return sum(int(p.size) for p in jax.tree_util.tree_leaves(params_tuple))
+        return per_row_ce(logits, x_mb["targets"])  # [mb_rows]

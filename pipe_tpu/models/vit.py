@@ -1,0 +1,161 @@
+"""Vision Transformer family, pipelined (BASELINE.json config #5: 8-stage
+ViT-L/16 ImageNet, chunks=8, non-LM tensor shapes, uneven stage balance).
+
+Architecture: patchify (``[b, H, W, C] -> [b, (H/p)(W/p), p*p*C]`` reshape +
+linear projection — the convolution-free, MXU-friendly form of the patch
+embedding), class token + learned positions, pre-LN GELU blocks
+(:class:`~pipe_tpu.ops.layers.PreLNBlock`, ``causal=False``), final LN and a
+classification head over the class token.
+
+Non-LM properties this family exercises end-to-end:
+
+* 4-D image inputs micro-batched through scatter/stack_scatter;
+* an odd token count (197 = 196 patches + cls for /16 at 224) that the
+  flash-attention tiling cannot cover — the XLA attention path is selected
+  statically (``supports()`` gate);
+* integer class labels with a scalar-per-row loss (no seq dimension);
+* uneven balance through ``Pipe(mesh=...)`` (embed and head stages cost
+  nothing like the block stages).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.partition import StageCtx
+from ..ops.layers import (Dropout, LayerNorm, Linear, Module, PreLNBlock,
+                          Sequential, spec)
+from .common import PipelinedTransformer, per_row_ce
+
+__all__ = ["ViTConfig", "build_sequential", "PipelinedViT"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    """ViT-L/16 by default (304M: 24 layers, d=1024, 16 heads, patch 16)."""
+
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    n_classes: int = 1000
+    d_model: int = 1024
+    nhead: int = 16
+    d_ff: int = 4096
+    n_layers: int = 24
+    dropout: float = 0.1
+    compute_dtype: Any = jnp.float32
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def n_tokens(self) -> int:
+        return self.n_patches + 1  # + class token
+
+    def tiny(self) -> "ViTConfig":
+        return dataclasses.replace(
+            self, image_size=16, patch_size=4, n_classes=11, d_model=16,
+            nhead=2, d_ff=64, n_layers=4, dropout=0.0)
+
+
+class PatchEmbed(Module):
+    """Patchify + project + class token + learned positions + dropout."""
+
+    def __init__(self, cfg: ViTConfig):
+        if cfg.image_size % cfg.patch_size:
+            raise ValueError(
+                f"image {cfg.image_size} not divisible by patch "
+                f"{cfg.patch_size}")
+        self.cfg = cfg
+        self.proj = Linear(cfg.d_model)
+        self.drop = Dropout(cfg.dropout)
+        self.name = "patch_embed"
+
+    def init(self, key, images):
+        cfg = self.cfg
+        kp, kc, ke = jax.random.split(key, 3)
+        patch_dim = cfg.patch_size * cfg.patch_size * cfg.channels
+        flat = jax.ShapeDtypeStruct((1, cfg.n_patches, patch_dim),
+                                    jnp.float32)
+        return {
+            "proj": self.proj.init(kp, flat),
+            "cls": 0.02 * jax.random.normal(kc, (1, 1, cfg.d_model),
+                                            jnp.float32),
+            "pos": 0.02 * jax.random.normal(
+                ke, (cfg.n_tokens, cfg.d_model), jnp.float32),
+        }
+
+    def apply(self, params, images, ctx: StageCtx = StageCtx()):
+        cfg = self.cfg
+        b = images.shape[0]
+        p, g = cfg.patch_size, cfg.image_size // cfg.patch_size
+        # [b, H, W, C] -> [b, g*g, p*p*C]
+        x = images.reshape(b, g, p, g, p, cfg.channels)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, p * p * cfg.channels)
+        h = self.proj.apply(params["proj"], x.astype(jnp.float32), ctx=ctx)
+        cls = jnp.broadcast_to(params["cls"], (b, 1, cfg.d_model))
+        h = jnp.concatenate([cls, h], axis=1) + params["pos"]
+        return self.drop.apply({}, h, ctx=ctx).astype(cfg.compute_dtype)
+
+
+class ViTHead(Module):
+    """Final LN + linear classifier over the class token."""
+
+    def __init__(self, cfg: ViTConfig):
+        self.cfg = cfg
+        self.ln = LayerNorm()
+        self.proj = Linear(cfg.n_classes)
+        self.name = "vit_head"
+
+    def init(self, key, h):
+        kl, kp = jax.random.split(key)
+        h = spec(h)
+        cls = jax.ShapeDtypeStruct(tuple(h.shape[:-2]) + (h.shape[-1],),
+                                   jnp.float32)
+        return {"ln": self.ln.init(kl, h), "proj": self.proj.init(kp, cls)}
+
+    def apply(self, params, h, ctx: StageCtx = StageCtx()):
+        h = self.ln.apply(params["ln"], h.astype(jnp.float32), ctx=ctx)
+        return self.proj.apply(params["proj"], h[..., 0, :], ctx=ctx)
+
+
+def build_sequential(cfg: ViTConfig) -> Sequential:
+    layers: List[Module] = [PatchEmbed(cfg)]
+    for _ in range(cfg.n_layers):
+        layers.append(PreLNBlock(cfg.d_model, cfg.nhead, cfg.d_ff,
+                                 cfg.dropout, causal=False))
+    layers.append(ViTHead(cfg))
+    return Sequential(layers, name="vit")
+
+
+class PipelinedViT(PipelinedTransformer):
+    """Homogeneous factorization: patch-embed | k blocks per stage | head."""
+
+    input_key = "images"
+
+    def __init__(self, cfg: ViTConfig, n_stages: int):
+        self.embed = PatchEmbed(cfg)
+        self.block = PreLNBlock(cfg.d_model, cfg.nhead, cfg.d_ff,
+                                cfg.dropout, causal=False)
+        self.head = ViTHead(cfg)
+        super().__init__(cfg, n_stages)
+
+    def x_spec(self):
+        cfg = self.cfg
+        return jax.ShapeDtypeStruct(
+            (1, cfg.image_size, cfg.image_size, cfg.channels), jnp.float32)
+
+    def h_spec(self):
+        cfg = self.cfg
+        return jax.ShapeDtypeStruct((1, cfg.n_tokens, cfg.d_model),
+                                    jnp.float32)
+
+    def loss_post_fn(self, post_params, h, x_mb, ctx: StageCtx):
+        """Per-row softmax CE against integer labels [mb_rows]."""
+        logits = self.head.apply(post_params["head"], h, ctx=ctx)
+        return per_row_ce(logits, x_mb["labels"])

@@ -26,12 +26,16 @@ from ..core.partition import StageCtx
 __all__ = [
     "Module", "Sequential", "Lambda", "Linear", "Embedding", "LayerNorm",
     "Dropout", "MultiHeadAttention", "TransformerEncoderLayer",
-    "PositionalEncoding", "Decoder",
+    "PreLNBlock", "PositionalEncoding", "Decoder", "spec",
 ]
 
 
-def _spec(x) -> jax.ShapeDtypeStruct:
+def spec(x) -> jax.ShapeDtypeStruct:
+    """Abstract ``ShapeDtypeStruct`` of an array or spec (public helper)."""
     return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+_spec = spec  # internal alias
 
 
 class Module:
@@ -296,15 +300,21 @@ class MultiHeadAttention(Module):
         return jnp.einsum("bsd,de->bse", o, params["wo"]) + params["bo"]
 
 
-class TransformerEncoderLayer(Module):
-    """Post-LN transformer block — semantics of torch's default
-    ``nn.TransformerEncoderLayer`` (reference ``main.py:148``): self-attn →
-    add&norm → FFN(ReLU) → add&norm, dropout on each residual branch."""
+_ACTIVATIONS = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}
+
+
+class _TransformerBlockBase(Module):
+    """Shared structure of the two block families (attn + FFN + 2 LN +
+    dropout, one param pytree); subclasses supply ``apply`` (LN placement)."""
 
     def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
                  dropout: float = 0.0, causal: bool = True,
-                 dtype=jnp.float32, name: str = "encoder_layer",
-                 attn_impl: str = "auto"):
+                 dtype=jnp.float32, name: str = "block",
+                 attn_impl: str = "auto", activation: str = "relu"):
+        if activation not in _ACTIVATIONS:
+            raise ValueError(
+                f"activation must be one of {sorted(_ACTIVATIONS)}, "
+                f"got {activation!r}")
         self.attn = MultiHeadAttention(d_model, nhead, dropout, causal, dtype,
                                        impl=attn_impl)
         self.ff1 = Linear(dim_feedforward, dtype=dtype)
@@ -312,31 +322,63 @@ class TransformerEncoderLayer(Module):
         self.ln1 = LayerNorm(dtype=dtype)
         self.ln2 = LayerNorm(dtype=dtype)
         self.drop = Dropout(dropout)
+        self.act = _ACTIVATIONS[activation]
         self.name = name
 
     def init(self, key, x):
         ks = jax.random.split(key, 5)
         d_model_spec = _spec(x)
-        ff_in = self.ff1.init(ks[1], x)
         hidden = jax.ShapeDtypeStruct(
             jnp.shape(x)[:-1] + (self.ff1.features,), jnp.result_type(x))
         return {
             "attn": self.attn.init(ks[0], x),
-            "ff1": ff_in,
+            "ff1": self.ff1.init(ks[1], x),
             "ff2": self.ff2.init(ks[2], hidden),
             "ln1": self.ln1.init(ks[3], d_model_spec),
             "ln2": self.ln2.init(ks[4], d_model_spec),
         }
 
+
+class TransformerEncoderLayer(_TransformerBlockBase):
+    """Post-LN transformer block — semantics of torch's default
+    ``nn.TransformerEncoderLayer`` (reference ``main.py:148``): self-attn →
+    add&norm → FFN(ReLU/GELU) → add&norm, dropout on each residual branch."""
+
+    def __init__(self, *args, name: str = "encoder_layer", **kwargs):
+        super().__init__(*args, name=name, **kwargs)
+
     def apply(self, params, x, ctx: StageCtx = StageCtx()):
         a = self.attn.apply(params["attn"], x, ctx=ctx.fold(0))
         a = self.drop.apply({}, a, ctx=ctx.fold(1))
         x = self.ln1.apply(params["ln1"], x + a, ctx=ctx)
-        h = jax.nn.relu(self.ff1.apply(params["ff1"], x, ctx=ctx))
+        h = self.act(self.ff1.apply(params["ff1"], x, ctx=ctx))
         h = self.drop.apply({}, h, ctx=ctx.fold(2))
         h = self.ff2.apply(params["ff2"], h, ctx=ctx)
         h = self.drop.apply({}, h, ctx=ctx.fold(3))
         return self.ln2.apply(params["ln2"], x + h, ctx=ctx)
+
+
+class PreLNBlock(_TransformerBlockBase):
+    """Pre-LN transformer block (GPT-2 / ViT lineage): x + attn(ln1(x)),
+    then x + ffn(ln2(x)) with GELU — the ring-invariant stage body for the
+    model zoo's pipelined GPT-2/ViT factorizations. Same param pytree as
+    :class:`TransformerEncoderLayer` (shared base); only LN placement
+    differs."""
+
+    def __init__(self, *args, name: str = "preln_block",
+                 activation: str = "gelu", **kwargs):
+        super().__init__(*args, name=name, activation=activation, **kwargs)
+
+    def apply(self, params, x, ctx: StageCtx = StageCtx()):
+        a = self.attn.apply(params["attn"],
+                            self.ln1.apply(params["ln1"], x, ctx=ctx),
+                            ctx=ctx.fold(0))
+        x = x + self.drop.apply({}, a, ctx=ctx.fold(1))
+        h = self.act(self.ff1.apply(
+            params["ff1"], self.ln2.apply(params["ln2"], x, ctx=ctx),
+            ctx=ctx))
+        h = self.ff2.apply(params["ff2"], h, ctx=ctx)
+        return x + self.drop.apply({}, h, ctx=ctx.fold(2))
 
 
 class PositionalEncoding(Module):

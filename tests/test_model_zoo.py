@@ -1,0 +1,245 @@
+"""Model-zoo tests: the BASELINE.json config families, pipelined.
+
+Each family asserts the core transparency property (pipelined == plain
+Sequential) plus the composition its BASELINE config names:
+
+* GPT-2 (#3): 4 stages, @skippable embedding shortcut, through BOTH the
+  emulator and Pipe(mesh=);
+* BERT (#4): MLM masking + loss semantics, 4-device x v=2 interleaved
+  executor (the 8-virtual-stage shape);
+* ViT (#5): image inputs, odd token count, uneven balance through
+  Pipe(mesh=), scalar-per-row loss.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu import Pipe
+from pipe_tpu.core import microbatch as mb
+from pipe_tpu.core.partition import StageCtx
+from pipe_tpu.models.bert import BertConfig, PipelinedBERT, mask_tokens
+from pipe_tpu.models.bert import build_sequential as build_bert
+from pipe_tpu.models.gpt2 import GPT2Config, PipelinedGPT2
+from pipe_tpu.models.gpt2 import build_sequential as build_gpt2
+from pipe_tpu.models.vit import PipelinedViT, ViTConfig
+from pipe_tpu.models.vit import build_sequential as build_vit
+from pipe_tpu.parallel.interleaved import (InterleavedSpmdPipeline,
+                                           stack_interleaved_params)
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.scheduled import ScheduledPipeline
+from pipe_tpu.parallel.spmd import SpmdPipeline, stack_stage_params
+
+
+def stage_mesh(n_stages):
+    return make_mesh(n_stages, 1, devices=jax.devices()[:n_stages])
+
+
+# ---------------- GPT-2 (BASELINE config #3) ----------------
+
+def test_gpt2_pipelined_matches_sequential():
+    cfg = GPT2Config().tiny()
+    model = PipelinedGPT2(cfg, n_stages=4)
+    sp, prep, postp = model.init(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=-1)
+
+    # plain forward: chain the stage fns serially
+    h = model.pre_fn(prep, {"tokens": tokens}, StageCtx())
+    for blocks in sp:
+        h = model.stage_fn(blocks, h, StageCtx())
+    plain = model.loss_post_fn(postp, h, {"targets": targets}, StageCtx())
+
+    spmd = SpmdPipeline(stage_mesh(4), model.stage_fn, pre_fn=model.pre_fn,
+                        post_fn=model.loss_post_fn, post_with_batch=True)
+    x, _ = mb.stack_scatter({"tokens": tokens, "targets": targets}, 2)
+    per_row = spmd(stack_stage_params(sp), prep, postp, x)
+    np.testing.assert_allclose(np.asarray(per_row.reshape(-1)),
+                               np.asarray(plain), rtol=2e-5, atol=2e-5)
+
+
+def test_gpt2_embed_skip_through_pipe_and_mesh():
+    """Config #3's composition: 4-stage GPT-2 with a @skippable cross-stage
+    residual, emulator vs compiled mesh executor."""
+    cfg = GPT2Config().tiny()
+    seq = build_gpt2(cfg, embed_skip=True)
+    # 8 layers: embed+stash | 2 blocks | 2 blocks | join+head
+    balance = [2, 2, 2, 2]
+    emu = Pipe(seq, chunks=2, checkpoint="never", balance=balance)
+    mesh_pipe = Pipe(seq, chunks=2, checkpoint="never",
+                     mesh=stage_mesh(4), balance=balance)
+    tokens0 = jnp.zeros((2, cfg.seq_len), jnp.int32)
+    sp = mesh_pipe.init(jax.random.key(0), tokens0)
+    tokens = jax.random.randint(jax.random.key(1), (4, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    np.testing.assert_allclose(np.asarray(mesh_pipe(sp, tokens)),
+                               np.asarray(emu(sp, tokens)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gpt2_trains_through_scheduled_1f1b():
+    cfg = dataclasses.replace(GPT2Config().tiny(), dropout=0.1)
+    model = PipelinedGPT2(cfg, n_stages=2)
+    sp, prep, postp = model.init(jax.random.key(0))
+    sched = ScheduledPipeline(stage_mesh(2), model.stage_fn,
+                              pre_fn=model.pre_fn,
+                              post_fn=model.loss_post_fn,
+                              checkpoint="except_last", schedule="1f1b")
+    tokens = jax.random.randint(jax.random.key(1), (8, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    x, _ = mb.stack_scatter({"tokens": tokens,
+                             "targets": jnp.roll(tokens, -1, -1)}, 4)
+    w = jnp.ones(x["tokens"].shape[:2], jnp.float32)
+    stacked = stack_stage_params(sp)
+
+    @jax.jit
+    def step(stacked, prep, postp):
+        loss, grads = sched.loss_and_grad(stacked, prep, postp, x, w,
+                                          key=jax.random.key(2))
+        return loss, grads
+
+    loss, (g_sp, g_pre, g_post) = step(stacked, prep, postp)
+    assert np.isfinite(float(loss))
+    gnorm = sum(float(jnp.sum(jnp.abs(g)))
+                for g in jax.tree_util.tree_leaves((g_sp, g_pre, g_post)))
+    assert gnorm > 0.0
+
+
+# ---------------- BERT (BASELINE config #4) ----------------
+
+def test_mask_tokens_statistics():
+    cfg = BertConfig().tiny()
+    tokens = jax.random.randint(jax.random.key(0), (64, cfg.seq_len),
+                                2, cfg.vocab, jnp.int32)
+    masked, weights = mask_tokens(jax.random.key(1), tokens, cfg)
+    rate = float(jnp.mean(weights))
+    assert 0.10 < rate < 0.20              # ~15% selected
+    # corrupted positions are a subset of selected positions
+    changed = (masked != tokens)
+    assert bool(jnp.all(weights[changed] == 1.0))
+    # roughly 80% of selected became [MASK]
+    sel = weights == 1.0
+    frac_mask = float(jnp.sum((masked == cfg.mask_token_id) & sel)
+                      / jnp.sum(sel))
+    assert 0.6 < frac_mask < 0.95
+
+
+def test_bert_mlm_loss_only_counts_masked_positions():
+    cfg = BertConfig().tiny()
+    model = PipelinedBERT(cfg, n_virtual=4)
+    sp, prep, postp = model.init(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    h = model.pre_fn(prep, {"tokens": tokens}, StageCtx())
+    for blocks in sp:
+        h = model.stage_fn(blocks, h, StageCtx())
+    w1 = jnp.zeros((2, cfg.seq_len)).at[:, 0].set(1.0)
+    l1 = model.loss_post_fn(postp, h, {"targets": tokens,
+                                       "mlm_weights": w1}, StageCtx())
+    # changing an unmasked target must not change the loss
+    t2 = tokens.at[:, 5].set((tokens[:, 5] + 1) % cfg.vocab)
+    l2 = model.loss_post_fn(postp, h, {"targets": t2,
+                                       "mlm_weights": w1}, StageCtx())
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    # changing the masked target must
+    t3 = tokens.at[:, 0].set((tokens[:, 0] + 1) % cfg.vocab)
+    l3 = model.loss_post_fn(postp, h, {"targets": t3,
+                                       "mlm_weights": w1}, StageCtx())
+    assert not np.allclose(np.asarray(l1), np.asarray(l3))
+
+
+def test_bert_interleaved_matches_plain():
+    """The 8-virtual-stage interleaved shape (4 devices x v=2)."""
+    cfg = dataclasses.replace(BertConfig().tiny(), n_layers=8)
+    model = PipelinedBERT(cfg, n_virtual=8)
+    sp, prep, postp = model.init(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    masked, weights = mask_tokens(jax.random.key(2), tokens, cfg)
+
+    h = model.pre_fn(prep, {"tokens": masked}, StageCtx())
+    for blocks in sp:
+        h = model.stage_fn(blocks, h, StageCtx())
+    plain = model.loss_post_fn(
+        postp, h, {"targets": tokens, "mlm_weights": weights}, StageCtx())
+
+    ipipe = InterleavedSpmdPipeline(
+        stage_mesh(4), model.stage_fn, v=2, pre_fn=model.pre_fn,
+        post_fn=model.loss_post_fn, post_with_batch=True)
+    x, _ = mb.stack_scatter({"tokens": masked, "targets": tokens,
+                             "mlm_weights": weights}, 4)
+    per_row = ipipe(stack_interleaved_params(sp, 4), prep, postp, x)
+    np.testing.assert_allclose(np.asarray(per_row.reshape(-1)),
+                               np.asarray(plain), rtol=2e-5, atol=2e-5)
+
+
+# ---------------- ViT (BASELINE config #5) ----------------
+
+def test_vit_pipelined_matches_sequential():
+    cfg = ViTConfig().tiny()
+    model = PipelinedViT(cfg, n_stages=4)
+    sp, prep, postp = model.init(jax.random.key(0))
+    images = jax.random.normal(
+        jax.random.key(1),
+        (4, cfg.image_size, cfg.image_size, cfg.channels))
+    labels = jax.random.randint(jax.random.key(2), (4,), 0, cfg.n_classes)
+
+    h = model.pre_fn(prep, {"images": images}, StageCtx())
+    for blocks in sp:
+        h = model.stage_fn(blocks, h, StageCtx())
+    plain = model.loss_post_fn(postp, h, {"labels": labels}, StageCtx())
+
+    spmd = SpmdPipeline(stage_mesh(4), model.stage_fn, pre_fn=model.pre_fn,
+                        post_fn=model.loss_post_fn, post_with_batch=True)
+    x, _ = mb.stack_scatter({"images": images, "labels": labels}, 2)
+    per_row = spmd(stack_stage_params(sp), prep, postp, x)
+    np.testing.assert_allclose(np.asarray(per_row.reshape(-1)),
+                               np.asarray(plain), rtol=2e-5, atol=2e-5)
+    # odd token count (n_patches + 1) rules out the flash tiling
+    assert cfg.n_tokens % 2 == 1
+
+
+def test_vit_uneven_balance_through_pipe_mesh():
+    """Config #5's composition: uneven stage balance, image shapes."""
+    cfg = ViTConfig().tiny()
+    seq = build_vit(cfg)                    # 6 layers: embed, 4 blocks, head
+    balance = [1, 3, 2]
+    emu = Pipe(seq, chunks=2, checkpoint="except_last", balance=balance)
+    mesh_pipe = Pipe(seq, chunks=2, checkpoint="except_last",
+                     mesh=stage_mesh(3), balance=balance)
+    img0 = jnp.zeros((2, cfg.image_size, cfg.image_size, cfg.channels))
+    sp = mesh_pipe.init(jax.random.key(0), img0)
+    images = jax.random.normal(
+        jax.random.key(1),
+        (4, cfg.image_size, cfg.image_size, cfg.channels))
+    np.testing.assert_allclose(np.asarray(mesh_pipe(sp, images)),
+                               np.asarray(emu(sp, images)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_vit_gradients_flow():
+    cfg = ViTConfig().tiny()
+    model = PipelinedViT(cfg, n_stages=2)
+    sp, prep, postp = model.init(jax.random.key(0))
+    spmd = SpmdPipeline(stage_mesh(2), model.stage_fn, pre_fn=model.pre_fn,
+                        post_fn=model.loss_post_fn, post_with_batch=True,
+                        checkpoint="except_last")
+    images = jax.random.normal(
+        jax.random.key(1),
+        (4, cfg.image_size, cfg.image_size, cfg.channels))
+    labels = jax.random.randint(jax.random.key(2), (4,), 0, cfg.n_classes)
+    x, _ = mb.stack_scatter({"images": images, "labels": labels}, 2)
+    stacked = stack_stage_params(sp)
+
+    def loss(stacked, prep, postp):
+        return jnp.mean(spmd(stacked, prep, postp, x,
+                             key=jax.random.key(3), train=True))
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(stacked, prep, postp)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    assert sum(float(jnp.sum(jnp.abs(l))) for l in leaves) > 0.0
