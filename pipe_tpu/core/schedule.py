@@ -15,6 +15,7 @@ configs #4) slot in without touching the executors.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -26,8 +27,10 @@ __all__ = [
     "GPipeSchedule",
     "OneFOneBSchedule",
     "InterleavedSchedule",
+    "InterleavedOneFOneBSchedule",
     "get_schedule",
     "verify_op_tables",
+    "verify_interleaved_op_tables",
     "IDLE",
     "FWD",
     "BWD",
@@ -104,6 +107,11 @@ class Schedule:
     def stash_slots(self, m: int, n: int) -> int:
         """Max simultaneously-live stashed input activations per stage."""
         raise NotImplementedError
+
+    @property
+    def v(self) -> int:
+        """Interleave depth: virtual stages per device (1 = not interleaved)."""
+        return 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -210,6 +218,163 @@ class InterleavedSchedule(Schedule):
         return (d - 1) / (m * self.v + d - 1)
 
 
+@dataclasses.dataclass(frozen=True)
+class InterleavedOneFOneBSchedule(Schedule):
+    """Interleaved 1F1B: ``v`` virtual stages per device, forward AND
+    backward as one static table (BASELINE config #4's schedule).
+
+    Virtual stage ``s`` of ``S = v * d`` lives on device ``s % d`` — every
+    boundary ``s -> s+1`` is one hop on the WRAPAROUND device ring, so one
+    uniform ppermute moves all inter-group traffic. Tables come from a
+    greedy constructor honoring the manual executor's transport contract:
+
+    * FWD(i, s) at least one cycle after FWD(i, s-1) (park in stash);
+    * BWD(i, s) EXACTLY one cycle after BWD(i, s+1) (cotangents ride the
+      reverse ring unbuffered) — backward chains are rigid once seeded, so
+      the constructor reserves whole chains at the earliest collision-free
+      cycle and fills remaining slots with the deepest available forward;
+    * per device one op per cycle.
+
+    vs plain 1F1B of the same S virtual stages, the fill/drain shrinks
+    (e.g. m=8, d=4, v=2: 42 cycles vs 46) — the interleave bubble win with
+    1F1B's activation cap, where :class:`InterleavedSchedule` (AD executor)
+    keeps GPipe's O(m) liveness.
+    """
+
+    name: str = "interleaved-1f1b"
+    interleave: int = 2
+
+    @property
+    def v(self) -> int:
+        return self.interleave
+
+    def cycles(self, m: int, n: int) -> List[List[Tuple[int, int]]]:
+        raise NotImplementedError(
+            "interleaved-1f1b is a manual-executor schedule; it has no "
+            "forward-only wavefront (use op_tables)")
+
+    @functools.lru_cache(maxsize=64)
+    def op_tables(self, m: int, d: int):
+        """``(op[T, d], mb[T, d], grp[T, d])`` over devices (not stages).
+
+        Cached: the greedy constructor is pure Python over the whole table
+        and is consulted repeatedly (trace time, stash_slots, memory_plan,
+        per-log-line bubble reporting); the dataclass is frozen/hashable.
+        """
+        v = self.interleave
+        S = v * d
+        max_T = 4 * (m * v + d) + 8
+        op = np.full((max_T, d), IDLE, np.int32)
+        mbi = np.zeros((max_T, d), np.int32)
+        grp = np.zeros((max_T, d), np.int32)
+        t_fwd = np.full((m, S), -1)
+        t_bwd = np.full((m, S), -1)
+        reserved: dict = {}
+
+        def chain_free(t0):
+            return all((t0 + (S - 1 - s), s % d) not in reserved
+                       and t0 + (S - 1 - s) < max_T for s in range(S))
+
+        def reserve_chain(t0, i):
+            for s in range(S):
+                reserved[(t0 + (S - 1 - s), s % d)] = (i, s)
+
+        next_seed = 0
+        for t in range(max_T):
+            while (next_seed < m and 0 <= t_fwd[next_seed, S - 1] < t):
+                t0 = t
+                while not chain_free(t0):
+                    t0 += 1
+                reserve_chain(t0, next_seed)
+                next_seed += 1
+            for p in range(d):
+                if (t, p) in reserved:
+                    i, s = reserved[(t, p)]
+                    op[t, p], mbi[t, p], grp[t, p] = BWD, i, s // d
+                    t_bwd[i, s] = t
+                    continue
+                placed = False
+                for g in range(v - 1, -1, -1):      # deepest group first
+                    s = g * d + p
+                    for i in range(m):
+                        if t_fwd[i, s] >= 0:
+                            continue
+                        if s > 0 and not (0 <= t_fwd[i, s - 1] < t):
+                            continue
+                        op[t, p], mbi[t, p], grp[t, p] = FWD, i, g
+                        t_fwd[i, s] = t
+                        placed = True
+                        break
+                    if placed:
+                        break
+            if (t_bwd >= 0).all():
+                T = t + 1
+                return op[:T], mbi[:T], grp[:T]
+        raise AssertionError(
+            f"interleaved-1f1b table construction did not converge "
+            f"(m={m}, d={d}, v={v})")
+
+    def stash_slots(self, m: int, d: int) -> int:
+        """Peak live stashed inputs per VIRTUAL stage, from the tables."""
+        op, mbi, grp = self.op_tables(m, d)
+        _, _, cap = _virtual_times(op, mbi, grp, m, d, self.interleave)
+        return cap
+
+    def num_cycles(self, m: int, d: int) -> int:
+        return self.op_tables(m, d)[0].shape[0]
+
+    def bubble(self, m: int, d: int) -> float:
+        T = self.num_cycles(m, d)
+        return (T * d - 2 * m * self.interleave * d) / (T * d)
+
+
+def _virtual_times(op, mbi, grp, m, d, v):
+    """(t_fwd[m, S], t_bwd[m, S], peak stash capacity) from device tables."""
+    S = v * d
+    T = op.shape[0]
+    t_fwd = np.full((m, S), -1)
+    t_bwd = np.full((m, S), -1)
+    for t in range(T):
+        for p in range(d):
+            s = grp[t, p] * d + p
+            i = mbi[t, p]
+            if op[t, p] == FWD:
+                assert t_fwd[i, s] == -1, (t, p)
+                t_fwd[i, s] = t
+            elif op[t, p] == BWD:
+                assert t_bwd[i, s] == -1, (t, p)
+                t_bwd[i, s] = t
+    assert (t_fwd >= 0).all() and (t_bwd >= 0).all(), "missing ops"
+    cap = 0
+    for s in range(S):
+        arrive = t_fwd[:, s] if s == 0 else t_fwd[:, s - 1] + 1
+        free = t_bwd[:, s]
+        # ring indexing i % cap needs the live set to be a contiguous i
+        # range: arrivals and frees must each be monotone in i
+        assert (np.diff(arrive) > 0).all(), f"non-FIFO arrivals at {s}"
+        assert (np.diff(free) > 0).all(), f"non-FIFO frees at {s}"
+        for t in range(T):
+            cap = max(cap, int(np.sum((arrive <= t) & (t <= free))))
+    return t_fwd, t_bwd, cap
+
+
+def verify_interleaved_op_tables(op, mbi, grp, m: int, d: int,
+                                 v: int) -> None:
+    """Invariants for device-major interleaved tables (see
+    :class:`InterleavedOneFOneBSchedule`): each (i, virtual stage) runs FWD
+    and BWD exactly once on the right device, forward order is strict,
+    backward chains step exactly one cycle per hop, and the FIFO property
+    the stash ring indexing relies on holds."""
+    S = v * d
+    t_fwd, t_bwd, _ = _virtual_times(op, mbi, grp, m, d, v)
+    for i in range(m):
+        for s in range(S):
+            assert t_bwd[i, s] > t_fwd[i, s], (i, s)
+            if s + 1 < S:
+                assert t_fwd[i, s] < t_fwd[i, s + 1], (i, s)
+                assert t_bwd[i, s] == t_bwd[i, s + 1] + 1, (i, s)
+
+
 def verify_op_tables(op: np.ndarray, mbi: np.ndarray, m: int, n: int,
                      stash_slots: Optional[int] = None) -> None:
     """Check the :meth:`Schedule.op_tables` invariants (see docstring there).
@@ -258,6 +423,7 @@ _SCHEDULES = {
     "gpipe": GPipeSchedule,
     "1f1b": OneFOneBSchedule,
     "interleaved": InterleavedSchedule,
+    "interleaved-1f1b": InterleavedOneFOneBSchedule,
 }
 
 

@@ -10,48 +10,59 @@ GPipe's O(m) activation liveness: every micro-batch's residuals survive until
 the scan's backward.
 
 This module instead compiles the *whole* training step — forward, backward,
-loss, gradient accumulation — as one ``lax.scan`` over ``2(m+n-1)`` uniform
-clock slots, driven by static (cycle, stage) → (op, micro-batch) tables
-emitted by :meth:`core.schedule.Schedule.op_tables`. Per cycle each device
-either
+loss, gradient accumulation — as one ``lax.scan`` over uniform clock slots,
+driven by static (cycle, device) → (op, micro-batch, group) tables emitted
+by :meth:`core.schedule.Schedule.op_tables`. Per cycle each device either
 
-* **FWD**: runs its stage on one micro-batch (stashing the stage *input* in a
-  ring buffer), or
+* **FWD**: runs one of its stage bodies on one micro-batch (stashing the
+  stage *input* in a ring buffer), or
 * **BWD**: re-runs the stage from the stashed input under ``jax.vjp`` and
   applies the cotangent arriving from the next stage (manual remat — the
   compiled analogue of ``Recompute.backward`` re-running forward just before
   ``Checkpoint.backward`` consumes it, ``README.md:450-537``), or
 * **IDLE**: passes through (a fill/drain bubble slot).
 
-Transport is two ``ppermute`` rings — activations j→j+1, cotangents j+1→j —
-shifted every cycle; the tables guarantee a value is consumed exactly when it
-arrives (gradients) or is parked in the stash until its cycle (activations).
+Transport is two ``ppermute`` rings — activations one hop forward,
+cotangents one hop backward — shifted every cycle; the tables guarantee a
+value is consumed exactly when it arrives (gradients) or is parked in the
+stash until its cycle (activations).
 
-What this buys over the AD executor:
+What this buys over the AD executors:
 
 * **True 1F1B**: with ``schedule='1f1b'`` the stashed-input buffer holds at
   most ``min(m, n)`` micro-batches (vs GPipe's ``m``) — the activation-memory
   cap that is the entire point of the reference's fork/join machinery.
+* **Interleaved 1F1B** (``schedule='interleaved-1f1b'``): each device hosts
+  ``v`` non-adjacent virtual stages (virtual stage ``s`` on device
+  ``s % d``), every boundary is one hop on the WRAPAROUND ring, and both
+  passes come from the same static table — the fill bubble shrinks vs plain
+  1F1B of the same depth while keeping the 1F1B memory story
+  (:class:`~pipe_tpu.core.schedule.InterleavedOneFOneBSchedule`).
 * **Exact ``except_last``**: per-micro-batch remat policy with *uniform*
   per-cycle code: micro-batch m-1's vjp residuals are saved at forward time
   (a flattened-``vjp_fn`` pytree carried in the scan), every other micro-batch
   recomputes — sidestepping the jax 0.9.0 ``cond``+remat+PRNG bug that forces
   the AD executor's static remat (see ``spmd.py`` module docstring). Matches
   the reference mode map ``pipe.py:354`` exactly on the compiled path.
-* **Schedules as data**: any table satisfying
-  :func:`core.schedule.verify_op_tables` runs unmodified.
+* **Schedules as data**: any table satisfying the
+  :mod:`core.schedule` verifiers runs unmodified.
 
-Checkpoint-mode → storage map (per stage):
+Checkpoint-mode → storage map (per device; ``Sg`` = per-virtual-stage stash
+slots = ``schedule.stash_slots(m, d)``, ``v`` = interleave depth):
 
 =============  =====================  ==========================
 mode           stashed inputs         stored vjp residuals
 =============  =====================  ==========================
-always         S slots                none (recompute all)
-except_last    S slots                1 slot (micro-batch m-1)
-never          S slots                S slots (recompute none)
+always         v·Sg slots             none (recompute all)
+except_last    v·Sg slots             v slots (micro-batch m-1)
+never          v·Sg slots             v·Sg slots (recompute none)
 =============  =====================  ==========================
 
-with S = ``schedule.stash_slots(m, n)`` = m for GPipe, min(m, n) for 1F1B.
+Parameter layout: the stage axis stacks all ``v·d`` virtual stages
+device-major (``stack_interleaved_params`` ordering: global row ``p·v + g``
+= virtual stage ``g·d + p``), so each device's shard is its ``v`` groups in
+order; ``v = 1`` reduces to plain per-stage stacking and reproduces the
+non-interleaved executor exactly (same tables, same key folds).
 """
 
 from __future__ import annotations
@@ -67,7 +78,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.partition import StageCtx
 from ..core.remat import validate_mode
-from ..core.schedule import (BWD, FWD, GPipeSchedule, OneFOneBSchedule,
+from ..core.schedule import (BWD, FWD, GPipeSchedule,
+                             InterleavedOneFOneBSchedule, OneFOneBSchedule,
                              Schedule, get_schedule)
 from .mesh import DATA_AXIS, STAGE_AXIS
 
@@ -85,16 +97,19 @@ class ScheduledPipeline:
 
     Args:
       mesh: mesh with a ``stage`` axis (and optionally ``data``/others).
-      stage_fn: ``(params_j, h, ctx) -> h`` homogeneous stage body (ring
+        The stage axis size is the DEVICE count d; with an interleaved
+        schedule the model must factor into ``v*d`` virtual stage bodies.
+      stage_fn: ``(params_g, h, ctx) -> h`` homogeneous stage body (ring
         invariant: input/output activation shapes identical).
-      pre_fn: ``(pre_params, x_mb, ctx) -> h``, run on stage 0 (embed).
+      pre_fn: ``(pre_params, x_mb, ctx) -> h``, run on virtual stage 0.
       post_fn: ``(post_params, h, x_mb, ctx) -> per-row loss [rows]``, run on
-        stage n-1. Training executors always compute loss in-pipeline (the
-        reference moves targets to the last GPU for the same reason,
-        ``main.py:216``).
+        the last virtual stage. Training executors always compute loss
+        in-pipeline (the reference moves targets to the last GPU for the
+        same reason, ``main.py:216``).
       checkpoint: ``always | except_last | never`` — exact per-micro-batch
         policy (reference ``pipe.py:354``).
-      schedule: ``'gpipe' | '1f1b'`` or a :class:`Schedule` with op tables.
+      schedule: ``'gpipe' | '1f1b' | 'interleaved-1f1b'`` or a
+        :class:`Schedule` with op tables.
     """
 
     mesh: Mesh
@@ -112,12 +127,15 @@ class ScheduledPipeline:
             raise ValueError(f"mesh must have a {STAGE_AXIS!r} axis")
         if isinstance(self.schedule, str):
             self.schedule = get_schedule(self.schedule)
-        if not isinstance(self.schedule, (GPipeSchedule, OneFOneBSchedule)):
-            # anything emitting valid op tables works; these two are shipped
+        if not isinstance(self.schedule, (GPipeSchedule, OneFOneBSchedule,
+                                          InterleavedOneFOneBSchedule)):
+            # anything emitting valid op tables works; these are shipped
             if not hasattr(self.schedule, "op_tables"):
                 raise ValueError(
                     f"schedule {self.schedule!r} has no op_tables")
-        self.n_stages = self.mesh.shape[STAGE_AXIS]
+        self.n_stages = self.mesh.shape[STAGE_AXIS]      # devices d
+        self.v = self.schedule.v
+        self.n_virtual = self.v * self.n_stages
         self.has_data_axis = DATA_AXIS in self.mesh.axis_names
         if self.context_axis and self.context_axis not in self.mesh.axis_names:
             raise ValueError(
@@ -125,12 +143,18 @@ class ScheduledPipeline:
 
     # -----------------------------------------------------------------
     def memory_plan(self, m: int) -> dict:
-        """Static per-stage buffer counts — the memory story, inspectable."""
-        n = self.n_stages
-        S = self.schedule.stash_slots(m, n)
-        R = {"always": 0, "except_last": 1, "never": S}[self.checkpoint]
-        return {"cycles": 2 * (m + n - 1), "stash_slots": S,
-                "residual_slots": R}
+        """Static per-device buffer counts — the memory story, inspectable."""
+        d, v = self.n_stages, self.v
+        Sg = self.schedule.stash_slots(m, d)
+        R = {"always": 0, "except_last": v,
+             "never": v * Sg}[self.checkpoint]
+        return {"cycles": self._cycles(m), "stash_slots": v * Sg,
+                "stash_slots_per_virtual_stage": Sg, "residual_slots": R,
+                "virtual_stages_per_device": v}
+
+    def _cycles(self, m: int) -> int:
+        tables = self.schedule.op_tables(m, self.n_stages)
+        return tables[0].shape[0]
 
     # -----------------------------------------------------------------
     def loss_and_grad(self, stage_params, pre_params, post_params, x, w,
@@ -140,6 +164,9 @@ class ScheduledPipeline:
         ``x``: pytree of ``[m, rows, ...]`` micro-batched arrays;
         ``w``: ``[m, rows]`` per-row loss weights (0 for padding rows — the
         loss is ``sum(w * per_row) / sum(w)``).
+        ``stage_params``: all ``v*d`` virtual stages stacked device-major on
+        the leading axis (``stack_stage_params`` for v=1,
+        ``stack_interleaved_params`` otherwise).
         """
         x_leaves = jax.tree_util.tree_leaves(x)
         if not x_leaves:
@@ -175,50 +202,84 @@ class ScheduledPipeline:
         return run(stage_params, pre_params, post_params, x, w, key)
 
     # -----------------------------------------------------------------
-    def _f_full(self, params_j, prep, postp, h_in, x_mb, w_mb, kij, j):
-        """The per-(cycle, stage) forward: pre (stage 0 only) → body → loss
-        contribution (stage n-1 only). Everything the backward needs to
-        differentiate is an explicit argument — no closure over device state
-        (in particular no collective-derived values like the global weight
-        sum, which would change the vjp residual structure under shard_map) —
-        so the residual structure is derivable abstractly. The contribution is
-        UNNORMALIZED (``sum(w * per_row)``); the executor divides the loss and
-        scales the backward seed by ``1/sum(w)``."""
-        n = self.n_stages
+    def _f_full(self, params_g, prep, postp, h_in, x_mb, w_mb, kis, s):
+        """The per-(cycle, device) forward for virtual stage ``s``: pre
+        (stage 0 only) → body → loss contribution (last stage only).
+        Everything the backward needs to differentiate is an explicit
+        argument — no closure over device state (in particular no
+        collective-derived values like the global weight sum, which would
+        change the vjp residual structure under shard_map) — so the residual
+        structure is derivable abstractly. The contribution is UNNORMALIZED
+        (``sum(w * per_row)``); the executor divides the loss and scales the
+        backward seed by ``1/sum(w)``."""
+        S = self.n_virtual
         train = True
         h0 = jax.lax.cond(
-            j == 0,
+            s == 0,
             lambda: self.pre_fn(prep, x_mb,
-                                StageCtx(key=jax.random.fold_in(kij, 0),
+                                StageCtx(key=jax.random.fold_in(kis, 0),
                                          train=train)),
             lambda: h_in)
-        h1 = self.stage_fn(params_j, h0,
-                           StageCtx(key=jax.random.fold_in(kij, 1),
+        h1 = self.stage_fn(params_g, h0,
+                           StageCtx(key=jax.random.fold_in(kis, 1),
                                     train=train))
         contrib = jax.lax.cond(
-            j == n - 1,
+            s == S - 1,
             lambda: jnp.sum(
                 w_mb * self.post_fn(postp, h1, x_mb,
-                                    StageCtx(key=jax.random.fold_in(kij, 2),
+                                    StageCtx(key=jax.random.fold_in(kis, 2),
                                              train=train))
             ).astype(jnp.float32),
             lambda: jnp.zeros((), jnp.float32))
         return h1, contrib
 
-    def _vjp_wrt(self, params_j, prep, postp, h_in, x_mb, w_mb, kij, j):
-        """vjp of :meth:`_f_full` w.r.t. (stage params, pre, post, h_in)."""
+    def _vjp_wrt(self, params_g, prep, postp, h_in, x_mb, w_mb, kis, s):
+        """vjp of :meth:`_f_full` w.r.t. (group params, pre, post, h_in)."""
         return jax.vjp(
-            lambda a, b, c, d: self._f_full(a, b, c, d, x_mb, w_mb, kij, j),
-            params_j, prep, postp, h_in)
+            lambda a, b, c, dd: self._f_full(a, b, c, dd, x_mb, w_mb, kis, s),
+            params_g, prep, postp, h_in)
+
+    # -----------------------------------------------------------------
+    def _host_tables(self, m):
+        """Static (cycle, device) tables + receive-slot plan, host-side."""
+        d, v = self.n_stages, self.v
+        S = v * d
+        Sg = self.schedule.stash_slots(m, d)
+        tables = self.schedule.op_tables(m, d)
+        if len(tables) == 2:            # non-interleaved: group is always 0
+            op_np, mb_np = tables
+            grp_np = np.zeros_like(op_np)
+        else:
+            op_np, mb_np, grp_np = tables
+        T = op_np.shape[0]
+        sentinel = v * Sg
+        # rxslot[t, p]: stash slot for the value arriving at device p at
+        # cycle t (the upstream device's cycle-(t-1) output), sentinel when
+        # it is not a real activation (IDLE/BWD upstream, or the last
+        # virtual stage's output, which has no consumer).
+        rxslot_np = np.full((T, d), sentinel, np.int32)
+        for t in range(1, T):
+            for p in range(d):
+                q = (p - 1) % d
+                if self.v == 1 and p == 0:
+                    continue            # linear ring: nothing enters stage 0
+                if op_np[t - 1, q] != FWD:
+                    continue
+                s_up = grp_np[t - 1, q] * d + q
+                if s_up >= S - 1:
+                    continue
+                g2 = (s_up + 1) // d
+                rxslot_np[t, p] = g2 * Sg + (mb_np[t - 1, q] % Sg)
+        return (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel
 
     # -----------------------------------------------------------------
     def _device_program(self, stage_params, pre_params, post_params, x, w,
                         key, *, m):
-        n = self.n_stages
+        d, v = self.n_stages, self.v
+        S = self.n_virtual
         j = jax.lax.axis_index(STAGE_AXIS)
-        params_j = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-        plan = self.memory_plan(m)
-        S, R = plan["stash_slots"], plan["residual_slots"]
+        # This device's shard: [v, ...] — its interleave groups in order.
+        params_dev = stage_params
         mode = self.checkpoint
 
         # Total loss weight, global over the data axis (w is replicated over
@@ -234,92 +295,99 @@ class ScheduledPipeline:
         w_mb_spec = jax.eval_shape(lambda a: _index_spec(a), w)
         h_spec = jax.eval_shape(
             lambda p, a: self.pre_fn(p, a, ctx0), pre_params, x_mb_spec)
+        params_g_spec = jax.eval_shape(lambda p: _index_spec(p), params_dev)
 
         # Canonical vjp structure (abstract — no tracers leak in):
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         key_spec = jax.eval_shape(lambda: jax.random.key(0))
         (_, _), vjp_fn_spec = jax.eval_shape(
-            self._vjp_wrt, params_j, pre_params, post_params, h_spec,
+            self._vjp_wrt, params_g_spec, pre_params, post_params, h_spec,
             x_mb_spec, w_mb_spec, key_spec, i32)
         res_specs, res_treedef = jax.tree_util.tree_flatten(vjp_fn_spec)
         inv_wsum = 1.0 / wsum
 
         # --- schedule tables (static data → scan xs) ---------------------
-        op_np, mb_np = self.schedule.op_tables(m, n)
-        T = op_np.shape[0]
-        # rx[t, j]: the ring value arriving at stage j at cycle t is stage
-        # j-1's cycle-(t-1) output — a real activation iff that was a FWD.
-        rxop_np = np.full((T, n), 0, np.int32)
-        rxmb_np = np.zeros((T, n), np.int32)
-        rxop_np[1:, 1:] = (op_np[:-1, :-1] == FWD).astype(np.int32)
-        rxmb_np[1:, 1:] = mb_np[:-1, :-1]
-        xs = (jnp.asarray(op_np), jnp.asarray(mb_np),
-              jnp.asarray(rxop_np), jnp.asarray(rxmb_np))
+        (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel = \
+            self._host_tables(m)
+        xs = (jnp.asarray(op_np), jnp.asarray(mb_np), jnp.asarray(grp_np),
+              jnp.asarray(rxslot_np))
 
         # --- carry -------------------------------------------------------
         def zeros_of(spec):
             return jnp.zeros(spec.shape, spec.dtype)
 
         def slots_of(spec, k):
-            # one extra garbage slot so masked writes need no read-back
+            # one extra sentinel slot so masked writes need no read-back
             return jnp.zeros((k + 1,) + tuple(spec.shape), spec.dtype)
 
         h_ring = jax.tree_util.tree_map(zeros_of, h_spec)
         g_ring = jax.tree_util.tree_map(zeros_of, h_spec)
-        stash = jax.tree_util.tree_map(lambda s: slots_of(s, S), h_spec)
-        res_store = ([slots_of(s, R if mode == "never" else 1)
-                      for s in res_specs] if mode != "always" else [])
-        g_sp = jax.tree_util.tree_map(jnp.zeros_like, params_j)
+        stash = jax.tree_util.tree_map(
+            lambda s_: slots_of(s_, v * Sg), h_spec)
+        n_res = self.memory_plan(m)["residual_slots"]
+        res_store = ([slots_of(s_, n_res) for s_ in res_specs]
+                     if mode != "always" else [])
+        g_sp = jax.tree_util.tree_map(jnp.zeros_like, params_dev)
         g_pre = jax.tree_util.tree_map(jnp.zeros_like, pre_params)
         g_post = jax.tree_util.tree_map(jnp.zeros_like, post_params)
         loss0 = jnp.zeros((), jnp.float32)
 
-        fwd_perm = [(k, k + 1) for k in range(n - 1)]
-        bwd_perm = [(k + 1, k) for k in range(n - 1)]
+        if v == 1:
+            fwd_perm = [(k, k + 1) for k in range(d - 1)]
+            bwd_perm = [(k + 1, k) for k in range(d - 1)]
+        else:
+            fwd_perm = [(q, (q + 1) % d) for q in range(d)]
+            bwd_perm = [(q, (q - 1) % d) for q in range(d)]
 
-        def res_slot_for(i):
-            """Where micro-batch i's residuals live (garbage slot if unsaved)."""
+        def res_slot_for(i, g):
+            """Where (micro-batch i, group g)'s residuals live (sentinel
+            slot when unsaved)."""
             if mode == "never":
-                return i % S
-            # except_last: slot 0 holds micro-batch m-1, slot 1 is garbage
-            return jnp.where(i == m - 1, 0, 1)
+                return g * Sg + i % Sg
+            # except_last: slot g holds micro-batch m-1, slot v is sentinel
+            return jnp.where(i == m - 1, g, v)
 
         def cycle(carry, row):
-            h_ring, g_ring, stash, res_store, g_sp, g_pre, g_post, loss = carry
-            op_r, mb_r, rxop_r, rxmb_r = row
+            h_ring, g_ring, stash, res_store, g_sp, g_pre, g_post, loss = \
+                carry
+            op_r, mb_r, grp_r, rx_r = row
             opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
             i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
-            rxv = jax.lax.dynamic_index_in_dim(rxop_r, j, 0, keepdims=False)
-            rxi = jax.lax.dynamic_index_in_dim(rxmb_r, j, 0, keepdims=False)
+            g = jax.lax.dynamic_index_in_dim(grp_r, j, 0, keepdims=False)
+            rslot = jax.lax.dynamic_index_in_dim(rx_r, j, 0, keepdims=False)
+            s = g * d + j                 # this cycle's virtual stage
 
-            # 1) park the arriving activation (garbage slot when not real)
-            rslot = jnp.where(rxv == 1, rxi % S, S)
+            # 1) park the arriving activation (sentinel slot when not real)
             stash = jax.tree_util.tree_map(
                 lambda st, hr: jax.lax.dynamic_update_index_in_dim(
                     st, hr, rslot, 0), stash, h_ring)
 
-            kij = jax.random.fold_in(jax.random.fold_in(key, i), j)
+            kis = jax.random.fold_in(jax.random.fold_in(key, i), s)
             x_mb = _index(x, i)
             w_mb = _index(w, i)
+            # v=1: the single group is hoisted statically (no per-cycle
+            # gather); v>1: one gather per cycle selects the active group.
+            params_g = (_index(params_dev, 0) if v == 1
+                        else _index(params_dev, g))
             h_in = jax.tree_util.tree_map(
                 lambda st: jax.lax.dynamic_index_in_dim(
-                    st, i % S, 0, keepdims=False), stash)
+                    st, g * Sg + i % Sg, 0, keepdims=False), stash)
 
             def fwd_branch():
                 if mode == "always":
                     h1, contrib = self._f_full(
-                        params_j, pre_params, post_params, h_in, x_mb, w_mb,
-                        kij, j)
+                        params_g, pre_params, post_params, h_in, x_mb, w_mb,
+                        kis, s)
                     new_res = res_store
                 else:
                     (h1, contrib), vjp_fn = self._vjp_wrt(
-                        params_j, pre_params, post_params, h_in, x_mb, w_mb,
-                        kij, j)
+                        params_g, pre_params, post_params, h_in, x_mb, w_mb,
+                        kis, s)
                     leaves = jax.tree_util.tree_leaves(vjp_fn)
                     assert [(l.shape, l.dtype) for l in leaves] == \
-                        [(s.shape, s.dtype) for s in res_specs], \
+                        [(sp_.shape, sp_.dtype) for sp_ in res_specs], \
                         "vjp residual structure drifted from abstract spec"
-                    slot = res_slot_for(i) if mode == "except_last" else i % S
+                    slot = res_slot_for(i, g)
                     new_res = [
                         jax.lax.dynamic_update_index_in_dim(st, l, slot, 0)
                         for st, l in zip(res_store, leaves)]
@@ -328,13 +396,13 @@ class ScheduledPipeline:
 
             def bwd_branch():
                 seed_h = jax.tree_util.tree_map(
-                    lambda g: jnp.where(j == n - 1, jnp.zeros_like(g), g),
+                    lambda gr: jnp.where(s == S - 1, jnp.zeros_like(gr), gr),
                     g_ring)
                 # contribution cotangent: d(masked mean)/d(contrib) = 1/sum(w)
                 seed = (seed_h, inv_wsum)
 
                 def apply_stored():
-                    slot = res_slot_for(i) if mode == "except_last" else i % S
+                    slot = res_slot_for(i, g)
                     leaves = [
                         jax.lax.dynamic_index_in_dim(st, slot, 0,
                                                      keepdims=False)
@@ -344,8 +412,8 @@ class ScheduledPipeline:
 
                 def apply_recomputed():
                     _, vjp_fn = self._vjp_wrt(
-                        params_j, pre_params, post_params, h_in, x_mb, w_mb,
-                        kij, j)
+                        params_g, pre_params, post_params, h_in, x_mb, w_mb,
+                        kis, s)
                     return vjp_fn(seed)
 
                 if mode == "never":
@@ -356,7 +424,17 @@ class ScheduledPipeline:
                     gp, gpre, gpost, gh = jax.lax.cond(
                         i == m - 1, apply_stored, apply_recomputed)
                 add = functools.partial(jax.tree_util.tree_map, jnp.add)
-                return (res_store, add(g_sp, gp), add(g_pre, gpre),
+                # accumulate this group's param grads into its row
+                if v == 1:
+                    g_sp2 = jax.tree_util.tree_map(
+                        lambda G, gg: G + gg[None], g_sp, gp)
+                else:
+                    g_sp2 = jax.tree_util.tree_map(
+                        lambda G, gg: jax.lax.dynamic_update_index_in_dim(
+                            G, jax.lax.dynamic_index_in_dim(
+                                G, g, 0, keepdims=False) + gg, g, 0),
+                        g_sp, gp)
+                return (res_store, g_sp2, add(g_pre, gpre),
                         add(g_post, gpost), loss, h_ring, gh)
 
             def idle_branch():
@@ -365,7 +443,7 @@ class ScheduledPipeline:
             res_store2, g_sp2, g_pre2, g_post2, loss2, tx_h, tx_g = \
                 jax.lax.switch(opj, [idle_branch, fwd_branch, bwd_branch])
 
-            if n > 1:
+            if d > 1:
                 tx_h = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm), tx_h)
                 tx_g = jax.tree_util.tree_map(
@@ -379,22 +457,22 @@ class ScheduledPipeline:
             cycle, carry0, xs)
 
         # --- cross-device reductions ------------------------------------
-        # stage grads: per-stage shards stay put; replicas over other axes sum
+        # stage grads: per-device shards stay put; replicas over other axes
+        # sum
         other_axes = tuple(a for a in self.mesh.axis_names if a != STAGE_AXIS)
         if other_axes:
             g_sp = jax.tree_util.tree_map(
-                lambda g: jax.lax.psum(g, other_axes), g_sp)
+                lambda gg: jax.lax.psum(gg, other_axes), g_sp)
         # pre/post grads + loss: only edge stages contributed; psum collects
         reduce_axes = (STAGE_AXIS,) + other_axes
         g_pre = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, reduce_axes), g_pre)
+            lambda gg: jax.lax.psum(gg, reduce_axes), g_pre)
         g_post = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, reduce_axes), g_post)
+            lambda gg: jax.lax.psum(gg, reduce_axes), g_post)
         loss_axes = ((STAGE_AXIS, DATA_AXIS) if self.has_data_axis
                      else (STAGE_AXIS,))
         loss = jax.lax.psum(loss, loss_axes) * inv_wsum
 
-        g_sp = jax.tree_util.tree_map(lambda g: g[None], g_sp)
         return loss, (g_sp, g_pre, g_post)
 
 

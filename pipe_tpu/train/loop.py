@@ -47,7 +47,7 @@ class TrainerConfig:
     lr_gamma: float = 0.95     # StepLR(1.0, gamma=0.95), main.py:185
     grad_clip: float = 0.5     # main.py:219
     seed: int = 1234
-    schedule: str = "gpipe"    # gpipe | 1f1b | interleaved
+    schedule: str = "gpipe"    # gpipe | 1f1b | interleaved | interleaved-1f1b
     interleave: int = 2        # virtual stages per device (interleaved only)
 
 
@@ -69,17 +69,26 @@ class Trainer:
                 self.mesh, self.model.stage_fn, v=cfg.interleave,
                 pre_fn=self.model.pre_fn, post_fn=self.model.loss_post_fn,
                 post_with_batch=True, checkpoint=cfg.checkpoint)
-        elif cfg.schedule == "1f1b":
+        elif cfg.schedule in ("1f1b", "interleaved-1f1b"):
             # True 1F1B: the manual fwd+bwd executor caps live activations at
             # min(chunks, n_stages) per stage and applies the exact
             # per-micro-batch checkpoint policy (parallel.scheduled).
+            # interleaved-1f1b hosts `interleave` virtual stages per device
+            # (both passes from one static table; see core.schedule).
+            from ..core.schedule import InterleavedOneFOneBSchedule
             from ..parallel.scheduled import ScheduledPipeline
-            self.n_virtual = cfg.n_stages
-            self.model = PipelinedLM(model_cfg, cfg.n_stages)
+            if cfg.schedule == "interleaved-1f1b":
+                sched = InterleavedOneFOneBSchedule(
+                    interleave=cfg.interleave)
+                self.n_virtual = cfg.n_stages * cfg.interleave
+            else:
+                sched = "1f1b"
+                self.n_virtual = cfg.n_stages
+            self.model = PipelinedLM(model_cfg, self.n_virtual)
             self.pipe = ScheduledPipeline(
                 self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
                 post_fn=self.model.loss_post_fn, checkpoint=cfg.checkpoint,
-                schedule="1f1b")
+                schedule=sched)
         elif cfg.schedule == "gpipe":
             self.n_virtual = cfg.n_stages
             self.model = PipelinedLM(model_cfg, cfg.n_stages)
@@ -89,14 +98,26 @@ class Trainer:
                 checkpoint=cfg.checkpoint)
         else:
             raise ValueError(f"unknown schedule {cfg.schedule!r}")
-        self._scheduled = cfg.schedule == "1f1b"
+        self._scheduled = cfg.schedule in ("1f1b", "interleaved-1f1b")
         if self._scheduled:
             # The manual executor is training-only; eval (no grads, no remat)
-            # runs the AD forward executor on the same mesh and params.
-            self.eval_pipe = SpmdPipeline(
-                self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
-                post_fn=self.model.loss_post_fn, post_with_batch=True,
-                checkpoint="never")
+            # runs an AD forward executor on the same mesh and params. The
+            # executor must match the param layout: interleaved stacking
+            # ([v, ...] per device) needs the interleaved executor — a plain
+            # SpmdPipeline would read only group 0's slice and silently
+            # evaluate d of the v*d virtual stages.
+            if cfg.schedule == "interleaved-1f1b":
+                from ..parallel.interleaved import InterleavedSpmdPipeline
+                self.eval_pipe = InterleavedSpmdPipeline(
+                    self.mesh, self.model.stage_fn, v=cfg.interleave,
+                    pre_fn=self.model.pre_fn,
+                    post_fn=self.model.loss_post_fn, post_with_batch=True,
+                    checkpoint="never")
+            else:
+                self.eval_pipe = SpmdPipeline(
+                    self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
+                    post_fn=self.model.loss_post_fn, post_with_batch=True,
+                    checkpoint="never")
         else:
             self.eval_pipe = dataclasses.replace(self.pipe,
                                                  checkpoint="never") \
@@ -117,7 +138,7 @@ class Trainer:
     def init_state(self, key: Optional[jax.Array] = None) -> TrainState:
         key = key if key is not None else jax.random.key(self.cfg.seed)
         sp, prep, postp = self.model.init(key)
-        if self.cfg.schedule == "interleaved":
+        if self.cfg.schedule in ("interleaved", "interleaved-1f1b"):
             from ..parallel.interleaved import stack_interleaved_params
             stacked = stack_interleaved_params(sp, self.cfg.n_stages)
         else:
@@ -169,6 +190,10 @@ class Trainer:
             from ..core.schedule import InterleavedSchedule
             return InterleavedSchedule(v=cfg.interleave).device_bubble(
                 cfg.chunks, cfg.n_stages)
+        if cfg.schedule == "interleaved-1f1b":
+            from ..core.schedule import InterleavedOneFOneBSchedule
+            return InterleavedOneFOneBSchedule(
+                interleave=cfg.interleave).bubble(cfg.chunks, cfg.n_stages)
         return bubble_fraction(cfg.chunks, cfg.n_stages)
 
     # --- steps ---

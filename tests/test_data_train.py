@@ -206,3 +206,34 @@ def test_interleaved_trainer(corpus):
     assert m["loss"] < np.log(model_cfg.vocab)
     l_eval = trainer.evaluate(source, state, max_steps=2)
     assert np.isfinite(l_eval)
+
+
+def test_interleaved_1f1b_eval_covers_all_virtual_stages():
+    """Regression: the eval executor must match the interleaved param layout
+    — a plain SpmdPipeline over the device-major [v, ...] shard would
+    silently evaluate only interleave group 0's layers."""
+    import dataclasses as dc
+
+    from pipe_tpu.core.partition import StageCtx
+
+    cfg = dc.replace(LMConfig().tiny(), n_layers=4, dropout=0.0)
+    tc = TrainerConfig(batch_size=8, bptt=cfg.seq_len, chunks=4,
+                       checkpoint="except_last", n_stages=2, n_data=1,
+                       lr=1e-2, schedule="interleaved-1f1b", interleave=2)
+    tr = Trainer(cfg, tc, devices=jax.devices()[:2])
+    state = tr.init_state()
+    rng = np.random.RandomState(0)
+    corpus = rng.randint(0, cfg.vocab, size=4000)
+    source = lm_text.batchify(corpus, tc.batch_size)
+    got = tr.evaluate(source, state, max_steps=1)
+
+    # serial oracle over ALL v*d virtual stages
+    sp, prep, postp = tr.model.init(jax.random.key(tc.seed))
+    data, target = lm_text.get_batch(source, 0, tc.bptt)
+    h = tr.model.pre_fn(prep, {"tokens": jnp.asarray(data)}, StageCtx())
+    for blocks in sp:
+        h = tr.model.stage_fn(blocks, h, StageCtx())
+    per_row = tr.model.loss_post_fn(
+        postp, h, {"targets": jnp.asarray(target)}, StageCtx())
+    np.testing.assert_allclose(got, float(jnp.mean(per_row)),
+                               rtol=1e-5, atol=1e-6)

@@ -305,3 +305,124 @@ def test_padded_row_masking():
     loss, _ = jax.jit(pipe.loss_and_grad)(stacked, {}, {}, xs, w)
     l_ref = plain_loss_fn(stage_fn, params, x10)
     np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+
+
+# ---------- interleaved 1F1B (BASELINE config #4's schedule) ----------
+
+def _plain_loss_chain(stage_fn, params, x):
+    h = x
+    for p in params:
+        h = stage_fn(p, h, StageCtx())
+    return jnp.mean(jnp.sum((h - 1.0) ** 2, axis=-1))
+
+
+@pytest.mark.parametrize("d,v,m", [(2, 2, 4), (4, 2, 8), (2, 4, 8),
+                                   (3, 2, 6)])
+@pytest.mark.parametrize("mode", ["never", "except_last", "always"])
+def test_interleaved_1f1b_matches_plain(d, v, m, mode):
+    """Loss AND grads of the interleaved manual executor equal the plain
+    chain over all v*d virtual stages."""
+    from pipe_tpu.core.schedule import InterleavedOneFOneBSchedule
+    from pipe_tpu.parallel.interleaved import stack_interleaved_params
+
+    if (d, v, m) != (2, 2, 4) and mode != "except_last":
+        pytest.skip("full mode matrix only at the smallest shape")
+    S = d * v
+    stage_fn, params = make_stage(S, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (m * 2, WIDTH))
+    xm, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xm.shape[:2], jnp.float32)
+    sched = ScheduledPipeline(
+        make_mesh(d, 1, devices=jax.devices()[:d]), stage_fn,
+        pre_fn=lambda p, a, ctx: a,
+        post_fn=lambda p, h, a, ctx: jnp.sum((h - 1.0) ** 2, axis=-1),
+        checkpoint=mode,
+        schedule=InterleavedOneFOneBSchedule(interleave=v))
+    stacked = stack_interleaved_params(params, d)
+    loss, (g_sp, _, _) = jax.jit(
+        lambda a: sched.loss_and_grad(a, {}, {}, xm, w))(stacked)
+
+    exp_loss, exp_g = jax.value_and_grad(
+        lambda p: _plain_loss_chain(stage_fn, p, x))(params)
+    np.testing.assert_allclose(float(loss), float(exp_loss), rtol=1e-5)
+    exp_stacked = stack_interleaved_params(exp_g, d)
+    for a, b in zip(jax.tree_util.tree_leaves(g_sp),
+                    jax.tree_util.tree_leaves(exp_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_1f1b_dropout_exact_except_last():
+    """With dropout active, except_last must equal never bit-for-bit (same
+    key folds; stored vs recomputed residuals replay identical masks)."""
+    from pipe_tpu.core.schedule import InterleavedOneFOneBSchedule
+    from pipe_tpu.parallel.interleaved import stack_interleaved_params
+
+    d, v, m = 2, 2, 4
+    stage_fn, params = make_stage(d * v, jax.random.key(0), dropout=0.3)
+    x = jax.random.normal(jax.random.key(1), (m * 2, WIDTH))
+    xm, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xm.shape[:2], jnp.float32)
+    stacked = stack_interleaved_params(params, d)
+    out = {}
+    for mode in ("never", "except_last", "always"):
+        sched = ScheduledPipeline(
+            make_mesh(d, 1, devices=jax.devices()[:d]), stage_fn,
+            pre_fn=lambda p, a, ctx: a,
+            post_fn=lambda p, h, a, ctx: jnp.sum((h - 1.0) ** 2, axis=-1),
+            checkpoint=mode,
+            schedule=InterleavedOneFOneBSchedule(interleave=v))
+        out[mode] = jax.jit(
+            lambda a: sched.loss_and_grad(a, {}, {}, xm, w,
+                                          key=jax.random.key(5)))(stacked)
+    for mode in ("except_last", "always"):
+        np.testing.assert_array_equal(np.asarray(out["never"][0]),
+                                      np.asarray(out[mode][0]))
+        for a, b in zip(jax.tree_util.tree_leaves(out["never"][1]),
+                        jax.tree_util.tree_leaves(out[mode][1])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_interleaved_1f1b_tables_and_memory_plan():
+    from pipe_tpu.core.schedule import (InterleavedOneFOneBSchedule,
+                                        verify_interleaved_op_tables)
+
+    s = InterleavedOneFOneBSchedule(interleave=2)
+    for (m, d) in [(4, 2), (8, 4), (16, 4)]:
+        op, mbt, grp = s.op_tables(m, d)
+        verify_interleaved_op_tables(op, mbt, grp, m, d, 2)
+        # the interleave shrinks the schedule vs plain 1F1B of depth v*d
+        assert op.shape[0] < 2 * (m * 2 + 2 * d - 1)
+
+    sched = ScheduledPipeline(
+        make_mesh(2, 1, devices=jax.devices()[:2]),
+        lambda p, h, ctx: h, pre_fn=lambda p, a, ctx: a,
+        post_fn=lambda p, h, a, ctx: jnp.sum(h, axis=-1),
+        checkpoint="except_last", schedule=s)
+    plan = sched.memory_plan(8)
+    assert plan["virtual_stages_per_device"] == 2
+    assert plan["residual_slots"] == 2          # one per group (except_last)
+    assert plan["stash_slots"] == 2 * plan["stash_slots_per_virtual_stage"]
+
+
+def test_interleaved_1f1b_with_data_axis():
+    from pipe_tpu.core.schedule import InterleavedOneFOneBSchedule
+    from pipe_tpu.parallel.interleaved import stack_interleaved_params
+
+    d, v, m = 2, 2, 4
+    stage_fn, params = make_stage(d * v, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (m * 4, WIDTH))
+    xm, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xm.shape[:2], jnp.float32)
+    sched = ScheduledPipeline(
+        make_mesh(d, 2, devices=jax.devices()[:2 * d]), stage_fn,
+        pre_fn=lambda p, a, ctx: a,
+        post_fn=lambda p, h, a, ctx: jnp.sum((h - 1.0) ** 2, axis=-1),
+        checkpoint="except_last",
+        schedule=InterleavedOneFOneBSchedule(interleave=v))
+    stacked = stack_interleaved_params(params, d)
+    loss, _ = jax.jit(
+        lambda a: sched.loss_and_grad(a, {}, {}, xm, w))(stacked)
+    exp = _plain_loss_chain(stage_fn, params, x)
+    np.testing.assert_allclose(float(loss), float(exp), rtol=1e-5)
