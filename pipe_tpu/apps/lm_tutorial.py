@@ -30,7 +30,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--stages", type=int, default=2)
     p.add_argument("--chunks", type=int, default=4)
-    p.add_argument("--schedule", choices=["gpipe", "interleaved"],
+    p.add_argument("--schedule",
+                   choices=["gpipe", "1f1b", "interleaved",
+                            "interleaved-1f1b"],
                    default="gpipe")
     p.add_argument("--lr", type=float, default=None,
                    help="override the reference's Adam lr=5.0 (main.py:183), "
@@ -81,7 +83,7 @@ def main(argv=None) -> int:
                                   bptt=model_cfg.seq_len, lr=1e-3)
     if args.lr is not None:  # explicit --lr beats the tiny default
         cfg = dataclasses.replace(cfg, lr=args.lr)
-    if args.schedule == "interleaved" and args.tiny:
+    if args.schedule in ("interleaved", "interleaved-1f1b") and args.tiny:
         model_cfg = dataclasses.replace(
             model_cfg, n_layers=args.stages * args.interleave)
 
