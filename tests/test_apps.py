@@ -1,0 +1,27 @@
+"""Driver CLIs run end-to-end on the virtual CPU mesh (the reference's
+runnable-tutorial-as-integration-test pattern, SURVEY §4)."""
+
+import pytest
+
+from pipe_tpu.apps import lm_tutorial, zoo
+
+
+def test_lm_tutorial_tiny(capsys):
+    rc = lm_tutorial.main(["except_last", "--tiny", "--steps", "3",
+                           "--schedule", "1f1b"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "loss" in out and "val loss" in out
+
+
+@pytest.mark.parametrize("family,schedule", [
+    ("gpt2", "1f1b"),
+    ("bert", "interleaved-1f1b"),
+    ("vit", "gpipe"),
+])
+def test_zoo_families(family, schedule, capsys):
+    rc = zoo.main([family, "--tiny", "--steps", "2",
+                   "--schedule", schedule])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "final loss" in out
