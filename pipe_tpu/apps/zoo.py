@@ -43,9 +43,12 @@ def build_argparser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_argparser().parse_args(argv)
+    parser = build_argparser()
+    args = parser.parse_args(argv)
     if args.steps < 1:
-        build_argparser().error("--steps must be >= 1")
+        parser.error("--steps must be >= 1")
+    if args.stages < 1 or args.interleave < 1:
+        parser.error("--stages and --interleave must be >= 1")
     if args.cpu:
         from pipe_tpu.utils.platform import force_cpu_platform
         force_cpu_platform(args.cpu)
@@ -126,6 +129,9 @@ def main(argv=None) -> int:
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
     else:
+        if args.schedule == "interleaved-1f1b" and v == 1:
+            print("note: --interleave 1 makes interleaved-1f1b the plain "
+                  "1f1b schedule")
         sched_obj = (InterleavedOneFOneBSchedule(interleave=v)
                      if v > 1 else "1f1b")
         sched = ScheduledPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
@@ -146,12 +152,8 @@ def main(argv=None) -> int:
     t_start = t0 = time.perf_counter()
     for b in range(args.steps):
         stacked_x, n_rows = mb.stack_scatter(batch_for(b), args.chunks)
-        # valid-row mask: zero out rows stack_scatter padded for
-        # non-divisible batches (the Trainer._make_x pattern, VERDICT r1 #7)
-        chunks_n, mb_rows = jax.tree_util.tree_leaves(
-            stacked_x)[0].shape[:2]
-        idx = jnp.arange(chunks_n * mb_rows).reshape(chunks_n, mb_rows)
-        w = (idx < n_rows).astype(jnp.float32)
+        # zero-weight the rows stack_scatter padded (VERDICT r1 #7)
+        w = mb.valid_row_mask(stacked_x, n_rows)
         params, opt_state, loss = step_fn(params, opt_state, stacked_x, w,
                                           jax.random.key(b))
         l = float(loss)

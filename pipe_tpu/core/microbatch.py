@@ -313,6 +313,21 @@ def stack_scatter(tree: Any, chunks: int) -> Tuple[Any, int]:
     return stacked, batch_size
 
 
+def valid_row_mask(stacked: Any, batch_size: int):
+    """``[chunks, mb_rows]`` float mask of real rows in a stacked batch.
+
+    Owns the padding-layout knowledge: :func:`stack_scatter` pads at the
+    TAIL of the flattened batch, so row ``(c, r)`` is real iff its flat
+    index ``c * mb_rows + r`` is below the true batch size. Weight losses
+    with it so zero-padded rows never contaminate loss or gradients.
+    """
+    import jax.numpy as jnp
+
+    chunks_n, mb_rows = jax.tree_util.tree_leaves(stacked)[0].shape[:2]
+    idx = jnp.arange(chunks_n * mb_rows).reshape(chunks_n, mb_rows)
+    return (idx < batch_size).astype(jnp.float32)
+
+
 def stack_gather(tree: Any, batch_size: int) -> Any:
     """Inverse of :func:`stack_scatter`: ``[chunks, mb, ...] -> [n, ...]``.
 

@@ -282,8 +282,15 @@ class MultiHeadAttention(Module):
         # interpret mode and unsupported tilings use the XLA path.
         on_tpu = jax.default_backend() == "tpu"
         dropout_active = self.dropout > 0.0 and ctx.train and dk is not None
+        # auto: flash only from FLASH_AUTO_MIN_SEQ up — measured on v5e-lite
+        # (520M LM, bf16): a single 128-token block can't amortize the
+        # kernel (XLA +3.7% at s=128), flash wins from s=256 (+1.9%) and
+        # grows with s (and is the only option at memory-bound lengths).
+        # Explicit impl="flash" bypasses the heuristic.
         use_flash = (not dropout_active or on_tpu) and (
-            self.impl == "flash" or (self.impl == "auto" and on_tpu))
+            self.impl == "flash"
+            or (self.impl == "auto" and on_tpu
+                and s >= FLASH_AUTO_MIN_SEQ))
         if use_flash:
             from .pallas_attention import flash_attention, supports
             use_flash = supports(s)
@@ -301,6 +308,10 @@ class MultiHeadAttention(Module):
 
 
 _ACTIVATIONS = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}
+
+# Minimum sequence length at which impl="auto" selects the Pallas flash
+# kernel on TPU (measured crossover; see MultiHeadAttention.apply).
+FLASH_AUTO_MIN_SEQ = 256
 
 
 class _TransformerBlockBase(Module):

@@ -236,9 +236,7 @@ class Trainer:
         """Stack-scatter the batch; return it with the valid-row mask."""
         x = {"tokens": jnp.asarray(data), "targets": jnp.asarray(target)}
         stacked, n_rows = mb.stack_scatter(x, self.cfg.chunks)
-        chunks, mb_rows = stacked["tokens"].shape[:2]
-        idx = jnp.arange(chunks * mb_rows).reshape(chunks, mb_rows)
-        return stacked, (idx < n_rows).astype(jnp.float32)
+        return stacked, mb.valid_row_mask(stacked, n_rows)
 
     # --- epochs ---
 
