@@ -169,6 +169,24 @@ def make_plain_step(model, tx, microbatches: int = 1):
     return jax.jit(train_step, donate_argnums=(0, 1))
 
 
+def with_retries(fn, attempts: int = 3, wait_s: float = 20.0):
+    """Run fn(), retrying transient remote-compile tunnel failures.
+
+    The axon PJRT bridge intermittently drops fresh compile requests
+    (INTERNAL: remote_compile: response body closed); a short pause and a
+    retry succeeds (and usually hits the compile cache).
+    """
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # jax.errors.JaxRuntimeError and kin
+            if attempt == attempts - 1 or "INTERNAL" not in str(e):
+                raise
+            print(f"transient backend error (attempt {attempt + 1}): "
+                  f"{str(e)[:120]}", file=sys.stderr)
+            time.sleep(wait_s)
+
+
 def time_steps(step_fn, params, opt_state, args, warmup=2, iters=8):
     """Per-step wall time with a ONE-STEP-LAGGED host value fetch.
 
@@ -207,12 +225,15 @@ def main():
 
     model = PipelinedLM(cfg, n_stages)
     stage_params, pre_params, post_params = model.init(jax.random.key(0))
-    params = (stack_stage_params(stage_params), pre_params, post_params)
-    # fresh buffers: the pipelined step donates its inputs, and pre/post
-    # params are shared between the two trees
-    plain_params = jax.tree_util.tree_map(
-        lambda a: jnp.array(a, copy=True),
-        (stage_params, pre_params, post_params))
+    # plain_params is the never-donated master copy; every timed step gets
+    # fresh buffers from it (steps donate their inputs, and a retry after a
+    # transient tunnel failure must not see deleted buffers).
+    plain_params = (stage_params, pre_params, post_params)
+
+    def fresh(stacked: bool):
+        p = jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True),
+                                   plain_params)
+        return (stack_stage_params(p[0]), p[1], p[2]) if stacked else p
 
     n_params = model.num_params(plain_params)
     spmd = SpmdPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
@@ -227,8 +248,12 @@ def main():
     key = jax.random.key(2)
 
     step = make_step(model, spmd, tx)
-    sec_per_step, loss = time_steps(
-        step, params, tx.init(params), (x, key))
+
+    def timed_pipeline():
+        p = fresh(stacked=True)
+        return time_steps(step, p, tx.init(p), (x, key))
+
+    sec_per_step, loss = with_retries(timed_pipeline)
     tokens_per_step = BATCH * cfg.seq_len
     pipe_tps_chip = tokens_per_step / sec_per_step / n_stages
 
@@ -243,10 +268,12 @@ def main():
         targets2 = jnp.roll(tokens2, -1, axis=-1)
         x2, _ = mb.stack_scatter({"tokens": tokens2, "targets": targets2},
                                  2 * CHUNKS)
-        p2 = jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True),
-                                    plain_params)
-        p2 = (stack_stage_params(p2[0]), p2[1], p2[2])
-        sec_2m, _ = time_steps(step, p2, tx.init(p2), (x2, key))
+
+        def timed_2m():
+            p2 = fresh(stacked=True)
+            return time_steps(step, p2, tx.init(p2), (x2, key))
+
+        sec_2m, _ = with_retries(timed_2m)
         measured_bubble = measured_bubble_slope(sec_per_step, sec_2m, CHUNKS)
     except Exception as e:
         print(f"bubble slope timing failed: {e}", file=sys.stderr)
@@ -272,17 +299,23 @@ def main():
     vs_baseline = vs_fullbatch = 0.0
     try:
         plain_acc = make_plain_step(model, tx, microbatches=CHUNKS)
-        acc_params = jax.tree_util.tree_map(
-            lambda a: jnp.array(a, copy=True), plain_params)
-        acc_sec, _ = time_steps(
-            plain_acc, acc_params, tx.init(acc_params),
-            (tokens, targets, key))
+
+        def timed_acc():
+            p = fresh(stacked=False)
+            return time_steps(plain_acc, p, tx.init(p),
+                              (tokens, targets, key))
+
+        acc_sec, _ = with_retries(timed_acc)
         vs_baseline = pipe_tps_chip / (tokens_per_step / acc_sec)
         if CHUNKS > 1:
             plain = make_plain_step(model, tx)
-            plain_sec, _ = time_steps(
-                plain, plain_params, tx.init(plain_params),
-                (tokens, targets, key))
+
+            def timed_full():
+                p = fresh(stacked=False)
+                return time_steps(plain, p, tx.init(p),
+                                  (tokens, targets, key))
+
+            plain_sec, _ = with_retries(timed_full)
             vs_fullbatch = pipe_tps_chip / (tokens_per_step / plain_sec)
         else:
             vs_fullbatch = vs_baseline
