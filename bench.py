@@ -231,9 +231,20 @@ def main():
     plain_params = (stage_params, pre_params, post_params)
 
     def fresh(stacked: bool):
-        p = jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True),
-                                   plain_params)
-        return (stack_stage_params(p[0]), p[1], p[2]) if stacked else p
+        # jnp.stack already allocates new buffers for the stage tree, so
+        # only the (donated) pre/post trees need explicit copies there.
+        copy = lambda t: jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True), t)
+        if stacked:
+            return (stack_stage_params(plain_params[0]),
+                    copy(plain_params[1]), copy(plain_params[2]))
+        return copy(plain_params)
+
+    def timed(step_fn, stacked, args):
+        def run():
+            p = fresh(stacked)
+            return time_steps(step_fn, p, tx.init(p), args)
+        return with_retries(run)
 
     n_params = model.num_params(plain_params)
     spmd = SpmdPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
@@ -248,12 +259,7 @@ def main():
     key = jax.random.key(2)
 
     step = make_step(model, spmd, tx)
-
-    def timed_pipeline():
-        p = fresh(stacked=True)
-        return time_steps(step, p, tx.init(p), (x, key))
-
-    sec_per_step, loss = with_retries(timed_pipeline)
+    sec_per_step, loss = timed(step, True, (x, key))
     tokens_per_step = BATCH * cfg.seq_len
     pipe_tps_chip = tokens_per_step / sec_per_step / n_stages
 
@@ -269,11 +275,7 @@ def main():
         x2, _ = mb.stack_scatter({"tokens": tokens2, "targets": targets2},
                                  2 * CHUNKS)
 
-        def timed_2m():
-            p2 = fresh(stacked=True)
-            return time_steps(step, p2, tx.init(p2), (x2, key))
-
-        sec_2m, _ = with_retries(timed_2m)
+        sec_2m, _ = timed(step, True, (x2, key))
         measured_bubble = measured_bubble_slope(sec_per_step, sec_2m, CHUNKS)
     except Exception as e:
         print(f"bubble slope timing failed: {e}", file=sys.stderr)
@@ -299,23 +301,11 @@ def main():
     vs_baseline = vs_fullbatch = 0.0
     try:
         plain_acc = make_plain_step(model, tx, microbatches=CHUNKS)
-
-        def timed_acc():
-            p = fresh(stacked=False)
-            return time_steps(plain_acc, p, tx.init(p),
-                              (tokens, targets, key))
-
-        acc_sec, _ = with_retries(timed_acc)
+        acc_sec, _ = timed(plain_acc, False, (tokens, targets, key))
         vs_baseline = pipe_tps_chip / (tokens_per_step / acc_sec)
         if CHUNKS > 1:
             plain = make_plain_step(model, tx)
-
-            def timed_full():
-                p = fresh(stacked=False)
-                return time_steps(plain, p, tx.init(p),
-                                  (tokens, targets, key))
-
-            plain_sec, _ = with_retries(timed_full)
+            plain_sec, _ = timed(plain, False, (tokens, targets, key))
             vs_fullbatch = pipe_tps_chip / (tokens_per_step / plain_sec)
         else:
             vs_fullbatch = vs_baseline
