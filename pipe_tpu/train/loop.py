@@ -25,6 +25,7 @@ from ..models.transformer_lm import LMConfig, PipelinedLM
 from ..parallel.mesh import make_mesh
 from ..parallel.spmd import SpmdPipeline, stack_stage_params
 from ..data import lm_text
+from ..utils.rng import make_key
 from .state import TrainState
 
 __all__ = ["TrainerConfig", "Trainer"]
@@ -136,7 +137,7 @@ class Trainer:
     # --- state ---
 
     def init_state(self, key: Optional[jax.Array] = None) -> TrainState:
-        key = key if key is not None else jax.random.key(self.cfg.seed)
+        key = key if key is not None else make_key(self.cfg.seed)
         sp, prep, postp = self.model.init(key)
         if self.cfg.schedule in ("interleaved", "interleaved-1f1b"):
             from ..parallel.interleaved import stack_interleaved_params
@@ -228,7 +229,7 @@ class Trainer:
                           step=state.step + 1), loss
 
     def _eval_loss(self, params, x, w):
-        return self._loss(params, x, w, jax.random.key(0), False)
+        return self._loss(params, x, w, make_key(0), False)
 
     # --- data plumbing ---
 
@@ -252,7 +253,7 @@ class Trainer:
         n = lm_text.num_batches(source, cfg.bptt)
         if max_steps is not None:
             n = min(n, max_steps)
-        key = jax.random.fold_in(jax.random.key(cfg.seed), epoch)
+        key = jax.random.fold_in(make_key(cfg.seed), epoch)
 
         tokens_per_step = cfg.batch_size * cfg.bptt
         t_first = t0 = time.perf_counter()
