@@ -52,6 +52,7 @@ from ..core import microbatch as mb
 from ..core.partition import StageCtx
 from ..core.remat import apply_remat, checkpoint_stop, validate_mode
 from .mesh import DATA_AXIS, STAGE_AXIS
+from ..utils.rng import make_key
 
 __all__ = ["HeteroSpmdPipeline"]
 
@@ -198,7 +199,7 @@ class HeteroSpmdPipeline:
         out_specs_local = boundaries[n]
 
         keyed = key is not None
-        key = key if keyed else jax.random.key(0)
+        key = key if keyed else make_key(0)
         stop = checkpoint_stop(self.checkpoint, m, train)
 
         # --- shard_map specs --------------------------------------------

@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.partition import StageCtx
 from ..core.remat import checkpoint_stop, validate_mode
 from .mesh import DATA_AXIS, STAGE_AXIS
+from ..utils.rng import make_key
 
 __all__ = ["InterleavedSpmdPipeline", "stack_interleaved_params"]
 
@@ -129,7 +130,7 @@ class InterleavedSpmdPipeline:
                 f"(m={m} < d={d}): an activation's buffer slot must free "
                 f"before its next-group replacement arrives")
         stop = checkpoint_stop(self.checkpoint, m, train)
-        key = key if key is not None else jax.random.key(0)
+        key = key if key is not None else make_key(0)
         data = DATA_AXIS if self.has_data_axis else None
         ctx0 = StageCtx(key=None, train=train)
 

@@ -58,6 +58,13 @@ except_last    v·Sg slots             v slots (micro-batch m-1)
 never          v·Sg slots             v·Sg slots (recompute none)
 =============  =====================  ==========================
 
+plus ``Sg`` activation-sized slots parking the last virtual stage's outputs.
+The post (decode/loss) is NEVER part of the stored residuals — its vjp is
+rebuilt fresh at backward time from the parked output, because post residuals
+are vocab-scale (a [rows, seq, vocab] logits tensor plus a weight-cast copy,
+hundreds of MB at tutorial scale) and slot structure replicates across every
+slot; folding the post in OOMed a 16G v5e on the 520M tutorial config.
+
 Parameter layout: the stage axis stacks all ``v·d`` virtual stages
 device-major (``stack_interleaved_params`` ordering: global row ``p·v + g``
 = virtual stage ``g·d + p``), so each device's shard is its ``v`` groups in
@@ -82,6 +89,7 @@ from ..core.schedule import (BWD, FWD, GPipeSchedule,
                              InterleavedOneFOneBSchedule, OneFOneBSchedule,
                              Schedule, get_schedule)
 from .mesh import DATA_AXIS, STAGE_AXIS
+from ..utils.rng import make_key
 
 __all__ = ["ScheduledPipeline"]
 
@@ -150,7 +158,7 @@ class ScheduledPipeline:
              "never": v * Sg}[self.checkpoint]
         return {"cycles": self._cycles(m), "stash_slots": v * Sg,
                 "stash_slots_per_virtual_stage": Sg, "residual_slots": R,
-                "virtual_stages_per_device": v}
+                "h_last_slots": Sg, "virtual_stages_per_device": v}
 
     def _cycles(self, m: int) -> int:
         tables = self.schedule.op_tables(m, self.n_stages)
@@ -172,7 +180,7 @@ class ScheduledPipeline:
         if not x_leaves:
             raise TypeError("x must contain at least one array leaf")
         m = x_leaves[0].shape[0]
-        key = key if key is not None else jax.random.key(0)
+        key = key if key is not None else make_key(0)
         data = DATA_AXIS if self.has_data_axis else None
 
         def x_spec(l):
@@ -202,17 +210,24 @@ class ScheduledPipeline:
         return run(stage_params, pre_params, post_params, x, w, key)
 
     # -----------------------------------------------------------------
-    def _f_full(self, params_g, prep, postp, h_in, x_mb, w_mb, kis, s):
+    def _f_body(self, params_g, prep, h_in, x_mb, kis, s):
         """The per-(cycle, device) forward for virtual stage ``s``: pre
-        (stage 0 only) → body → loss contribution (last stage only).
-        Everything the backward needs to differentiate is an explicit
-        argument — no closure over device state (in particular no
-        collective-derived values like the global weight sum, which would
-        change the vjp residual structure under shard_map) — so the residual
-        structure is derivable abstractly. The contribution is UNNORMALIZED
-        (``sum(w * per_row)``); the executor divides the loss and scales the
-        backward seed by ``1/sum(w)``."""
-        S = self.n_virtual
+        (stage 0 only) → stage body. Everything the backward needs to
+        differentiate is an explicit argument — no closure over device state
+        (in particular no collective-derived values like the global weight
+        sum, which would change the vjp residual structure under shard_map) —
+        so the residual structure is derivable abstractly.
+
+        The post (decode/loss) is deliberately NOT part of this function:
+        its vjp residuals are vocab-scale ([rows, seq, vocab] logits plus a
+        weight-cast copy — hundreds of MB at tutorial scale) and the residual
+        store replicates slot structure across every (virtual stage, slot),
+        so folding the post into the stored vjp OOMs a 16G chip. Instead the
+        executor stashes the last stage's ~activation-sized output and
+        rebuilds the post vjp fresh at backward time (:meth:`_post_contrib`)
+        — the compiled analogue of the reference keeping the loss OUTSIDE
+        ``Pipe`` and feeding its gradient into the recorded graph
+        (``main.py:216-218``)."""
         train = True
         h0 = jax.lax.cond(
             s == 0,
@@ -220,24 +235,25 @@ class ScheduledPipeline:
                                 StageCtx(key=jax.random.fold_in(kis, 0),
                                          train=train)),
             lambda: h_in)
-        h1 = self.stage_fn(params_g, h0,
-                           StageCtx(key=jax.random.fold_in(kis, 1),
-                                    train=train))
-        contrib = jax.lax.cond(
-            s == S - 1,
-            lambda: jnp.sum(
-                w_mb * self.post_fn(postp, h1, x_mb,
-                                    StageCtx(key=jax.random.fold_in(kis, 2),
-                                             train=train))
-            ).astype(jnp.float32),
-            lambda: jnp.zeros((), jnp.float32))
-        return h1, contrib
+        return self.stage_fn(params_g, h0,
+                             StageCtx(key=jax.random.fold_in(kis, 1),
+                                      train=train))
 
-    def _vjp_wrt(self, params_g, prep, postp, h_in, x_mb, w_mb, kis, s):
-        """vjp of :meth:`_f_full` w.r.t. (group params, pre, post, h_in)."""
+    def _post_contrib(self, postp, h1, x_mb, w_mb, kis):
+        """UNNORMALIZED loss contribution ``sum(w * per_row)`` of one
+        micro-batch; the executor divides by the global ``sum(w)`` and seeds
+        its backward with ``1/sum(w)``."""
+        return jnp.sum(
+            w_mb * self.post_fn(postp, h1, x_mb,
+                                StageCtx(key=jax.random.fold_in(kis, 2),
+                                         train=True))
+        ).astype(jnp.float32)
+
+    def _vjp_wrt(self, params_g, prep, h_in, x_mb, kis, s):
+        """vjp of :meth:`_f_body` w.r.t. (group params, pre, h_in)."""
         return jax.vjp(
-            lambda a, b, c, dd: self._f_full(a, b, c, dd, x_mb, w_mb, kis, s),
-            params_g, prep, postp, h_in)
+            lambda a, b, dd: self._f_body(a, b, dd, x_mb, kis, s),
+            params_g, prep, h_in)
 
     # -----------------------------------------------------------------
     def _host_tables(self, m):
@@ -292,7 +308,6 @@ class ScheduledPipeline:
         # --- local shape specs -------------------------------------------
         ctx0 = StageCtx(key=None, train=True)
         x_mb_spec = jax.eval_shape(lambda a: _index_spec(a), x)
-        w_mb_spec = jax.eval_shape(lambda a: _index_spec(a), w)
         h_spec = jax.eval_shape(
             lambda p, a: self.pre_fn(p, a, ctx0), pre_params, x_mb_spec)
         params_g_spec = jax.eval_shape(lambda p: _index_spec(p), params_dev)
@@ -300,9 +315,9 @@ class ScheduledPipeline:
         # Canonical vjp structure (abstract — no tracers leak in):
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         key_spec = jax.eval_shape(lambda: jax.random.key(0))
-        (_, _), vjp_fn_spec = jax.eval_shape(
-            self._vjp_wrt, params_g_spec, pre_params, post_params, h_spec,
-            x_mb_spec, w_mb_spec, key_spec, i32)
+        _, vjp_fn_spec = jax.eval_shape(
+            self._vjp_wrt, params_g_spec, pre_params, h_spec,
+            x_mb_spec, key_spec, i32)
         res_specs, res_treedef = jax.tree_util.tree_flatten(vjp_fn_spec)
         inv_wsum = 1.0 / wsum
 
@@ -320,12 +335,27 @@ class ScheduledPipeline:
             # one extra sentinel slot so masked writes need no read-back
             return jnp.zeros((k + 1,) + tuple(spec.shape), spec.dtype)
 
+        def exact_slots_of(spec, k):
+            # sentinel-free: writes are cond-gated, never masked-to-sentinel.
+            # This matters for the residual store, where one sentinel slot
+            # would double memory at v = Sg = 1 (and every not-saved forward
+            # would stream a full residual set into it).
+            return jnp.zeros((k,) + tuple(spec.shape), spec.dtype)
+
         h_ring = jax.tree_util.tree_map(zeros_of, h_spec)
         g_ring = jax.tree_util.tree_map(zeros_of, h_spec)
         stash = jax.tree_util.tree_map(
             lambda s_: slots_of(s_, v * Sg), h_spec)
+        # Last virtual stage's outputs, parked until their backward rebuilds
+        # the post vjp (activation-sized — the whole point of keeping the
+        # post out of res_store; see _f_body docstring). Sg slots suffice:
+        # h1 of micro-batch i goes live at FWD(i, S-1), no earlier than the
+        # stash arrival the Sg FIFO proof bounds, and frees at the same
+        # BWD(i, S-1).
+        h_last = jax.tree_util.tree_map(
+            lambda s_: exact_slots_of(s_, Sg), h_spec)
         n_res = self.memory_plan(m)["residual_slots"]
-        res_store = ([slots_of(s_, n_res) for s_ in res_specs]
+        res_store = ([exact_slots_of(s_, n_res) for s_ in res_specs]
                      if mode != "always" else [])
         g_sp = jax.tree_util.tree_map(jnp.zeros_like, params_dev)
         g_pre = jax.tree_util.tree_map(jnp.zeros_like, pre_params)
@@ -340,16 +370,15 @@ class ScheduledPipeline:
             bwd_perm = [(q, (q - 1) % d) for q in range(d)]
 
         def res_slot_for(i, g):
-            """Where (micro-batch i, group g)'s residuals live (sentinel
-            slot when unsaved)."""
+            """Where (micro-batch i, group g)'s residuals live. Saves are
+            cond-gated, so this is only consulted for saved micro-batches."""
             if mode == "never":
                 return g * Sg + i % Sg
-            # except_last: slot g holds micro-batch m-1, slot v is sentinel
-            return jnp.where(i == m - 1, g, v)
+            return g  # except_last: slot g holds micro-batch m-1
 
         def cycle(carry, row):
-            h_ring, g_ring, stash, res_store, g_sp, g_pre, g_post, loss = \
-                carry
+            (h_ring, g_ring, stash, h_last, res_store, g_sp, g_pre, g_post,
+             loss) = carry
             op_r, mb_r, grp_r, rx_r = row
             opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
             i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
@@ -374,32 +403,74 @@ class ScheduledPipeline:
                     st, g * Sg + i % Sg, 0, keepdims=False), stash)
 
             def fwd_branch():
-                if mode == "always":
-                    h1, contrib = self._f_full(
-                        params_g, pre_params, post_params, h_in, x_mb, w_mb,
-                        kis, s)
-                    new_res = res_store
-                else:
-                    (h1, contrib), vjp_fn = self._vjp_wrt(
-                        params_g, pre_params, post_params, h_in, x_mb, w_mb,
-                        kis, s)
+                def vjp_and_store():
+                    h1, vjp_fn = self._vjp_wrt(
+                        params_g, pre_params, h_in, x_mb, kis, s)
                     leaves = jax.tree_util.tree_leaves(vjp_fn)
                     assert [(l.shape, l.dtype) for l in leaves] == \
                         [(sp_.shape, sp_.dtype) for sp_ in res_specs], \
                         "vjp residual structure drifted from abstract spec"
                     slot = res_slot_for(i, g)
-                    new_res = [
+                    return h1, [
                         jax.lax.dynamic_update_index_in_dim(st, l, slot, 0)
                         for st, l in zip(res_store, leaves)]
-                return (new_res, g_sp, g_pre, g_post, loss + contrib,
-                        h1, g_ring)
+
+                def body_only():
+                    return (self._f_body(params_g, pre_params, h_in, x_mb,
+                                         kis, s), res_store)
+
+                if mode == "always":
+                    h1, new_res = body_only()
+                elif mode == "never":
+                    h1, new_res = vjp_and_store()
+                else:
+                    # except_last: ONLY micro-batch m-1 pays the residual
+                    # capture and store; the rest run the plain body (they
+                    # recompute at BWD). Without the gate every forward
+                    # would stream a full residual set into a sentinel slot
+                    # — wasted HBM traffic and a doubled store.
+                    h1, new_res = jax.lax.cond(
+                        i == m - 1, vjp_and_store, body_only)
+                is_last = s == S - 1
+                # loss contribution: forward value only (its vjp is rebuilt
+                # at BWD time from the parked h1 — never stored)
+                contrib = jax.lax.cond(
+                    is_last,
+                    lambda: self._post_contrib(post_params, h1, x_mb, w_mb,
+                                               kis),
+                    lambda: jnp.zeros((), jnp.float32))
+                new_h_last = jax.lax.cond(
+                    is_last,
+                    lambda: jax.tree_util.tree_map(
+                        lambda st, l: jax.lax.dynamic_update_index_in_dim(
+                            st, l, i % Sg, 0), h_last, h1),
+                    lambda: h_last)
+                return (new_h_last, new_res, g_sp, g_pre, g_post,
+                        loss + contrib, h1, g_ring)
 
             def bwd_branch():
-                seed_h = jax.tree_util.tree_map(
-                    lambda gr: jnp.where(s == S - 1, jnp.zeros_like(gr), gr),
-                    g_ring)
-                # contribution cotangent: d(masked mean)/d(contrib) = 1/sum(w)
-                seed = (seed_h, inv_wsum)
+                is_last = s == S - 1
+
+                # Last stage: rebuild the post vjp FRESH from the parked h1
+                # (no vocab-scale residuals live in the carry; the compiled
+                # analogue of the reference's loss living outside Pipe and
+                # its gradient seeding the recorded graph, main.py:216-218).
+                # Cotangent of the contribution: d(masked mean) = 1/sum(w).
+                def post_seed():
+                    h1 = jax.tree_util.tree_map(
+                        lambda st: jax.lax.dynamic_index_in_dim(
+                            st, i % Sg, 0, keepdims=False), h_last)
+                    _, post_vjp = jax.vjp(
+                        lambda pp, hh: self._post_contrib(pp, hh, x_mb, w_mb,
+                                                          kis),
+                        post_params, h1)
+                    return post_vjp(inv_wsum)
+
+                def ring_seed():
+                    return (jax.tree_util.tree_map(jnp.zeros_like,
+                                                   post_params), g_ring)
+
+                gpost, seed_h = jax.lax.cond(is_last, post_seed, ring_seed)
 
                 def apply_stored():
                     slot = res_slot_for(i, g)
@@ -408,20 +479,19 @@ class ScheduledPipeline:
                                                      keepdims=False)
                         for st in res_store]
                     vjp_fn = jax.tree_util.tree_unflatten(res_treedef, leaves)
-                    return vjp_fn(seed)
+                    return vjp_fn(seed_h)
 
                 def apply_recomputed():
                     _, vjp_fn = self._vjp_wrt(
-                        params_g, pre_params, post_params, h_in, x_mb, w_mb,
-                        kis, s)
-                    return vjp_fn(seed)
+                        params_g, pre_params, h_in, x_mb, kis, s)
+                    return vjp_fn(seed_h)
 
                 if mode == "never":
-                    gp, gpre, gpost, gh = apply_stored()
+                    gp, gpre, gh = apply_stored()
                 elif mode == "always":
-                    gp, gpre, gpost, gh = apply_recomputed()
+                    gp, gpre, gh = apply_recomputed()
                 else:  # except_last: stored for m-1, recomputed otherwise
-                    gp, gpre, gpost, gh = jax.lax.cond(
+                    gp, gpre, gh = jax.lax.cond(
                         i == m - 1, apply_stored, apply_recomputed)
                 add = functools.partial(jax.tree_util.tree_map, jnp.add)
                 # accumulate this group's param grads into its row
@@ -434,26 +504,28 @@ class ScheduledPipeline:
                             G, jax.lax.dynamic_index_in_dim(
                                 G, g, 0, keepdims=False) + gg, g, 0),
                         g_sp, gp)
-                return (res_store, g_sp2, add(g_pre, gpre),
+                return (h_last, res_store, g_sp2, add(g_pre, gpre),
                         add(g_post, gpost), loss, h_ring, gh)
 
             def idle_branch():
-                return (res_store, g_sp, g_pre, g_post, loss, h_ring, g_ring)
+                return (h_last, res_store, g_sp, g_pre, g_post, loss,
+                        h_ring, g_ring)
 
-            res_store2, g_sp2, g_pre2, g_post2, loss2, tx_h, tx_g = \
-                jax.lax.switch(opj, [idle_branch, fwd_branch, bwd_branch])
+            (h_last2, res_store2, g_sp2, g_pre2, g_post2, loss2, tx_h,
+             tx_g) = jax.lax.switch(opj, [idle_branch, fwd_branch,
+                                          bwd_branch])
 
             if d > 1:
                 tx_h = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm), tx_h)
                 tx_g = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm), tx_g)
-            return (tx_h, tx_g, stash, res_store2, g_sp2, g_pre2, g_post2,
-                    loss2), None
+            return (tx_h, tx_g, stash, h_last2, res_store2, g_sp2, g_pre2,
+                    g_post2, loss2), None
 
-        carry0 = (h_ring, g_ring, stash, res_store, g_sp, g_pre, g_post,
-                  loss0)
-        (_, _, _, _, g_sp, g_pre, g_post, loss), _ = jax.lax.scan(
+        carry0 = (h_ring, g_ring, stash, h_last, res_store, g_sp, g_pre,
+                  g_post, loss0)
+        (_, _, _, _, _, g_sp, g_pre, g_post, loss), _ = jax.lax.scan(
             cycle, carry0, xs)
 
         # --- cross-device reductions ------------------------------------

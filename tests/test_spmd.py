@@ -249,3 +249,42 @@ def test_skip_as_carried_pytree_lane():
     g = jax.grad(lambda x: jnp.sum(pipe(stacked, {}, {},
                                         mb.stack_scatter(x, 4)[0])))(x)
     assert np.isfinite(np.asarray(g)).all()
+
+def test_remat_post_parity():
+    """remat_post trades the post's vocab-scale loss residuals for a decode
+    recompute; same explicit key replays, so loss AND grads must be
+    identical (bitwise up to reduction order) with the flag on or off —
+    including with dropout active through the remat'd post path."""
+    from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+
+    cfg = LMConfig(vocab=64, d_model=16, nhead=2, d_ff=32, n_layers=2,
+                   seq_len=8, dropout=0.2)
+    model = PipelinedLM(cfg, 2)
+    sp, prep, postp = model.init(jax.random.key(0))
+    stacked = stack_stage_params(sp)
+    mesh = make_mesh(2, 1)
+    tokens = jax.random.randint(jax.random.key(1), (4, cfg.seq_len), 0,
+                                cfg.vocab, jnp.int32)
+    x, _ = mb.stack_scatter({"tokens": tokens,
+                             "targets": jnp.roll(tokens, -1, -1)}, 2)
+    key = jax.random.key(2)
+
+    results = []
+    for flag in (False, True):
+        pipe = SpmdPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
+                            post_fn=model.loss_post_fn, post_with_batch=True,
+                            checkpoint="except_last", remat_post=flag)
+
+        def loss_fn(sp_, prep_, postp_):
+            return jnp.mean(pipe(sp_, prep_, postp_, x, key=key, train=True))
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            stacked, prep, postp)
+        results.append((loss, grads))
+
+    (l0, g0), (l1, g1) = results
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
