@@ -28,7 +28,8 @@ import jax
 from ..core.schedule import Schedule, bubble_fraction
 
 __all__ = ["stage_scope", "profile_trace", "device_memory_report",
-           "BubbleMeter", "stage_busy_from_trace", "measured_bubble_slope"]
+           "BubbleMeter", "stage_busy_from_trace", "measured_bubble_slope",
+           "measured_bubble_two_point"]
 
 
 def stage_scope(microbatch: int, stage: int):
@@ -173,7 +174,26 @@ def measured_bubble_slope(t_m: float, t_2m: float, m: int) -> float:
     :func:`stage_busy_from_trace` + :meth:`BubbleMeter.measured` pair is the
     per-stage-attributed alternative on real device planes.
     """
-    if t_m <= 0:
+    return measured_bubble_two_point(t_m, m, t_2m, 2 * m)
+
+
+def measured_bubble_two_point(t_ref: float, m_ref: int,
+                              t_other: float, m_other: int) -> float:
+    """:func:`measured_bubble_slope` generalized to any two micro-batch
+    counts: the bubble is reported at the REFERENCE point ``(t_ref,
+    m_ref)``; the other point only fixes the slope. Lets the probe use
+    FEWER micro-batches than the headline run (e.g. m/2 vs m) when a 2m
+    program would not fit — the straight-line d=1 specialization's HLO temp
+    footprint grows with the unroll length, so probing downward keeps the
+    slope measurable at the memory ceiling.
+
+    Caveat: the premise is that step time is affine in the micro-batch
+    count. Fixed per-step costs that do NOT scale with m (optimizer update,
+    remote-dispatch latency) bias the slope low and the bubble high — on a
+    tunneled single chip the bias dominates, so prefer the trace-based
+    busy fraction (:func:`stage_busy_from_trace`) whenever a real device
+    plane is available."""
+    if t_ref <= 0 or m_other == m_ref:
         return 0.0
-    a = max((t_2m - t_m) / m, 0.0)
-    return max(0.0, 1.0 - (m * a) / t_m)
+    a = max((t_other - t_ref) / (m_other - m_ref), 0.0)
+    return max(0.0, 1.0 - (m_ref * a) / t_ref)

@@ -103,8 +103,10 @@ def test_1f1b_stash_cap():
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 @pytest.mark.parametrize("checkpoint", ["always", "except_last", "never"])
-@pytest.mark.parametrize("n_stages,m", [(2, 8), (4, 8), (4, 2)])
+@pytest.mark.parametrize("n_stages,m", [(1, 4), (2, 8), (4, 8), (4, 2)])
 def test_loss_and_grad_transparency(schedule, checkpoint, n_stages, m):
+    # n_stages == 1 exercises the trace-time static specialization
+    # (_device_program_static); >= 2 the dynamic table scan.
     stage_fn, params = make_stage(n_stages, jax.random.key(0))
     mesh = make_mesh(n_stages, 1)
     x = jax.random.normal(jax.random.key(1), (2 * m, WIDTH))
@@ -316,8 +318,8 @@ def _plain_loss_chain(stage_fn, params, x):
     return jnp.mean(jnp.sum((h - 1.0) ** 2, axis=-1))
 
 
-@pytest.mark.parametrize("d,v,m", [(2, 2, 4), (4, 2, 8), (2, 4, 8),
-                                   (3, 2, 6)])
+@pytest.mark.parametrize("d,v,m", [(1, 2, 4), (2, 2, 4), (4, 2, 8),
+                                   (2, 4, 8), (3, 2, 6)])
 @pytest.mark.parametrize("mode", ["never", "except_last", "always"])
 def test_interleaved_1f1b_matches_plain(d, v, m, mode):
     """Loss AND grads of the interleaved manual executor equal the plain
@@ -325,8 +327,9 @@ def test_interleaved_1f1b_matches_plain(d, v, m, mode):
     from pipe_tpu.core.schedule import InterleavedOneFOneBSchedule
     from pipe_tpu.parallel.interleaved import stack_interleaved_params
 
-    if (d, v, m) != (2, 2, 4) and mode != "except_last":
-        pytest.skip("full mode matrix only at the smallest shape")
+    if (d, v, m) not in ((2, 2, 4), (1, 2, 4)) and mode != "except_last":
+        pytest.skip("full mode matrix only at the smallest shapes; (1, 2, 4) "
+                    "covers the static d == 1 specialization per mode")
     S = d * v
     stage_fn, params = make_stage(S, jax.random.key(0))
     x = jax.random.normal(jax.random.key(1), (m * 2, WIDTH))
