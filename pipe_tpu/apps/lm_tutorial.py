@@ -31,7 +31,7 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--stages", type=int, default=2)
     p.add_argument("--chunks", type=int, default=4)
     p.add_argument("--schedule",
-                   choices=["gpipe", "1f1b", "interleaved",
+                   choices=["gpipe", "1f1b", "zb-h1", "interleaved",
                             "interleaved-1f1b"],
                    default="gpipe")
     p.add_argument("--lr", type=float, default=None,

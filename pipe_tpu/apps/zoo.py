@@ -27,7 +27,7 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default="except_last",
                    choices=["never", "except_last", "always"])
     p.add_argument("--schedule", default="1f1b",
-                   choices=["gpipe", "1f1b", "interleaved-1f1b"])
+                   choices=["gpipe", "1f1b", "zb-h1", "interleaved-1f1b"])
     p.add_argument("--stages", type=int, default=2)
     p.add_argument("--chunks", type=int, default=4)
     p.add_argument("--interleave", type=int, default=2,
@@ -134,7 +134,7 @@ def main(argv=None) -> int:
             print("note: --interleave 1 makes interleaved-1f1b the plain "
                   "1f1b schedule")
         sched_obj = (InterleavedOneFOneBSchedule(interleave=v)
-                     if v > 1 else "1f1b")
+                     if v > 1 else args.schedule)
         sched = ScheduledPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
                                   post_fn=model.loss_post_fn,
                                   checkpoint=args.checkpoint,

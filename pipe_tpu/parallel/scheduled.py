@@ -85,7 +85,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.partition import StageCtx
 from ..core.remat import validate_mode
-from ..core.schedule import (BWD, FWD, GPipeSchedule,
+from ..core.schedule import (BWD, FWD, WGRAD, GPipeSchedule,
                              InterleavedOneFOneBSchedule, OneFOneBSchedule,
                              Schedule, get_schedule)
 from .mesh import DATA_AXIS, STAGE_AXIS
@@ -169,11 +169,13 @@ class ScheduledPipeline:
         """Static per-device buffer counts — the memory story, inspectable."""
         d, v = self.n_stages, self.v
         Sg = self.schedule.stash_slots(m, d)
+        Wg = self.schedule.wstash_slots(m, d)
         R = {"always": 0, "except_last": v,
              "never": v * Sg}[self.checkpoint]
         return {"cycles": self._cycles(m), "stash_slots": v * Sg,
                 "stash_slots_per_virtual_stage": Sg, "residual_slots": R,
-                "h_last_slots": Sg, "virtual_stages_per_device": v}
+                "h_last_slots": Sg, "wstash_slots": v * Wg,
+                "virtual_stages_per_device": v}
 
     def _cycles(self, m: int) -> int:
         tables = self.schedule.op_tables(m, self.n_stages)
@@ -340,10 +342,12 @@ class ScheduledPipeline:
         op_np, mb_np = tables[0], tables[1]
         grp_np = tables[2] if len(tables) == 3 else np.zeros_like(op_np)
 
-        stash = {}     # (i, s) -> stage input (pops at FWD)
+        split_w = bool((op_np == WGRAD).any())
+        stash = {}     # (i, s) -> stage input (released at B, or W if split)
         res = {}       # (i, g) -> vjp_fn (policy-gated)
         h_last = {}    # i -> last virtual stage's output (pops at BWD)
         gbuf = {}      # (i, s) -> cotangent from stage s+1 (pops at BWD)
+        wpend = {}     # (i, g) -> deferred (gp, gpre) for the W slot
         g_per_group = {}
         g_pre = jax.tree_util.tree_map(jnp.zeros_like, pre_params)
         g_post = jax.tree_util.tree_map(jnp.zeros_like, post_params)
@@ -386,7 +390,7 @@ class ScheduledPipeline:
                     h_last[i] = h1
                 else:
                     stash[(i, s + 1)] = h1
-            else:                 # BWD
+            elif opj == BWD:
                 if s == S - 1:
                     _, post_vjp = jax.vjp(
                         lambda pp, hh: self._post_contrib(
@@ -401,14 +405,29 @@ class ScheduledPipeline:
                     _, vjp_fn = self._vjp_wrt(
                         params_g, pre_params, h_in, x_mb, kis, s)
                 gp, gpre, gh = vjp_fn(seed_h)
+                if split_w:
+                    # B/W split table (zb-h1): the weight/pre grads computed
+                    # here are traced values — defer only their ACCUMULATION
+                    # to the W slot (straight-line code, so no recompute;
+                    # ordering is immaterial at d == 1 where there is no
+                    # bubble to fill, but the table contract is honored).
+                    wpend[(i, g)] = (gp, gpre)
+                else:
+                    g_per_group[g] = (add(g_per_group[g], gp)
+                                      if g in g_per_group else gp)
+                    g_pre = add(g_pre, gpre)
+                if s > 0:
+                    gbuf[(i, s - 1)] = gh
+                if not split_w:
+                    stash.pop((i, s), None)
+            else:                 # WGRAD
+                gp, gpre = wpend.pop((i, g))
                 g_per_group[g] = (add(g_per_group[g], gp)
                                   if g in g_per_group else gp)
                 g_pre = add(g_pre, gpre)
-                if s > 0:
-                    gbuf[(i, s - 1)] = gh
                 stash.pop((i, s), None)
-        assert not stash and not res and not h_last and not gbuf, \
-            "static schedule left unconsumed state"
+        assert not stash and not res and not h_last and not gbuf \
+            and not wpend, "static schedule left unconsumed state"
 
         g_sp = jax.tree_util.tree_map(
             lambda *rows: jnp.stack(rows, axis=0),
@@ -468,6 +487,12 @@ class ScheduledPipeline:
             self._host_tables(m)
         xs = (jnp.asarray(op_np), jnp.asarray(mb_np), jnp.asarray(grp_np),
               jnp.asarray(rxslot_np))
+        # Split-backward (zero-bubble) tables carry WGRAD ops: B computes
+        # the input grad only (and parks its cotangent); W consumes the
+        # parked cotangent for the weight grads. Static: shapes the carry
+        # and the branch list.
+        has_w = bool((op_np == WGRAD).any())
+        Wg = self.schedule.wstash_slots(m, d) if has_w else 0
 
         # --- carry -------------------------------------------------------
         def zeros_of(spec):
@@ -496,6 +521,9 @@ class ScheduledPipeline:
         # BWD(i, S-1).
         h_last = jax.tree_util.tree_map(
             lambda s_: exact_slots_of(s_, Sg), h_spec)
+        # Deferred-W cotangent park (B -> W window), activation-sized slots.
+        wstash = (jax.tree_util.tree_map(
+            lambda s_: exact_slots_of(s_, v * Wg), h_spec) if has_w else ())
         n_res = self.memory_plan(m)["residual_slots"]
         res_store = ([exact_slots_of(s_, n_res) for s_ in res_specs]
                      if mode != "always" else [])
@@ -519,8 +547,8 @@ class ScheduledPipeline:
             return g  # except_last: slot g holds micro-batch m-1
 
         def cycle(carry, row):
-            (h_ring, g_ring, stash, h_last, res_store, g_sp, g_pre, g_post,
-             loss) = carry
+            (h_ring, g_ring, stash, h_last, wstash, res_store, g_sp, g_pre,
+             g_post, loss) = carry
             op_r, mb_r, grp_r, rx_r = row
             opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
             i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
@@ -543,6 +571,43 @@ class ScheduledPipeline:
             h_in = jax.tree_util.tree_map(
                 lambda st: jax.lax.dynamic_index_in_dim(
                     st, g * Sg + i % Sg, 0, keepdims=False), stash)
+
+            def apply_vjp(seed_h):
+                """(gp, gpre, gh) from the stored or recomputed vjp per the
+                checkpoint policy — shared by the B and W branches so slot
+                layout and policy gating cannot drift between them."""
+                def apply_stored():
+                    slot = res_slot_for(i, g)
+                    leaves = [
+                        jax.lax.dynamic_index_in_dim(st, slot, 0,
+                                                     keepdims=False)
+                        for st in res_store]
+                    vjp_fn = jax.tree_util.tree_unflatten(res_treedef, leaves)
+                    return vjp_fn(seed_h)
+
+                def apply_recomputed():
+                    _, vjp_fn = self._vjp_wrt(
+                        params_g, pre_params, h_in, x_mb, kis, s)
+                    return vjp_fn(seed_h)
+
+                if mode == "never":
+                    return apply_stored()
+                if mode == "always":
+                    return apply_recomputed()
+                # except_last: stored for m-1, recomputed otherwise
+                return jax.lax.cond(i == m - 1, apply_stored,
+                                    apply_recomputed)
+
+            def scatter_gp(G, gp):
+                """Accumulate group g's param grads into its row of G."""
+                if v == 1:
+                    return jax.tree_util.tree_map(
+                        lambda G_, gg: G_ + gg[None], G, gp)
+                return jax.tree_util.tree_map(
+                    lambda G_, gg: jax.lax.dynamic_update_index_in_dim(
+                        G_, jax.lax.dynamic_index_in_dim(
+                            G_, g, 0, keepdims=False) + gg, g, 0),
+                    G, gp)
 
             def fwd_branch():
                 def vjp_and_store():
@@ -587,7 +652,7 @@ class ScheduledPipeline:
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, i % Sg, 0), h_last, h1),
                     lambda: h_last)
-                return (new_h_last, new_res, g_sp, g_pre, g_post,
+                return (new_h_last, wstash, new_res, g_sp, g_pre, g_post,
                         loss + contrib, h1, g_ring)
 
             def bwd_branch():
@@ -614,60 +679,51 @@ class ScheduledPipeline:
 
                 gpost, seed_h = jax.lax.cond(is_last, post_seed, ring_seed)
 
-                def apply_stored():
-                    slot = res_slot_for(i, g)
-                    leaves = [
-                        jax.lax.dynamic_index_in_dim(st, slot, 0,
-                                                     keepdims=False)
-                        for st in res_store]
-                    vjp_fn = jax.tree_util.tree_unflatten(res_treedef, leaves)
-                    return vjp_fn(seed_h)
-
-                def apply_recomputed():
-                    _, vjp_fn = self._vjp_wrt(
-                        params_g, pre_params, h_in, x_mb, kis, s)
-                    return vjp_fn(seed_h)
-
-                if mode == "never":
-                    gp, gpre, gh = apply_stored()
-                elif mode == "always":
-                    gp, gpre, gh = apply_recomputed()
-                else:  # except_last: stored for m-1, recomputed otherwise
-                    gp, gpre, gh = jax.lax.cond(
-                        i == m - 1, apply_stored, apply_recomputed)
+                gp, gpre, gh = apply_vjp(seed_h)
                 add = functools.partial(jax.tree_util.tree_map, jnp.add)
-                # accumulate this group's param grads into its row
-                if v == 1:
-                    g_sp2 = jax.tree_util.tree_map(
-                        lambda G, gg: G + gg[None], g_sp, gp)
-                else:
-                    g_sp2 = jax.tree_util.tree_map(
-                        lambda G, gg: jax.lax.dynamic_update_index_in_dim(
-                            G, jax.lax.dynamic_index_in_dim(
-                                G, g, 0, keepdims=False) + gg, g, 0),
-                        g_sp, gp)
-                return (h_last, res_store, g_sp2, add(g_pre, gpre),
-                        add(g_post, gpost), loss, h_ring, gh)
+                if has_w:
+                    # split backward: B emits only the input grad (XLA DCE
+                    # prunes the unused weight-grad matmuls from the stored-
+                    # residual call); the cotangent parks for the W op.
+                    new_wstash = jax.tree_util.tree_map(
+                        lambda st, l: jax.lax.dynamic_update_index_in_dim(
+                            st, l, g * Wg + i % Wg, 0), wstash, seed_h)
+                    return (h_last, new_wstash, res_store, g_sp, g_pre,
+                            add(g_post, gpost), loss, h_ring, gh)
+                return (h_last, wstash, res_store, scatter_gp(g_sp, gp),
+                        add(g_pre, gpre), add(g_post, gpost), loss,
+                        h_ring, gh)
+
+            def wgrad_branch():
+                seed_h = jax.tree_util.tree_map(
+                    lambda st: jax.lax.dynamic_index_in_dim(
+                        st, g * Wg + i % Wg, 0, keepdims=False), wstash)
+                gp, gpre, _ = apply_vjp(seed_h)
+                add = functools.partial(jax.tree_util.tree_map, jnp.add)
+                return (h_last, wstash, res_store, scatter_gp(g_sp, gp),
+                        add(g_pre, gpre), g_post, loss, h_ring, g_ring)
 
             def idle_branch():
-                return (h_last, res_store, g_sp, g_pre, g_post, loss,
-                        h_ring, g_ring)
+                return (h_last, wstash, res_store, g_sp, g_pre, g_post,
+                        loss, h_ring, g_ring)
 
-            (h_last2, res_store2, g_sp2, g_pre2, g_post2, loss2, tx_h,
-             tx_g) = jax.lax.switch(opj, [idle_branch, fwd_branch,
-                                          bwd_branch])
+            branches = [idle_branch, fwd_branch, bwd_branch]
+            if has_w:
+                branches.append(wgrad_branch)
+            (h_last2, wstash2, res_store2, g_sp2, g_pre2, g_post2, loss2,
+             tx_h, tx_g) = jax.lax.switch(opj, branches)
 
             if d > 1:
                 tx_h = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm), tx_h)
                 tx_g = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm), tx_g)
-            return (tx_h, tx_g, stash, h_last2, res_store2, g_sp2, g_pre2,
-                    g_post2, loss2), None
+            return (tx_h, tx_g, stash, h_last2, wstash2, res_store2, g_sp2,
+                    g_pre2, g_post2, loss2), None
 
-        carry0 = (h_ring, g_ring, stash, h_last, res_store, g_sp, g_pre,
-                  g_post, loss0)
-        (_, _, _, _, _, g_sp, g_pre, g_post, loss), _ = jax.lax.scan(
+        carry0 = (h_ring, g_ring, stash, h_last, wstash, res_store, g_sp,
+                  g_pre, g_post, loss0)
+        (_, _, _, _, _, _, g_sp, g_pre, g_post, loss), _ = jax.lax.scan(
             cycle, carry0, xs)
 
         # --- cross-device reductions ------------------------------------

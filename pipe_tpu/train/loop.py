@@ -48,7 +48,8 @@ class TrainerConfig:
     lr_gamma: float = 0.95     # StepLR(1.0, gamma=0.95), main.py:185
     grad_clip: float = 0.5     # main.py:219
     seed: int = 1234
-    schedule: str = "gpipe"    # gpipe | 1f1b | interleaved | interleaved-1f1b
+    schedule: str = "gpipe"    # gpipe | 1f1b | zb-h1 | interleaved
+                               # | interleaved-1f1b
     interleave: int = 2        # virtual stages per device (interleaved only)
 
 
@@ -70,7 +71,7 @@ class Trainer:
                 self.mesh, self.model.stage_fn, v=cfg.interleave,
                 pre_fn=self.model.pre_fn, post_fn=self.model.loss_post_fn,
                 post_with_batch=True, checkpoint=cfg.checkpoint)
-        elif cfg.schedule in ("1f1b", "interleaved-1f1b"):
+        elif cfg.schedule in ("1f1b", "interleaved-1f1b", "zb-h1"):
             # True 1F1B: the manual fwd+bwd executor caps live activations at
             # min(chunks, n_stages) per stage and applies the exact
             # per-micro-batch checkpoint policy (parallel.scheduled).
@@ -83,7 +84,8 @@ class Trainer:
                     interleave=cfg.interleave)
                 self.n_virtual = cfg.n_stages * cfg.interleave
             else:
-                sched = "1f1b"
+                # "1f1b" or "zb-h1" (split-backward zero-bubble tables)
+                sched = cfg.schedule
                 self.n_virtual = cfg.n_stages
             self.model = PipelinedLM(model_cfg, self.n_virtual)
             self.pipe = ScheduledPipeline(
@@ -99,7 +101,8 @@ class Trainer:
                 checkpoint=cfg.checkpoint)
         else:
             raise ValueError(f"unknown schedule {cfg.schedule!r}")
-        self._scheduled = cfg.schedule in ("1f1b", "interleaved-1f1b")
+        self._scheduled = cfg.schedule in ("1f1b", "interleaved-1f1b",
+                                           "zb-h1")
         if self._scheduled:
             # The manual executor is training-only; eval (no grads, no remat)
             # runs an AD forward executor on the same mesh and params. The

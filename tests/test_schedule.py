@@ -76,3 +76,28 @@ def test_fair_split_non_divisible():
     assert split_balance(4, 3) == [2, 1, 1]
     assert split_balance(7, 5) == [2, 2, 1, 1, 1]
     assert split_balance(9, 6) == [2, 2, 2, 1, 1, 1]
+
+
+# ---------- zero-bubble (zb-h1) tables ----------
+
+def test_zb_tables_verify_and_beat_1f1b_bubble():
+    from pipe_tpu.core.schedule import (OneFOneBSchedule, ZeroBubbleSchedule,
+                                        verify_zb_op_tables)
+    s = ZeroBubbleSchedule()
+    for m, n in [(4, 2), (8, 4), (4, 4), (16, 4), (2, 4), (8, 8), (4, 1)]:
+        op, mb = s.op_tables(m, n)
+        verify_zb_op_tables(op, mb, m, n, s.stash_slots(m, n),
+                            s.wstash_slots(m, n))
+        # 1F1B in F=B=W unit time: m*3 busy units + 3(n-1) fill/drain units
+        unit_1f1b = 3 * (n - 1) / (3 * m + 3 * (n - 1))
+        if n > 1:
+            assert s.bubble(m, n) < unit_1f1b, (m, n)
+        # memory stays 1F1B-bounded (the H1 property): stashed inputs and
+        # deferred cotangents within a small constant of min(m, n)
+        assert s.stash_slots(m, n) <= min(m, n + 2), (m, n)
+        assert s.wstash_slots(m, n) <= min(m, n + 2), (m, n)
+
+
+def test_zb_registered():
+    from pipe_tpu.core.schedule import ZeroBubbleSchedule, get_schedule
+    assert isinstance(get_schedule("zb-h1"), ZeroBubbleSchedule)

@@ -101,12 +101,13 @@ def test_1f1b_stash_cap():
 
 # ---------- transparency ----------
 
-@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "zb-h1"])
 @pytest.mark.parametrize("checkpoint", ["always", "except_last", "never"])
 @pytest.mark.parametrize("n_stages,m", [(1, 4), (2, 8), (4, 8), (4, 2)])
 def test_loss_and_grad_transparency(schedule, checkpoint, n_stages, m):
     # n_stages == 1 exercises the trace-time static specialization
-    # (_device_program_static); >= 2 the dynamic table scan.
+    # (_device_program_static); >= 2 the dynamic table scan. zb-h1 covers
+    # the split-backward (B/W) executor paths in both.
     stage_fn, params = make_stage(n_stages, jax.random.key(0))
     mesh = make_mesh(n_stages, 1)
     x = jax.random.normal(jax.random.key(1), (2 * m, WIDTH))
