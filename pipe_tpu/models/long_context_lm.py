@@ -55,20 +55,26 @@ def _pmean_or_identity(x, axis: str):
 
 
 class ContextParallelLM:
-    """Embed | k ring-attention blocks per stage | loss, all context-sharded.
+    """Embed | k context-parallel blocks per stage | loss, all context-sharded.
 
     Functions run under ``shard_map`` with ``stage``/``data``/``context``
     axes bound. Activations are ``[rows, seq_local, d_model]``; attention is
-    exact over the *global* sequence via the context ring; the loss pmean's
-    over context so every shard returns the identical per-row value.
+    exact over the *global* sequence via ``sp_impl``: the K/V ppermute ring
+    (``'ring'``, block-sized peak memory) or Ulysses all-to-all resharding
+    (``'ulysses'``, unsharded per-device attention — flash-kernel
+    compatible; needs ``nhead % n_context == 0``). The loss pmean's over
+    context so every shard returns the identical per-row value.
     """
 
-    def __init__(self, cfg: LMConfig, n_stages: int):
+    def __init__(self, cfg: LMConfig, n_stages: int, sp_impl: str = "ring"):
         if cfg.n_layers % n_stages:
             raise ValueError(f"n_layers={cfg.n_layers} must divide into "
                              f"n_stages={n_stages}")
+        if sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be ring|ulysses, got {sp_impl!r}")
         self.cfg = cfg
         self.n_stages = n_stages
+        self.sp_impl = sp_impl
         self.layers_per_stage = cfg.n_layers // n_stages
         # Build sublayers (and especially PositionalEncoding's constant
         # table) EAGERLY: creating them lazily inside a traced function
@@ -126,11 +132,14 @@ class ContextParallelLM:
             return (jnp.einsum("bsd,de->bse", h, w) + b).reshape(
                 rows, s_local, cfg.nhead, hd)
 
-        a = ring_attention(
-            proj(bp["attn"]["wq"], bp["attn"]["bq"]),
-            proj(bp["attn"]["wk"], bp["attn"]["bk"]),
-            proj(bp["attn"]["wv"], bp["attn"]["bv"]),
-            CONTEXT_AXIS, causal=cfg.causal)
+        q = proj(bp["attn"]["wq"], bp["attn"]["bq"])
+        k = proj(bp["attn"]["wk"], bp["attn"]["bk"])
+        v = proj(bp["attn"]["wv"], bp["attn"]["bv"])
+        if self.sp_impl == "ulysses":
+            from ..ops.ulysses_attention import ulysses_attention
+            a = ulysses_attention(q, k, v, CONTEXT_AXIS, causal=cfg.causal)
+        else:
+            a = ring_attention(q, k, v, CONTEXT_AXIS, causal=cfg.causal)
         a = a.reshape(rows, s_local, d)
         a = jnp.einsum("bsd,de->bse", a, bp["attn"]["wo"]) + bp["attn"]["bo"]
 

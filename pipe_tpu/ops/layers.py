@@ -282,18 +282,18 @@ class MultiHeadAttention(Module):
         # interpret mode and unsupported tilings use the XLA path.
         on_tpu = jax.default_backend() == "tpu"
         dropout_active = self.dropout > 0.0 and ctx.train and dk is not None
-        # auto: flash only from FLASH_AUTO_MIN_SEQ up — measured on v5e-lite
-        # (520M LM, bf16): a single 128-token block can't amortize the
-        # kernel (XLA +3.7% at s=128), flash wins from s=256 (+1.9%) and
-        # grows with s (and is the only option at memory-bound lengths).
-        # Explicit impl="flash" bypasses the heuristic.
-        use_flash = (not dropout_active or on_tpu) and (
-            self.impl == "flash"
-            or (self.impl == "auto" and on_tpu
-                and s >= FLASH_AUTO_MIN_SEQ))
+        # auto: the measured-crossover heuristic lives in flash_auto_ok;
+        # explicit impl="flash" bypasses it (tiling support still required).
+        if self.impl == "flash":
+            from .pallas_attention import supports
+            use_flash = (not dropout_active or on_tpu) and supports(s)
+        elif self.impl == "auto":
+            use_flash = ((not dropout_active or on_tpu)
+                         and flash_auto_ok(s))
+        else:
+            use_flash = False
         if use_flash:
-            from .pallas_attention import flash_attention, supports
-            use_flash = supports(s)
+            from .pallas_attention import flash_attention
         if use_flash:
             o = flash_attention(
                 q, k, v, causal=self.causal,
@@ -312,6 +312,19 @@ _ACTIVATIONS = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}
 # Minimum sequence length at which impl="auto" selects the Pallas flash
 # kernel on TPU (measured crossover; see MultiHeadAttention.apply).
 FLASH_AUTO_MIN_SEQ = 256
+
+
+def flash_auto_ok(s: int) -> bool:
+    """The auto-selection heuristic, in ONE place (MultiHeadAttention and
+    ulysses_attention both consult it): flash on TPU from the measured
+    crossover length up, when the kernel tiling covers ``s``. Measured on
+    v5e-lite (520M LM, bf16): a single 128-token block can't amortize the
+    kernel (XLA +3.7% at s=128); flash wins from s=256 (+1.9%) and grows
+    with s."""
+    if jax.default_backend() != "tpu" or s < FLASH_AUTO_MIN_SEQ:
+        return False
+    from .pallas_attention import supports
+    return supports(s)
 
 
 class _TransformerBlockBase(Module):

@@ -34,12 +34,26 @@ def make_context_mesh(n_context: int,
 
 def context_parallel_attention(mesh: Mesh, q: jax.Array, k: jax.Array,
                                v: jax.Array, *, causal: bool = True,
-                               axis: str = CONTEXT_AXIS) -> jax.Array:
+                               axis: str = CONTEXT_AXIS,
+                               impl: str = "ring") -> jax.Array:
     """Exact attention over globally ``[batch, seq, heads, head_dim]`` inputs
-    with ``seq`` sharded over ``axis``; returns the same-sharded output."""
+    with ``seq`` sharded over ``axis``; returns the same-sharded output.
+
+    ``impl='ring'`` rotates K/V blocks over the axis (block-sized peak
+    memory, any head count); ``impl='ulysses'`` all-to-all-reshards to full
+    sequence x heads/c per device (lets the flash kernel run unsharded;
+    needs ``heads % axis_size == 0``). Both are exact — see
+    ``ops.ulysses_attention`` for the trade-offs.
+    """
+    if impl == "ring":
+        body = partial(ring_attention, axis_name=axis, causal=causal)
+    elif impl == "ulysses":
+        from ..ops.ulysses_attention import ulysses_attention
+        body = partial(ulysses_attention, axis_name=axis, causal=causal)
+    else:
+        raise ValueError(f"impl must be ring|ulysses, got {impl!r}")
     spec = P(None, axis, None, None)
     fn = jax.shard_map(
-        partial(ring_attention, axis_name=axis, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

@@ -1,0 +1,121 @@
+"""Ulysses (all-to-all) sequence parallelism: exactness + PP x CP wiring.
+
+The second SP strategy next to the K/V ring (``ops.ulysses_attention``):
+head<->sequence all-to-all resharding around an unsharded attention. Bars:
+bit-level-close parity with plain attention (forward AND grads) on the
+virtual CPU mesh, and the context-parallel LM matching its ring variant and
+the plain single-device oracle.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pipe_tpu.ops.layers import dot_product_attention
+from pipe_tpu.ops.ulysses_attention import ulysses_attention
+from pipe_tpu.parallel.context import (context_parallel_attention,
+                                       make_context_mesh)
+
+
+def qkv(key, b=2, s=32, h=4, d=8):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (b, s, h, d)),
+            jax.random.normal(kk, (b, s, h, d)),
+            jax.random.normal(kv, (b, s, h, d)))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("n_context", [2, 4])
+def test_ulysses_forward_parity(causal, n_context):
+    q, k, v = qkv(jax.random.key(0))
+    mesh = make_context_mesh(n_context)
+    got = context_parallel_attention(mesh, q, k, v, causal=causal,
+                                     impl="ulysses")
+    exp = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ulysses_matches_ring():
+    q, k, v = qkv(jax.random.key(1))
+    mesh = make_context_mesh(4)
+    u = context_parallel_attention(mesh, q, k, v, causal=True,
+                                   impl="ulysses")
+    r = context_parallel_attention(mesh, q, k, v, causal=True, impl="ring")
+    np.testing.assert_allclose(np.asarray(u), np.asarray(r),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ulysses_gradient_parity():
+    q, k, v = qkv(jax.random.key(2))
+    mesh = make_context_mesh(2)
+
+    def loss_u(q, k, v):
+        return jnp.sum(context_parallel_attention(
+            mesh, q, k, v, causal=True, impl="ulysses") ** 2)
+
+    def loss_p(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    for a, e in zip(gu, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    # heads=3 over axis of 2 cannot split
+    q = k = v = jnp.zeros((1, 8, 3, 4))
+    mesh = make_context_mesh(2)
+    with pytest.raises(ValueError, match="heads % axis_size"):
+        context_parallel_attention(mesh, q, k, v, impl="ulysses")
+
+
+def test_ulysses_bad_impl_rejected():
+    q = k = v = jnp.zeros((1, 8, 2, 4))
+    mesh = make_context_mesh(2)
+    with pytest.raises(ValueError, match="ring|ulysses"):
+        context_parallel_attention(mesh, q, k, v, impl="alltoall")
+
+
+def test_pp_cp_ulysses_matches_ring_model():
+    """ContextParallelLM(sp_impl='ulysses') == its ring twin AND the plain
+    single-device oracle, through the full pipelined executor."""
+    from test_long_context import plain_reference_loss, tiny_cfg
+
+    from pipe_tpu.core import microbatch as mb
+    from pipe_tpu.models.long_context_lm import ContextParallelLM
+    from pipe_tpu.parallel.mesh import CONTEXT_AXIS, make_mesh
+    from pipe_tpu.parallel.spmd import SpmdPipeline, stack_stage_params
+
+    n_stages, n_context, chunks, seq, rows = 2, 2, 2, 32, 4
+    cfg = dataclasses.replace(tiny_cfg(seq), n_layers=2)
+    results = {}
+    for impl in ("ring", "ulysses"):
+        model = ContextParallelLM(cfg, n_stages, sp_impl=impl)
+        sp, prep, postp = model.init(jax.random.key(0))
+        stacked = stack_stage_params(sp)
+        mesh = make_mesh(n_stages, 1, n_context=n_context)
+        pipe = SpmdPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
+                            post_fn=model.loss_post_fn, post_with_batch=True,
+                            context_axis=CONTEXT_AXIS)
+        tokens = jax.random.randint(jax.random.key(1), (rows * chunks, seq),
+                                    0, cfg.vocab, jnp.int32)
+        targets = jnp.roll(tokens, -1, axis=-1)
+        x, _ = mb.stack_scatter({"tokens": tokens, "targets": targets},
+                                chunks)
+        results[impl] = np.asarray(
+            pipe(stacked, prep, postp, x)).reshape(-1)
+        if impl == "ulysses":
+            exp = plain_reference_loss(model, (sp, prep, postp), tokens,
+                                       targets)
+            np.testing.assert_allclose(results[impl], np.asarray(exp),
+                                       rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(results["ulysses"], results["ring"],
+                               rtol=2e-5, atol=2e-6)
