@@ -370,8 +370,9 @@ def main():
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
         out = subprocess.run(
-            [sys.executable, "-m", "pipe_tpu.obs.bubble_probe", "4", "8"],
-            capture_output=True, text=True, timeout=600, env=env)
+            [sys.executable, "-m", "pipe_tpu.obs.bubble_probe", "4", "8",
+             "--schedules"],
+            capture_output=True, text=True, timeout=900, env=env)
         if out.returncode == 0:
             bubble_multistage = json.loads(out.stdout.strip().splitlines()[-1])
         else:

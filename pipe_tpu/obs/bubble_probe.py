@@ -1,9 +1,11 @@
 """Multi-stage measured-bubble probe on the virtual CPU mesh.
 
-``python -m pipe_tpu.obs.bubble_probe [n_stages] [chunks]`` forces the
-8-device CPU platform, times one compiled pipeline train step at ``m`` and
-``2m`` micro-batches (per-micro-batch work held constant), and prints one
-JSON line with the measured and analytic bubble. bench.py runs this as a
+``python -m pipe_tpu.obs.bubble_probe [n_stages] [chunks] [--schedules]``
+forces the 8-device CPU platform, times one compiled pipeline train step at
+``m`` and ``2m`` micro-batches (per-micro-batch work held constant), and
+prints one JSON line with the measured and analytic bubble; ``--schedules``
+adds head-to-head table-executor timings (1f1b vs zb-h1) with each table's
+analytic idle fraction. bench.py runs this as a
 subprocess so the single-chip TPU benchmark can still report a REAL
 multi-stage bubble measurement (VERDICT r1 #6: the reference author verified
 the schedule with profiler traces, ``/root/reference/README.md:559-567``;
@@ -17,7 +19,8 @@ import sys
 import time
 
 
-def main(n_stages: int = 4, chunks: int = 8) -> dict:
+def main(n_stages: int = 4, chunks: int = 8,
+         compare_schedules: bool = False) -> dict:
     from pipe_tpu.utils.platform import force_cpu_platform
     force_cpu_platform(8)
 
@@ -43,12 +46,17 @@ def main(n_stages: int = 4, chunks: int = 8) -> dict:
 
     mb_rows = 4
 
-    def step_time(m: int, iters: int = 8) -> float:
+    def make_batch(m: int):
+        """One probe batch: m micro-batches of mb_rows, shared recipe for
+        the slope timings AND the schedule comparison (same workload)."""
         tokens = jax.random.randint(jax.random.key(1),
                                     (mb_rows * m, cfg.seq_len),
                                     0, cfg.vocab, jnp.int32)
-        x, _ = mb.stack_scatter(
+        return mb.stack_scatter(
             {"tokens": tokens, "targets": jnp.roll(tokens, -1, -1)}, m)
+
+    def step_time(m: int, iters: int = 8) -> float:
+        x, _ = make_batch(m)
 
         @jax.jit
         def loss_grad(sp, x):
@@ -66,7 +74,7 @@ def main(n_stages: int = 4, chunks: int = 8) -> dict:
 
     m = chunks
     t_m, t_2m = step_time(m), step_time(2 * m)
-    return {
+    out = {
         "platform": "cpu8",
         "n_stages": n_stages,
         "chunks": m,
@@ -76,8 +84,45 @@ def main(n_stages: int = 4, chunks: int = 8) -> dict:
         "analytic_bubble": round(bubble_fraction(m, n_stages), 4),
     }
 
+    if compare_schedules:
+        # Head-to-head step timings of the table executor per schedule at
+        # the same workload (never mode so zb-h1's stored-vjp DCE split
+        # applies), next to each table's analytic idle fraction. The CPU
+        # mesh carries real per-cycle machinery overhead, so the analytic
+        # column is the schedule property and the seconds are the honest
+        # end-to-end number on THIS platform.
+        from pipe_tpu.parallel.scheduled import ScheduledPipeline
+
+        x, n_rows = make_batch(m)
+        w = mb.valid_row_mask(x, n_rows)
+        scheds = {}
+        for name in ("1f1b", "zb-h1"):
+            pipe = ScheduledPipeline(
+                mesh, model.stage_fn, pre_fn=model.pre_fn,
+                post_fn=model.loss_post_fn, checkpoint="never",
+                schedule=name)
+
+            lg = jax.jit(lambda sp, pipe=pipe: pipe.loss_and_grad(
+                sp, prep, postp, x, w))
+            jax.block_until_ready(lg(sp))
+            t0 = time.perf_counter()
+            for _ in range(4):
+                out_lg = lg(sp)
+            jax.block_until_ready(out_lg)
+            scheds[name] = {
+                "sec_per_step": round((time.perf_counter() - t0) / 4, 5),
+                # __post_init__ already built the Schedule; reuse it
+                "analytic_bubble": round(
+                    pipe.schedule.bubble(m, n_stages), 4),
+            }
+        out["schedules"] = scheds
+    return out
+
 
 if __name__ == "__main__":
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4
-    m = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-    print(json.dumps(main(n, m)))
+    args = sys.argv[1:]
+    cmp_scheds = "--schedules" in args
+    pos = [a for a in args if a != "--schedules"]
+    n = int(pos[0]) if len(pos) > 0 else 4
+    m = int(pos[1]) if len(pos) > 1 else 8
+    print(json.dumps(main(n, m, compare_schedules=cmp_scheds)))
