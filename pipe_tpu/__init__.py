@@ -14,7 +14,8 @@ from .core import microbatch
 from .core.microbatch import Batch, NoChunk, gather, scatter
 from .core.partition import BalanceError, Stage, StageCtx
 from .core.schedule import (GPipeSchedule, InterleavedSchedule,
-                            OneFOneBSchedule, clock_cycles, get_schedule)
+                            OneFOneBSchedule, ZeroBubbleSchedule,
+                            clock_cycles, get_schedule)
 from .ops.layers import (Decoder, Dropout, Embedding, Lambda, LayerNorm,
                          Linear, Module, MultiHeadAttention,
                          PositionalEncoding, Sequential,
@@ -27,7 +28,7 @@ __all__ = [
     "Pipe", "NoChunk", "Batch", "BalanceError", "Stage", "StageCtx",
     "scatter", "gather", "microbatch",
     "GPipeSchedule", "OneFOneBSchedule", "InterleavedSchedule",
-    "clock_cycles", "get_schedule",
+    "ZeroBubbleSchedule", "clock_cycles", "get_schedule",
     "Module", "Sequential", "Lambda", "Linear", "Embedding", "LayerNorm",
     "Dropout", "MultiHeadAttention", "TransformerEncoderLayer",
     "PositionalEncoding", "Decoder",
