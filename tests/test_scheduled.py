@@ -430,3 +430,32 @@ def test_interleaved_1f1b_with_data_axis():
         lambda a: sched.loss_and_grad(a, {}, {}, xm, w))(stacked)
     exp = _plain_loss_chain(stage_fn, params, x)
     np.testing.assert_allclose(float(loss), float(exp), rtol=1e-5)
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "zb-h1"])
+def test_static_unroll_matches_dynamic_at_d1(schedule):
+    """static_unroll=True (trace-time straight-line) and =False (the
+    dynamic table scan) must produce identical loss and grads at d == 1 —
+    the two programs implement one schedule contract."""
+    m = 4
+    stage_fn, params = make_stage(1, jax.random.key(0))
+    mesh = make_mesh(1, 1, devices=jax.devices()[:1])
+    x = jax.random.normal(jax.random.key(1), (2 * m, WIDTH))
+    xs, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    stacked = stack_stage_params(params)
+
+    results = []
+    for flag in (True, False):
+        pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn,
+                                 post_fn=post_fn, checkpoint="except_last",
+                                 schedule=schedule, static_unroll=flag)
+        loss, (gsp, _, _) = jax.jit(pipe.loss_and_grad)(
+            stacked, {}, {}, xs, w, key=jax.random.key(9))
+        results.append((float(loss), gsp))
+    (l_s, g_s), (l_d, g_d) = results
+    assert l_s == pytest.approx(l_d, rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g_s),
+                    jax.tree_util.tree_leaves(g_d)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
