@@ -25,6 +25,23 @@ from pipe_tpu.ops.layers import TransformerEncoderLayer
 D_MODEL, NHEAD, D_FF, SEQ, BATCH = 16, 2, 32, 12, 3
 
 
+def causal_mask(seq=SEQ):
+    return torch.triu(torch.full((seq, seq), float("-inf")), diagonal=1)
+
+
+def torch_sinusoid(seq, d):
+    """The tutorial's PositionalEncoding table, built INDEPENDENTLY on the
+    torch side (reference main.py's formula) so the composition test
+    actually validates this package's table rather than injecting it."""
+    import math
+    position = torch.arange(seq).unsqueeze(1)
+    div = torch.exp(torch.arange(0, d, 2) * (-math.log(10000.0) / d))
+    pe = torch.zeros(seq, d)
+    pe[:, 0::2] = torch.sin(position * div)
+    pe[:, 1::2] = torch.cos(position * div)
+    return pe
+
+
 def torch_layer(seed=0):
     torch.manual_seed(seed)
     return torch.nn.TransformerEncoderLayer(
@@ -68,9 +85,7 @@ def test_encoder_layer_matches_torch(causal):
         (BATCH, SEQ, D_MODEL)).astype(np.float32)
     with torch.no_grad():
         if causal:
-            mask = torch.triu(
-                torch.full((SEQ, SEQ), float("-inf")), diagonal=1)
-            exp = tl(torch.from_numpy(x), src_mask=mask)
+            exp = tl(torch.from_numpy(x), src_mask=causal_mask())
         else:
             exp = tl(torch.from_numpy(x))
     got = ours.apply(params, jnp.asarray(x), ctx=StageCtx())
@@ -95,18 +110,17 @@ def test_full_tutorial_composition_matches_torch():
     dec_b = rng.standard_normal((VOCAB,)).astype(np.float32) * 0.1
     tokens = rng.integers(0, VOCAB, size=(BATCH, SEQ))
 
-    # --- torch side (the reference composition, main.py:139-157) ---
-    pe = PositionalEncoding(D_MODEL, 0.0)  # same sinusoid table both sides
+    # --- torch side (the reference composition, main.py:139-157; the
+    # sinusoid table built independently — see torch_sinusoid) ---
     with torch.no_grad():
         h = torch.from_numpy(emb_w[tokens]) * math.sqrt(D_MODEL)
-        h = h + torch.from_numpy(np.array(pe.pe[:SEQ], np.float32,
-                                          copy=True))
-        mask = torch.triu(torch.full((SEQ, SEQ), float("-inf")), diagonal=1)
+        h = h + torch_sinusoid(SEQ, D_MODEL)
         for tl in tls:
-            h = tl(h, src_mask=mask)
+            h = tl(h, src_mask=causal_mask())
         exp = h @ torch.from_numpy(dec_w) + torch.from_numpy(dec_b)
 
-    # --- pipe_tpu side ---
+    # --- pipe_tpu side (its OWN PositionalEncoding table) ---
+    pe = PositionalEncoding(D_MODEL, 0.0)
     emb = Embedding(VOCAB, D_MODEL, scale=True)
     dec = Decoder(VOCAB)
     ours = TransformerEncoderLayer(D_MODEL, NHEAD, D_FF, dropout=0.0,
@@ -118,3 +132,22 @@ def test_full_tutorial_composition_matches_torch():
     got = dec.apply({"w": jnp.asarray(dec_w), "b": jnp.asarray(dec_b)}, h)
     np.testing.assert_allclose(np.asarray(got), exp.numpy(),
                                rtol=3e-5, atol=3e-5)
+
+
+def test_encoder_layer_grads_match_torch():
+    """d(loss)/d(input) parity: the backward math (through softmax, LN,
+    residuals) agrees with torch's autograd on the same weights."""
+    tl = torch_layer().eval()
+    params = params_from_torch(tl)
+    ours = TransformerEncoderLayer(D_MODEL, NHEAD, D_FF, dropout=0.0,
+                                   causal=True)
+    x = np.random.default_rng(3).standard_normal(
+        (BATCH, SEQ, D_MODEL)).astype(np.float32)
+
+    xt = torch.from_numpy(x.copy()).requires_grad_(True)
+    tl(xt, src_mask=causal_mask()).pow(2).sum().backward()
+    exp = xt.grad.numpy()
+
+    got = jax.grad(lambda a: jnp.sum(
+        ours.apply(params, a, ctx=StageCtx()) ** 2))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), exp, rtol=3e-4, atol=3e-4)
