@@ -1,0 +1,106 @@
+"""Numerical parity of the GPT-2 family against huggingface transformers.
+
+Loads ONE weight set into ``transformers.GPT2Model`` (CPU torch — the
+de-facto reference implementation of the architecture) and this package's
+``GPT2Embed``/``PreLNBlock``/final-LN stack, asserting the hidden states
+match to float32 tolerance. Pins: Conv1D weight orientation (HF's [in, out]
+equals this package's right-multiply convention), gelu_new (jax.nn.gelu's
+default tanh approximation), pre-LN residual placement, causal masking, and
+learned token+position embeddings.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from pipe_tpu.core.partition import StageCtx
+from pipe_tpu.models.gpt2 import GPT2Config, GPT2Embed
+from pipe_tpu.ops.layers import LayerNorm, PreLNBlock
+
+D, H, L, FF, SEQ, VOCAB, BATCH = 16, 2, 2, 64, 12, 50, 3
+
+
+def hf_model():
+    cfg = transformers.GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=D, n_layer=L, n_head=H,
+        n_inner=FF, activation_function="gelu_new", resid_pdrop=0.0,
+        embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    return transformers.GPT2Model(cfg).eval()
+
+
+def params_from_hf(model):
+    """(embed_params, [block_params...], ln_f_params) from HF's state dict.
+
+    HF GPT-2 uses Conv1D (weight [in, out], y = x @ W + b) — the SAME
+    orientation as this package's Linear, so no transposes anywhere.
+    """
+    sd = {k: jnp.asarray(v.detach().numpy())
+          for k, v in model.state_dict().items()}
+    embed = {"wte": sd["wte.weight"], "wpe": sd["wpe.weight"]}
+    blocks = []
+    for i in range(L):
+        p = f"h.{i}."
+        ca_w, ca_b = sd[p + "attn.c_attn.weight"], sd[p + "attn.c_attn.bias"]
+        blocks.append({
+            "attn": {"wq": ca_w[:, :D], "wk": ca_w[:, D:2 * D],
+                     "wv": ca_w[:, 2 * D:],
+                     "bq": ca_b[:D], "bk": ca_b[D:2 * D], "bv": ca_b[2 * D:],
+                     "wo": sd[p + "attn.c_proj.weight"],
+                     "bo": sd[p + "attn.c_proj.bias"]},
+            "ff1": {"w": sd[p + "mlp.c_fc.weight"],
+                    "b": sd[p + "mlp.c_fc.bias"]},
+            "ff2": {"w": sd[p + "mlp.c_proj.weight"],
+                    "b": sd[p + "mlp.c_proj.bias"]},
+            "ln1": {"g": sd[p + "ln_1.weight"], "b": sd[p + "ln_1.bias"]},
+            "ln2": {"g": sd[p + "ln_2.weight"], "b": sd[p + "ln_2.bias"]},
+        })
+    ln_f = {"g": sd["ln_f.weight"], "b": sd["ln_f.bias"]}
+    return embed, blocks, ln_f
+
+
+def jax_forward(embed_p, block_ps, ln_f_p, tokens, wpe=None):
+    """The ONE embed -> blocks -> final-LN stack both tests validate."""
+    cfg = GPT2Config(vocab=VOCAB, d_model=D, nhead=H, d_ff=FF, n_layers=L,
+                     seq_len=64, dropout=0.0)
+    if wpe is not None:
+        embed_p = {**embed_p, "wpe": wpe}
+    h = GPT2Embed(cfg).apply(embed_p, jnp.asarray(tokens))
+    block = PreLNBlock(D, H, FF, dropout=0.0, causal=True)
+    for p in block_ps:
+        h = block.apply(p, h, ctx=StageCtx())
+    return LayerNorm().apply(ln_f_p, h)
+
+
+def test_gpt2_hidden_states_match_hf():
+    model = hf_model()
+    embed_p, block_ps, ln_f_p = params_from_hf(model)
+
+    tokens = np.random.default_rng(1).integers(0, VOCAB, size=(BATCH, SEQ))
+    with torch.no_grad():
+        exp = model(torch.from_numpy(tokens)).last_hidden_state.numpy()
+
+    got = jax_forward(embed_p, block_ps, ln_f_p, tokens)
+    np.testing.assert_allclose(np.asarray(got), exp, rtol=3e-5, atol=3e-5)
+
+
+def test_gpt2_grads_match_hf():
+    """d(loss)/d(position-embedding) parity through the whole stack."""
+    model = hf_model()
+    embed_p, block_ps, ln_f_p = params_from_hf(model)
+    tokens = np.random.default_rng(2).integers(0, VOCAB, size=(BATCH, SEQ))
+
+    wpe = model.wpe.weight
+    model.zero_grad()
+    model(torch.from_numpy(tokens)).last_hidden_state.pow(2).sum().backward()
+    exp = wpe.grad.numpy()
+
+    got = jax.grad(lambda w: jnp.sum(
+        jax_forward(embed_p, block_ps, ln_f_p, tokens, wpe=w) ** 2))(
+        embed_p["wpe"])
+    np.testing.assert_allclose(np.asarray(got), exp, rtol=2e-4, atol=2e-4)
