@@ -121,8 +121,11 @@ class MLMHead(Module):
                 "proj": self.proj.init(kp, h)}
 
     def apply(self, params, h, ctx: StageCtx = StageCtx()):
+        # exact-erf gelu, consistent with the encoder blocks and HF BERT's
+        # BertPredictionHeadTransform
         h = jax.nn.gelu(self.dense.apply(params["dense"],
-                                         h.astype(jnp.float32), ctx=ctx))
+                                         h.astype(jnp.float32), ctx=ctx),
+                        approximate=False)
         h = self.ln.apply(params["ln"], h, ctx=ctx)
         return self.proj.apply(params["proj"], h, ctx=ctx)
 

@@ -1,7 +1,7 @@
 """GPT-2 model family, pipelined (BASELINE.json config #3: 4-stage
 GPT-2-small 124M, chunks=16, skip-connection via ``@skippable``).
 
-Architecture: learned token + position embeddings, pre-LN blocks with GELU
+Architecture: learned token + position embeddings, pre-LN blocks with gelu_new (tanh-approximate GELU)
 (:class:`~pipe_tpu.ops.layers.PreLNBlock`), final LayerNorm, vocab head.
 The head is untied from the embedding table: tied weights would be one
 parameter owned by two pipeline stages, which the reference rejects outright
@@ -128,7 +128,8 @@ def build_sequential(cfg: GPT2Config, embed_skip: bool = False) -> Sequential:
         layers.append(_StashEmbed())
     for _ in range(cfg.n_layers):
         layers.append(PreLNBlock(cfg.d_model, cfg.nhead, cfg.d_ff,
-                                 cfg.dropout, causal=True))
+                                 cfg.dropout, causal=True,
+                                 activation="gelu_tanh"))
     if embed_skip:
         layers.append(_JoinEmbed())
     layers.append(GPT2Head(cfg))
@@ -141,7 +142,8 @@ class PipelinedGPT2(PipelinedTransformer):
     def __init__(self, cfg: GPT2Config, n_stages: int):
         self.embed = GPT2Embed(cfg)
         self.block = PreLNBlock(cfg.d_model, cfg.nhead, cfg.d_ff,
-                                cfg.dropout, causal=True)
+                                cfg.dropout, causal=True,
+                                activation="gelu_tanh")
         self.head = GPT2Head(cfg)
         super().__init__(cfg, n_stages)
 

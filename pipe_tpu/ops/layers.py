@@ -307,7 +307,16 @@ class MultiHeadAttention(Module):
         return jnp.einsum("bsd,de->bse", o, params["wo"]) + params["bo"]
 
 
-_ACTIVATIONS = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}
+# "gelu" is the EXACT erf form (torch.nn.TransformerEncoderLayer's
+# activation='gelu', BERT, ViT); "gelu_tanh" is the tanh approximation
+# (GPT-2's gelu_new — and jax.nn.gelu's default). Models must pick the
+# variant their reference implementation uses; the HF parity tests pin
+# both choices.
+_ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+}
 
 # Minimum sequence length at which impl="auto" selects the Pallas flash
 # kernel on TPU (measured crossover; see MultiHeadAttention.apply).

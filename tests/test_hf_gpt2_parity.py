@@ -4,8 +4,7 @@ Loads ONE weight set into ``transformers.GPT2Model`` (CPU torch — the
 de-facto reference implementation of the architecture) and this package's
 ``GPT2Embed``/``PreLNBlock``/final-LN stack, asserting the hidden states
 match to float32 tolerance. Pins: Conv1D weight orientation (HF's [in, out]
-equals this package's right-multiply convention), gelu_new (jax.nn.gelu's
-default tanh approximation), pre-LN residual placement, causal masking, and
+equals this package's right-multiply convention), gelu_new (the "gelu_tanh" activation variant), pre-LN residual placement, causal masking, and
 learned token+position embeddings.
 """
 
@@ -71,7 +70,8 @@ def jax_forward(embed_p, block_ps, ln_f_p, tokens, wpe=None):
     if wpe is not None:
         embed_p = {**embed_p, "wpe": wpe}
     h = GPT2Embed(cfg).apply(embed_p, jnp.asarray(tokens))
-    block = PreLNBlock(D, H, FF, dropout=0.0, causal=True)
+    block = PreLNBlock(D, H, FF, dropout=0.0, causal=True,
+                       activation="gelu_tanh")
     for p in block_ps:
         h = block.apply(p, h, ctx=StageCtx())
     return LayerNorm().apply(ln_f_p, h)

@@ -1,0 +1,71 @@
+"""Numerical parity of the ViT block against huggingface transformers.
+
+``transformers.ViTModel``'s encoder layer is pre-LN with EXACT-erf gelu —
+this pins the ``"gelu"`` activation choice of :class:`PreLNBlock` (the ViT
+family's block; GPT-2 uses ``"gelu_tanh"``) against the implementation that
+defines the common ViT checkpoints.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from pipe_tpu.core.partition import StageCtx
+from pipe_tpu.ops.layers import PreLNBlock
+
+D, H, FF, SEQ, BATCH = 16, 2, 64, 10, 3
+
+
+def hf_layer():
+    cfg = transformers.ViTConfig(
+        hidden_size=D, num_hidden_layers=1, num_attention_heads=H,
+        intermediate_size=FF, hidden_act="gelu", hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    # take the layer from a full ViTModel so the attn-implementation
+    # dispatch is initialized (a bare ViTLayer(cfg) lacks it)
+    return transformers.ViTModel(cfg).eval().encoder.layer[0]
+
+
+def params_from_hf(layer) -> dict:
+    """HF ViT uses torch Linear ([out, in] -> transpose for our
+    right-multiply); attention Q/K/V are separate Linears."""
+    sd = {k: v.detach().numpy() for k, v in layer.state_dict().items()}
+    a = "attention.attention."
+    o = "attention.output."
+    return jax.tree_util.tree_map(jnp.asarray, {
+        "attn": {"wq": sd[a + "query.weight"].T,
+                 "wk": sd[a + "key.weight"].T,
+                 "wv": sd[a + "value.weight"].T,
+                 "bq": sd[a + "query.bias"], "bk": sd[a + "key.bias"],
+                 "bv": sd[a + "value.bias"],
+                 "wo": sd[o + "dense.weight"].T,
+                 "bo": sd[o + "dense.bias"]},
+        "ff1": {"w": sd["intermediate.dense.weight"].T,
+                "b": sd["intermediate.dense.bias"]},
+        "ff2": {"w": sd["output.dense.weight"].T,
+                "b": sd["output.dense.bias"]},
+        "ln1": {"g": sd["layernorm_before.weight"],
+                "b": sd["layernorm_before.bias"]},
+        "ln2": {"g": sd["layernorm_after.weight"],
+                "b": sd["layernorm_after.bias"]},
+    })
+
+
+def test_vit_block_matches_hf():
+    layer = hf_layer()
+    params = params_from_hf(layer)
+    ours = PreLNBlock(D, H, FF, dropout=0.0, causal=False)  # default "gelu"
+
+    x = np.random.default_rng(1).standard_normal(
+        (BATCH, SEQ, D)).astype(np.float32)
+    with torch.no_grad():
+        out = layer(torch.from_numpy(x))
+        exp = (out[0] if isinstance(out, tuple) else out).numpy()
+    got = ours.apply(params, jnp.asarray(x), ctx=StageCtx())
+    np.testing.assert_allclose(np.asarray(got), exp, rtol=3e-5, atol=3e-5)

@@ -42,11 +42,11 @@ def torch_sinusoid(seq, d):
     return pe
 
 
-def torch_layer(seed=0):
+def torch_layer(seed=0, activation="relu"):
     torch.manual_seed(seed)
     return torch.nn.TransformerEncoderLayer(
         d_model=D_MODEL, nhead=NHEAD, dim_feedforward=D_FF, dropout=0.0,
-        activation="relu", batch_first=True)
+        activation=activation, batch_first=True)
 
 
 def params_from_torch(tl) -> dict:
@@ -74,12 +74,15 @@ def params_from_torch(tl) -> dict:
     })
 
 
+@pytest.mark.parametrize("activation", ["relu", "gelu"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_encoder_layer_matches_torch(causal):
-    tl = torch_layer().eval()
+def test_encoder_layer_matches_torch(causal, activation):
+    # torch's activation="gelu" is the EXACT erf form — pinned against this
+    # package's "gelu" (the BERT/ViT variant; GPT-2 uses "gelu_tanh")
+    tl = torch_layer(activation=activation).eval()
     params = params_from_torch(tl)
     ours = TransformerEncoderLayer(D_MODEL, NHEAD, D_FF, dropout=0.0,
-                                   causal=causal)
+                                   causal=causal, activation=activation)
 
     x = np.random.default_rng(1).standard_normal(
         (BATCH, SEQ, D_MODEL)).astype(np.float32)
