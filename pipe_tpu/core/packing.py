@@ -1,0 +1,220 @@
+"""Per-dtype flat packing: heterogeneous pytrees in fixed-capacity buffers.
+
+Two users, one layout:
+
+* **Boundary carrier** (:class:`PackPlan`, used by ``parallel.hetero``): the
+  possibly multi-value, shape-varying activation pytree crossing each stage
+  boundary is flattened per dtype into 1-D buffers sized to the largest
+  boundary — one static ``ppermute`` shape for the whole pipeline.
+* **Stage-sharded parameters** (:class:`StageParamPack`): the same trick
+  applied to per-stage *parameter* trees. Each stage's pytree flattens into
+  per-dtype rows of a ``[n_stages, capacity]`` array sharded
+  ``P('stage')`` over the mesh — so each device physically holds ONLY its
+  own partition's weights (plus per-dtype padding to the largest stage).
+  This is the TPU-native equivalent of the reference moving each partition
+  to its own device (``_split_module``, reference ``pipe.py:191-218``, wired
+  at ``pipe.py:344-356``): the memory scaling that is the point of pipeline
+  parallelism. Replicating every stage's params on every device (the round-2
+  design) OOMs exactly at the model scale where pipelining matters.
+
+Inside the compiled program a device's local row unpacks (static slice +
+reshape of contiguous memory — XLA aliases these as views) into the stage's
+param tree only inside that stage's ``lax.switch`` branch, so the unpack of
+other stages' plans never executes. The transpose of unpack is pack
+(scatter into the row), so ``jax.grad`` with respect to the packed
+representation yields per-dtype ``[n, cap]`` cotangents sharded the same
+way — zero communication for stage grads, psum over the data axis inserted
+by AD where replication demands it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PackPlan", "StageParamPack"]
+
+
+class PackPlan:
+    """Static layout of one pytree (given as leaf specs) inside per-dtype
+    1-D buffers. Used for both boundary carriers and parameter rows."""
+
+    def __init__(self, specs: Sequence[jax.ShapeDtypeStruct]):
+        self.specs = list(specs)
+        self.sizes = [int(np.prod(s.shape)) if s.shape else 1
+                      for s in self.specs]
+        self.dtypes = [np.dtype(s.dtype).name for s in self.specs]
+        self.per_dtype: dict = {}
+        for size, dt in zip(self.sizes, self.dtypes):
+            self.per_dtype[dt] = self.per_dtype.get(dt, 0) + size
+
+    def pack(self, values, capacities: dict):
+        """values (in spec order) -> {dtype: 1-D padded buffer}."""
+        chunks: dict = {dt: [] for dt in capacities}
+        for v, dt in zip(values, self.dtypes):
+            chunks[dt].append(jnp.ravel(v))
+        out = {}
+        for dt, cap in capacities.items():
+            if chunks[dt]:
+                flat = jnp.concatenate(chunks[dt]) if len(chunks[dt]) > 1 \
+                    else chunks[dt][0]
+                pad = cap - flat.shape[0]
+                out[dt] = jnp.pad(flat, (0, pad)) if pad else flat
+            else:
+                out[dt] = jnp.zeros((cap,), dtype=np.dtype(dt))
+        return out
+
+    def unpack(self, carrier: dict):
+        offsets: dict = {dt: 0 for dt in carrier}
+        values = []
+        for spec, size, dt in zip(self.specs, self.sizes, self.dtypes):
+            off = offsets[dt]
+            flat = jax.lax.slice_in_dim(carrier[dt], off, off + size)
+            offsets[dt] = off + size
+            values.append(jnp.reshape(flat, spec.shape))
+        return values
+
+    def pack_np(self, values, capacities: dict) -> Dict[str, np.ndarray]:
+        """Host-side pack: numpy, no device round-trips (used at shard
+        construction so 520M-scale packing never materializes on one chip)."""
+        chunks: dict = {dt: [] for dt in capacities}
+        for v, dt in zip(values, self.dtypes):
+            chunks[dt].append(np.ravel(np.asarray(v)))
+        out = {}
+        for dt, cap in capacities.items():
+            npdt = np.dtype(dt)
+            buf = np.zeros((cap,), dtype=npdt)
+            if chunks[dt]:
+                flat = np.concatenate(chunks[dt]) if len(chunks[dt]) > 1 \
+                    else chunks[dt][0]
+                buf[:flat.shape[0]] = flat
+            out[dt] = buf
+        return out
+
+    def unpack_np(self, carrier: Dict[str, np.ndarray]):
+        offsets: dict = {dt: 0 for dt in carrier}
+        values = []
+        for spec, size, dt in zip(self.specs, self.sizes, self.dtypes):
+            off = offsets[dt]
+            flat = carrier[dt][off:off + size]
+            offsets[dt] = off + size
+            values.append(np.reshape(flat, spec.shape))
+        return values
+
+
+def _leaf_specs(tree) -> List[jax.ShapeDtypeStruct]:
+    return [jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l))
+            for l in jax.tree_util.tree_leaves(tree)]
+
+
+class StageParamPack:
+    """Plans + capacities mapping per-stage param trees to stage-sharded
+    per-dtype ``{dtype: [n, cap]}`` arrays (see module docstring).
+
+    Built from one concrete (or abstract) instance of the per-stage trees;
+    thereafter :meth:`shard` / :meth:`unshard` convert representations and
+    :meth:`unpack_stage` is the in-program (traced) view used by the
+    executor's stage branches.
+    """
+
+    def __init__(self, params_per_stage: Sequence[Any]):
+        self.n = len(params_per_stage)
+        self.treedefs = [jax.tree_util.tree_structure(p)
+                         for p in params_per_stage]
+        self.plans = [PackPlan(_leaf_specs(p)) for p in params_per_stage]
+        self.capacities: Dict[str, int] = {}
+        for plan in self.plans:
+            for dt, sz in plan.per_dtype.items():
+                self.capacities[dt] = max(self.capacities.get(dt, 0), sz)
+        if not self.capacities:     # parameterless model: keep one leaf
+            self.capacities = {"float32": 1}
+
+    # -- representation conversions (host side) ---------------------------
+    def shard(self, mesh, params_per_stage: Sequence[Any],
+              stage_axis: str = "stage") -> Dict[str, jax.Array]:
+        """Per-dtype ``[n, cap]`` arrays, row ``j`` on stage ``j``'s devices.
+
+        Builds each device's shard directly (``make_array_from_callback``
+        over host-packed rows), so no device ever materializes another
+        stage's weights — the analogue of ``partition.to(device)`` in the
+        reference's ``_split_module`` (``pipe.py:191-218``).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if len(params_per_stage) != self.n:
+            raise ValueError(
+                f"{len(params_per_stage)} stages for an {self.n}-stage pack")
+        rows = [plan.pack_np(jax.tree_util.tree_leaves(tree), self.capacities)
+                for plan, tree in zip(self.plans, params_per_stage)]
+        out = {}
+        for dt, cap in self.capacities.items():
+            sharding = NamedSharding(mesh, P(stage_axis))
+
+            def cb(index, dt=dt):
+                s_slice, c_slice = index
+                stages = range(*s_slice.indices(self.n))
+                return np.stack([rows[s][dt][c_slice] for s in stages])
+
+            out[dt] = jax.make_array_from_callback((self.n, cap), sharding,
+                                                   cb)
+        return out
+
+    def unshard(self, packed: Dict[str, jax.Array]) -> List[Any]:
+        """Packed ``{dtype: [n, cap]}`` (params OR their grads) back to the
+        per-stage trees. Host-side; gathers one stage row at a time and
+        returns host (numpy) leaves — re-committing all stages to the
+        default device would be exactly the single-chip allocation the
+        packed layout exists to avoid. Copies (not views) so the gathered
+        row buffers are not pinned by the returned trees."""
+        out = []
+        for s in range(self.n):
+            local = {dt: np.asarray(packed[dt][s])
+                     for dt in self.capacities}
+            leaves = [np.array(l) for l in self.plans[s].unpack_np(local)]
+            out.append(jax.tree_util.tree_unflatten(self.treedefs[s], leaves))
+        return out
+
+    def check_packed(self, packed: Dict[str, jax.Array]) -> None:
+        """Fail fast when a packed dict does not match this pack's layout
+        (wrong Pipe, wrong balance, truncated dict): same dtype keys, every
+        buffer shaped ``[n, cap]``. Residual ambiguity: mirror balances
+        (e.g. [3,1] vs [1,3] of identical layers) produce byte-identical
+        buffer shapes and cannot be distinguished here."""
+        if set(packed) != set(self.capacities):
+            raise ValueError(
+                f"packed params have dtypes {sorted(packed)} but this pack "
+                f"expects {sorted(self.capacities)}")
+        for dt, cap in self.capacities.items():
+            got = tuple(jnp.shape(packed[dt]))
+            if got != (self.n, cap):
+                raise ValueError(
+                    f"packed[{dt!r}] has shape {got}, expected "
+                    f"{(self.n, cap)} — params packed by a different "
+                    f"Pipe/balance?")
+
+    # -- in-program views (traced) ----------------------------------------
+    def unpack_stage(self, local_rows: Dict[str, jax.Array], s: int):
+        """Stage ``s``'s param tree from a device's local ``{dtype: [cap]}``
+        row. Static offsets: slice + reshape of contiguous memory, which XLA
+        aliases — only the selected switch branch ever executes its unpack."""
+        leaves = self.plans[s].unpack(local_rows)
+        return jax.tree_util.tree_unflatten(self.treedefs[s], leaves)
+
+    def abstract_tree(self, s: int):
+        """Stage ``s``'s params as ShapeDtypeStructs (for eval_shape chains)."""
+        return jax.tree_util.tree_unflatten(self.treedefs[s],
+                                            list(self.plans[s].specs))
+
+    # -- accounting --------------------------------------------------------
+    def per_device_bytes(self) -> int:
+        """Bytes each device holds: one row of every per-dtype buffer."""
+        return sum(cap * np.dtype(dt).itemsize
+                   for dt, cap in self.capacities.items())
+
+    def total_param_bytes(self) -> int:
+        return sum(sz * np.dtype(dt).itemsize
+                   for plan in self.plans
+                   for sz, dt in zip(plan.sizes, plan.dtypes))

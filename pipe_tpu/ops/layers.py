@@ -53,10 +53,15 @@ class Module:
         return self.apply(params, *inputs, ctx=ctx)
 
     def out_spec(self, params, *input_specs):
-        """Abstract output spec, used to chain shape-driven inits."""
-        def f(*xs):
-            return self.apply(params, *xs, ctx=StageCtx())
-        return jax.eval_shape(f, *[_spec(x) for x in input_specs])
+        """Abstract output spec, used to chain shape-driven inits.
+
+        ``params`` goes through ``eval_shape`` as an argument (not a
+        closure), so abstract param trees — ``ShapeDtypeStruct`` leaves, as
+        produced by ``StageParamPack.abstract_tree`` for stage-sharded
+        params — chain shapes without any concrete weights existing."""
+        def f(p, *xs):
+            return self.apply(p, *xs, ctx=StageCtx())
+        return jax.eval_shape(f, params, *[_spec(x) for x in input_specs])
 
 
 class Lambda(Module):

@@ -28,12 +28,23 @@ with three devices-visible mechanisms, all static at trace time:
   reference's portal machinery (``skip/portal.py`` via ``pipeline.py:136-138``)
   that round 1 left emulator-only.
 
-Parameters stay per-stage pytrees, replicated over the mesh (``P()``): only
-branch ``j`` touches stage ``j``'s params on device ``j``, so their cotangents
-are zero elsewhere and the psum inserted by AD-of-``shard_map`` recovers exact
-gradients. This trades param-memory for generality — the price of arbitrary
-per-stage structures under SPMD; models at memory scale use the homogeneous
-stacked executors. Remat on this path is static per mode (``except_last``
+Parameters come in two layouts:
+
+* **Stage-sharded (the memory-scaling layout)**: :meth:`shard_params` packs
+  each stage's param tree into per-dtype rows of a ``[n, cap]`` array
+  sharded ``P('stage')`` (:class:`~pipe_tpu.core.packing.StageParamPack`) —
+  each device holds ONLY its partition's weights plus per-dtype padding to
+  the largest stage, matching the reference's partition-per-device placement
+  (``_split_module``, reference ``pipe.py:191-218,344-356``). Branch ``j``
+  unpacks its own row (static slice+reshape, aliased by XLA); grads come
+  back in the same sharded layout with no stage-axis communication.
+* **Replicated per-stage pytrees** (legacy/simple): every stage's tree on
+  every device (``P()``); only branch ``j`` touches stage ``j``'s params,
+  and the psum inserted by AD-of-``shard_map`` recovers exact gradients.
+  Convenient at toy scale; OOMs at exactly the model scale where pipeline
+  parallelism is the point — use :meth:`shard_params`.
+
+Remat on this path is static per mode (``except_last``
 remats all micro-batches like :mod:`.spmd`; the exact policy lives in
 :mod:`.scheduled`).
 """
@@ -49,51 +60,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core import microbatch as mb
+from ..core.packing import PackPlan as _PackPlan, StageParamPack
 from ..core.partition import StageCtx
 from ..core.remat import apply_remat, checkpoint_stop, validate_mode
 from .mesh import DATA_AXIS, STAGE_AXIS
 from ..utils.rng import make_key
 
 __all__ = ["HeteroSpmdPipeline"]
-
-
-class _PackPlan:
-    """Static layout of one boundary pytree inside the per-dtype carrier."""
-
-    def __init__(self, specs: Sequence[jax.ShapeDtypeStruct]):
-        self.specs = list(specs)
-        self.sizes = [int(np.prod(s.shape)) if s.shape else 1
-                      for s in self.specs]
-        self.dtypes = [np.dtype(s.dtype).name for s in self.specs]
-        self.per_dtype: dict = {}
-        for size, dt in zip(self.sizes, self.dtypes):
-            self.per_dtype[dt] = self.per_dtype.get(dt, 0) + size
-
-    def pack(self, values, capacities: dict):
-        """values (in spec order) -> {dtype: 1-D padded buffer}."""
-        chunks: dict = {dt: [] for dt in capacities}
-        for v, dt in zip(values, self.dtypes):
-            chunks[dt].append(jnp.ravel(v))
-        out = {}
-        for dt, cap in capacities.items():
-            if chunks[dt]:
-                flat = jnp.concatenate(chunks[dt]) if len(chunks[dt]) > 1 \
-                    else chunks[dt][0]
-                pad = cap - flat.shape[0]
-                out[dt] = jnp.pad(flat, (0, pad)) if pad else flat
-            else:
-                out[dt] = jnp.zeros((cap,), dtype=np.dtype(dt))
-        return out
-
-    def unpack(self, carrier: dict):
-        offsets: dict = {dt: 0 for dt in carrier}
-        values = []
-        for spec, size, dt in zip(self.specs, self.sizes, self.dtypes):
-            off = offsets[dt]
-            flat = jax.lax.slice_in_dim(carrier[dt], off, off + size)
-            offsets[dt] = off + size
-            values.append(jnp.reshape(flat, spec.shape))
-        return values
 
 
 class HeteroSpmdPipeline:
@@ -122,6 +95,29 @@ class HeteroSpmdPipeline:
             if src != dst:
                 for ns, name in names:
                     self.lane_keys.append((ns, name, src, dst))
+        # Established by shard_params(); None until then (replicated layout).
+        self.param_pack: Optional[StageParamPack] = None
+
+    # -----------------------------------------------------------------
+    def shard_params(self, params_per_stage: Sequence[Any]):
+        """Convert per-stage trees to the stage-sharded packed layout
+        (``{dtype: [n, cap]}``, row j on stage j's devices) and remember the
+        pack plans so subsequent calls accept the packed form."""
+        if len(params_per_stage) != self.n_stages:
+            raise ValueError(
+                f"{len(params_per_stage)} per-stage trees for a "
+                f"{self.n_stages}-stage pipeline")
+        pack = StageParamPack(params_per_stage)
+        packed = pack.shard(self.mesh, params_per_stage,
+                            stage_axis=STAGE_AXIS)
+        self.param_pack = pack  # only after shard() succeeded
+        return packed
+
+    def unshard_params(self, packed):
+        """Packed params (or grads in the same layout) → per-stage trees."""
+        if self.param_pack is None:
+            raise ValueError("no StageParamPack: call shard_params() first")
+        return self.param_pack.unshard(packed)
 
     # -----------------------------------------------------------------
     def __call__(self, params: Sequence[Any], *inputs,
@@ -129,6 +125,15 @@ class HeteroSpmdPipeline:
                  train: bool = False, remat_policy=None):
         n = self.n_stages
         m = self.chunks
+        # Packed stage-sharded params ({dtype: [n, cap]}) vs per-stage trees.
+        packed = isinstance(params, dict)
+        if packed:
+            if self.param_pack is None:
+                raise ValueError(
+                    "packed params given but no StageParamPack on this "
+                    "executor; call shard_params() (or Pipe.shard_params) "
+                    "first")
+            self.param_pack.check_packed(params)
         mb.check(*inputs)
         kinds = []
         for x in inputs:
@@ -182,7 +187,9 @@ class HeteroSpmdPipeline:
         specs = vals0
         with use_skip_tracker(spec_tracker):
             for jdx, part in enumerate(self.partitions):
-                out = part.out_spec(params[jdx], *specs)
+                p_j = (self.param_pack.abstract_tree(jdx) if packed
+                       else params[jdx])
+                out = part.out_spec(p_j, *specs)
                 specs = list(out) if isinstance(out, (tuple, list)) else [out]
                 boundaries.append(specs)
         lane_specs = [spec_tracker._store[(0, ns, name)]
@@ -215,19 +222,25 @@ class HeteroSpmdPipeline:
             P(*([STAGE_AXIS, None, data] + [None] * (len(s.shape) - 1)))
         for s in out_specs_local)
 
+        if packed:
+            # one row per device: only its own partition's weights live here
+            p_arg = dict(params)
+            p_spec = {dt: P(STAGE_AXIS, None) for dt in p_arg}
+        else:
+            p_arg = tuple(params)
+            p_spec = jax.tree_util.tree_map(lambda _: P(), p_arg)
         run = jax.shard_map(
             functools.partial(
                 self._device_program, m=m, plans=plans,
                 capacities=capacities, lane_specs=lane_specs,
                 out_specs_local=out_specs_local, train=train, keyed=keyed,
                 remat_on=stop > 0, remat_policy=remat_policy,
-                static_vals=static_vals, kinds=kinds),
+                static_vals=static_vals, kinds=kinds, packed=packed),
             mesh=self.mesh,
-            in_specs=(jax.tree_util.tree_map(lambda _: P(), tuple(params)),
-                      x_specs, P()),
+            in_specs=(p_spec, x_specs, P()),
             out_specs=out_sp,
             check_vma=False)
-        stacked_out = run(tuple(params), stacked, key)
+        stacked_out = run(p_arg, stacked, key)
         # device n-1's slice holds the real outputs: [n, m, rows...] -> [m, ...]
         outs = tuple(o[-1] for o in stacked_out)
         if mb_rows != true_rows:  # drop data-axis padding before gather
@@ -238,7 +251,7 @@ class HeteroSpmdPipeline:
     # -----------------------------------------------------------------
     def _make_branch(self, s, all_params, train, keyed, remat_on,
                      remat_policy, plans, capacities, out_specs_local,
-                     static_vals, kinds):
+                     static_vals, kinds, packed):
         from ..extras.skip import SkipTracker
 
         n = self.n_stages
@@ -273,7 +286,15 @@ class HeteroSpmdPipeline:
                 return out, stash_vals
 
             wrapped = apply_remat(task, enabled=remat_on, policy=remat_policy)
-            out, stash_vals = wrapped(all_params[s], kij, pop_vals, *vals)
+            if packed:
+                # local row [1, cap] per dtype → this stage's tree; only the
+                # selected switch branch executes its unpack, and its
+                # transpose scatters grads straight back into the local row.
+                p_s = self.param_pack.unpack_stage(
+                    {dt: a[0] for dt, a in all_params.items()}, s)
+            else:
+                p_s = all_params[s]
+            out, stash_vals = wrapped(p_s, kij, pop_vals, *vals)
             out_vals = list(out) if isinstance(out, (tuple, list)) else [out]
             lanes2 = list(lanes)
             for idx, v in zip(stash_idx, stash_vals):
@@ -292,14 +313,14 @@ class HeteroSpmdPipeline:
     # -----------------------------------------------------------------
     def _device_program(self, all_params, x, key, *, m, plans, capacities,
                         lane_specs, out_specs_local, train, keyed, remat_on,
-                        remat_policy, static_vals, kinds):
+                        remat_policy, static_vals, kinds, packed):
         n = self.n_stages
         j = jax.lax.axis_index(STAGE_AXIS)
 
         branches = [
             self._make_branch(s, all_params, train, keyed, remat_on,
                               remat_policy, plans, capacities,
-                              out_specs_local, static_vals, kinds)
+                              out_specs_local, static_vals, kinds, packed)
             for s in range(n)]
 
         carrier0 = {dt: jnp.zeros((cap,), dtype=np.dtype(dt))

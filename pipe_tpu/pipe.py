@@ -174,9 +174,16 @@ class Pipe:
 
     # --- params ---
 
-    def init(self, key: jax.Array, *example_inputs) -> List[Any]:
-        """Per-stage parameter pytrees, shapes chained stage to stage."""
+    def init(self, key: jax.Array, *example_inputs,
+             _host: bool = False) -> List[Any]:
+        """Per-stage parameter pytrees, shapes chained stage to stage.
+
+        ``_host=True`` (used by :meth:`init_sharded`) moves each stage's
+        fresh params to host numpy immediately, so peak device memory during
+        init is ONE stage, not the whole model."""
         import contextlib
+
+        import numpy as np
 
         # Shape inference through skip-carrying layers: a spec-mode tracker
         # records stash shapes and serves pops as zeros (tracers cannot cross
@@ -193,11 +200,42 @@ class Pipe:
             for j, part in enumerate(self.partitions):
                 pkey = jax.random.fold_in(key, j)
                 p = part.init(pkey, *specs)
-                params.append(p)
                 out = part.out_spec(p, *specs)
+                if _host:
+                    p = jax.tree_util.tree_map(np.asarray, p)
+                params.append(p)
                 specs = list(out) if isinstance(out, (tuple, list)) else [out]
         verify_splitting(params)
         return params
+
+    # --- stage-sharded params (reference _split_module's partition-per-
+    # device placement, pipe.py:191-218,344-356) ---
+
+    def shard_params(self, params: Sequence[Any]):
+        """Per-stage trees → stage-sharded packed layout: each device holds
+        ONLY its own partition's weights (``{dtype: [n_stages, cap]}`` rows
+        sharded over the mesh's stage axis). Requires ``mesh=``. The packed
+        dict is a plain pytree — differentiate with respect to it, feed it
+        to optax — and :meth:`unshard_params` converts either params or
+        grads back to per-stage trees."""
+        if self._executor is None:
+            raise ValueError("shard_params requires Pipe(mesh=...)")
+        return self._executor.shard_params(params)
+
+    def unshard_params(self, packed):
+        if self._executor is None:
+            raise ValueError("unshard_params requires Pipe(mesh=...)")
+        return self._executor.unshard_params(packed)
+
+    unshard_grads = unshard_params
+
+    def init_sharded(self, key: jax.Array, *example_inputs):
+        """Initialize straight into the stage-sharded layout. Each stage's
+        fresh params move to host before the next stage initializes, and
+        sharding builds per-device rows directly — peak device memory is one
+        stage's weights, never the whole model."""
+        return self.shard_params(
+            self.init(key, *example_inputs, _host=True))
 
     # --- forward (reference pipe.py:431-494) ---
 
@@ -210,6 +248,10 @@ class Pipe:
         if self._executor is not None:
             return self._executor(params, *inputs, key=key, train=train,
                                   remat_policy=remat_policy)
+        if isinstance(params, dict):
+            raise TypeError(
+                "stage-sharded packed params need Pipe(mesh=...); the serial "
+                "emulator takes per-stage trees (use unshard_params)")
         mb.check(*inputs)
         batches = mb.scatter(inputs, self.chunks)
         has_bn = any(isinstance(l, DeferredBatchNorm) for l in self)

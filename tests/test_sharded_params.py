@@ -1,0 +1,231 @@
+"""Stage-sharded parameters on the flagship mesh path (VERDICT r2 #1).
+
+The reference's ``_split_module`` moves each partition to its own device
+(reference ``pipe.py:191-218``, wired at ``pipe.py:344-356``) — each GPU
+holds ONLY its stage's weights. These tests pin the TPU-native equivalent:
+``Pipe.shard_params`` packs per-stage trees into per-dtype ``[n, cap]`` rows
+sharded over the mesh's stage axis, each device's addressable bytes scale as
+~total/n, and forward + gradients stay transparent in the packed layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu import Dropout, Linear, Pipe, Sequential
+from pipe_tpu.core.packing import StageParamPack
+from pipe_tpu.parallel.mesh import make_mesh
+
+WIDTH = 8
+
+
+def make_mlp(key, depth=4, width=WIDTH):
+    seq = Sequential([Linear(width) for _ in range(depth)])
+    params = seq.init(key, jnp.zeros((2, width)))
+    return seq, params
+
+
+def _regroup(flat_params, balance):
+    out, off = [], 0
+    for w in balance:
+        out.append(flat_params[off:off + w])
+        off += w
+    return out
+
+
+def stage_mesh(n_stages, n_data=1):
+    return make_mesh(n_stages, n_data,
+                     devices=jax.devices()[:n_stages * n_data])
+
+
+def test_shard_unshard_roundtrip():
+    seq, params = make_mlp(jax.random.key(0))
+    pipe = Pipe(seq, chunks=2, checkpoint="never", mesh=stage_mesh(2))
+    sp = _regroup(params, pipe.balance)
+    packed = pipe.shard_params(sp)
+    back = pipe.unshard_params(packed)
+    for a, b in zip(jax.tree_util.tree_leaves(sp),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("chunks", [1, 2, 3])
+@pytest.mark.parametrize("n_stages", [1, 2, 4])
+def test_sharded_forward_matches_plain(chunks, n_stages):
+    seq, params = make_mlp(jax.random.key(0))
+    pipe = Pipe(seq, chunks=chunks, checkpoint="never",
+                mesh=stage_mesh(n_stages))
+    packed = pipe.shard_params(_regroup(params, pipe.balance))
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+    np.testing.assert_allclose(np.asarray(pipe(packed, x)),
+                               np.asarray(seq.apply(params, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("checkpoint", ["never", "except_last", "always"])
+def test_sharded_gradient_transparency(checkpoint):
+    """grad with respect to the PACKED layout == plain-model grads after
+    unshard — stage grads come back sharded with no stage-axis collectives."""
+    seq, params = make_mlp(jax.random.key(0))
+    pipe = Pipe(seq, chunks=4, checkpoint=checkpoint, mesh=stage_mesh(2))
+    sp = _regroup(params, pipe.balance)
+    packed = pipe.shard_params(sp)
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+
+    expected = jax.grad(lambda p: jnp.mean(seq.apply(p, x) ** 2))(params)
+    gp = jax.grad(lambda pk: jnp.mean(pipe(pk, x, train=True) ** 2))(packed)
+    got = pipe.unshard_grads(gp)
+    flat_e = jax.tree_util.tree_leaves(_regroup(expected, pipe.balance))
+    flat_g = jax.tree_util.tree_leaves(got)
+    assert len(flat_e) == len(flat_g)
+    for e, g in zip(flat_e, flat_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_grads_with_data_axis():
+    """PP x DP in the packed layout: AD inserts the data-axis psum for the
+    replicated rows; stage rows need no collective at all."""
+    seq, params = make_mlp(jax.random.key(0))
+    pipe = Pipe(seq, chunks=2, checkpoint="except_last",
+                mesh=stage_mesh(2, n_data=2))
+    packed = pipe.shard_params(_regroup(params, pipe.balance))
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+
+    expected = jax.grad(lambda p: jnp.mean(seq.apply(p, x) ** 2))(params)
+    gp = jax.grad(lambda pk: jnp.mean(pipe(pk, x, train=True) ** 2))(packed)
+    got = pipe.unshard_grads(gp)
+    for e, g in zip(jax.tree_util.tree_leaves(_regroup(expected,
+                                                       pipe.balance)),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_dropout_and_jit():
+    seq = Sequential([Linear(WIDTH), Dropout(0.5), Linear(WIDTH)])
+    pipe = Pipe(seq, chunks=2, checkpoint="never", mesh=stage_mesh(2),
+                balance=[2, 1])
+    packed = pipe.init_sharded(jax.random.key(0), jnp.zeros((2, WIDTH)))
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+
+    @jax.jit
+    def fwd(pk, k):
+        return pipe(pk, x, key=k, train=True)
+
+    a = fwd(packed, jax.random.key(42))
+    b = fwd(packed, jax.random.key(42))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.allclose(np.asarray(a),
+                           np.asarray(fwd(packed, jax.random.key(43))))
+
+
+def test_uneven_heterogeneous_sharded_matches_emulator():
+    """Uneven balance + shape-varying boundaries in the packed layout."""
+    seq = Sequential([Linear(WIDTH), Linear(16), Linear(WIDTH), Linear(WIDTH)])
+    params = seq.init(jax.random.key(0), jnp.zeros((2, WIDTH)))
+    balance = [3, 1]
+    mesh_pipe = Pipe(seq, chunks=2, checkpoint="never", mesh=stage_mesh(2),
+                     balance=balance)
+    emu_pipe = Pipe(seq, chunks=2, checkpoint="never", balance=balance)
+    sp = _regroup(params, balance)
+    packed = mesh_pipe.shard_params(sp)
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+    np.testing.assert_allclose(np.asarray(mesh_pipe(packed, x)),
+                               np.asarray(emu_pipe(sp, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_per_device_bytes_scale():
+    """4 equal stages: each device's addressable param bytes == total/4 —
+    the memory scaling that is pipeline parallelism's reason to exist."""
+    seq, params = make_mlp(jax.random.key(0), depth=4)
+    pipe = Pipe(seq, chunks=2, checkpoint="never", mesh=stage_mesh(4))
+    packed = pipe.shard_params(_regroup(params, pipe.balance))
+
+    total = sum(np.asarray(l).nbytes
+                for l in jax.tree_util.tree_leaves(params))
+    per_dev: dict = {}
+    for arr in packed.values():
+        for sh in arr.addressable_shards:
+            per_dev[sh.device] = per_dev.get(sh.device, 0) + sh.data.nbytes
+    assert len(per_dev) == 4
+    for dev, nbytes in per_dev.items():
+        assert nbytes == total // 4, (dev, nbytes, total)
+    # the pack's own accounting agrees with the buffers
+    assert pipe._executor.param_pack.per_device_bytes() == total // 4
+
+
+def test_foreign_packed_layout_rejected():
+    """A packed dict whose buffer layout does not match this Pipe's pack is
+    rejected at call time ([3,1] vs [2,2] differ in capacity; NOTE mirror
+    balances like [3,1]/[1,3] produce byte-identical layouts and cannot be
+    told apart — that residual ambiguity is documented in check_packed)."""
+    seq = Sequential([Linear(WIDTH) for _ in range(4)])
+    params = seq.init(jax.random.key(0), jnp.zeros((2, WIDTH)))
+    pa = Pipe(seq, chunks=2, checkpoint="never", mesh=stage_mesh(2),
+              balance=[3, 1])
+    pb = Pipe(seq, chunks=2, checkpoint="never", mesh=stage_mesh(2),
+              balance=[2, 2])
+    packed_a = pa.shard_params(_regroup(params, [3, 1]))
+    pb.shard_params(_regroup(params, [2, 2]))  # pb has its own pack
+    x = jnp.ones((4, WIDTH))
+    with pytest.raises(ValueError):
+        pb(packed_a, x)
+    # and a wrong stage count at shard time fails fast
+    with pytest.raises(ValueError):
+        pa.shard_params(_regroup(params, [2, 1, 1]))
+
+
+def test_packed_params_need_shard_params_first():
+    seq, params = make_mlp(jax.random.key(0))
+    p1 = Pipe(seq, chunks=2, checkpoint="never", mesh=stage_mesh(2))
+    p2 = Pipe(seq, chunks=2, checkpoint="never", mesh=stage_mesh(2))
+    packed = p1.shard_params(_regroup(params, p1.balance))
+    x = jnp.ones((4, WIDTH))
+    with pytest.raises(ValueError):
+        p2(packed, x)
+    emu = Pipe(seq, chunks=2, checkpoint="never", n_stages=2)
+    with pytest.raises(TypeError):
+        emu(packed, x)
+
+
+def test_tutorial_520m_per_device_bytes():
+    """The VERDICT r2 #1 'done' bar: the 520M tutorial config through
+    Pipe(mesh=, n_stages=4) on the cpu8 mesh, each device holding ~total/4
+    param bytes (reference model: 520,900,718 params, README.md:570)."""
+    from pipe_tpu.models.transformer_lm import LMConfig, build_sequential
+    import dataclasses
+
+    cfg = dataclasses.replace(LMConfig(), seq_len=32, dropout=0.0)
+    seq = build_sequential(cfg)
+    # embed+posenc+3 blocks | 5 blocks | 5 blocks | 3 blocks+decoder:
+    # ≈134M / 126M / 126M / 134M params — near-uniform cost split.
+    balance = [5, 5, 5, 4]
+    pipe = Pipe(seq, chunks=2, checkpoint="except_last",
+                mesh=stage_mesh(4), balance=balance)
+    tokens = jnp.zeros((1, cfg.seq_len), jnp.int32)
+    sp = pipe.init(jax.random.key(0), tokens)
+    n_params = sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree_util.tree_leaves(sp))
+    assert n_params > 4e8, n_params  # the real tutorial scale
+    packed = pipe.shard_params(sp)
+    del sp
+
+    total = sum(arr.nbytes for arr in packed.values())
+    per_dev: dict = {}
+    for arr in packed.values():
+        for sh in arr.addressable_shards:
+            per_dev[sh.device] = per_dev.get(sh.device, 0) + sh.data.nbytes
+    assert len(per_dev) == 4
+    for dev, nbytes in per_dev.items():
+        # cap = largest stage -> per-device <= ~1.07x of total/4 here
+        assert nbytes <= 1.1 * total / 4, (dev, nbytes, total)
+
+    # and the model still runs end to end in the packed layout
+    x = jax.random.randint(jax.random.key(1), (2, cfg.seq_len),
+                           0, cfg.vocab, jnp.int32)
+    out = pipe(packed, x)
+    assert out.shape == (2, cfg.seq_len, cfg.vocab)
+    assert bool(jnp.isfinite(out).all())
