@@ -1,0 +1,283 @@
+"""Memory-capped schedules (1F1B / zb-h1 / interleaved-1f1b) for Pipe(mesh=).
+
+The reference's entire fork/join machinery exists so backward can run each
+micro-batch as soon as its gradient arrives, releasing activations early
+(reference ``pipeline.py:128-132``); its user-facing constructor is
+``Pipe(module, chunks, checkpoint)`` (``pipe.py:308-314``). Round 2 had true
+1F1B only behind the expert :class:`~pipe_tpu.parallel.scheduled
+.ScheduledPipeline` API (homogeneous ``stage_fn`` + manually stacked
+params). This module closes that gap: it lowers a ``Pipe``'s arbitrary
+heterogeneous partitions onto the table executor, so
+``Pipe(module, chunks, checkpoint, mesh=mesh, schedule='1f1b')`` — the
+literal capability statement of the target — trains with the ``min(m, n)``
+activation cap and the exact per-micro-batch checkpoint policy.
+
+How heterogeneity rides the homogeneous table executor — every boundary is
+made ring-uniform by the same per-dtype packed carrier the GPipe-wavefront
+executor uses (:class:`~pipe_tpu.core.packing.PackPlan`):
+
+* ``pre_fn`` packs the micro-batch inputs into the carrier (boundary 0);
+* ``stage_fn`` is a ``lax.switch`` over virtual stages — branch ``s``
+  unpacks boundary ``s``, applies partition ``s`` (params unpacked from the
+  device's stage-sharded row), packs boundary ``s+1``. ``ctx.stage``
+  (threaded by the executor) selects the branch;
+* ``post_fn`` unpacks the final boundary and applies the user's
+  ``loss_fn`` to get the per-row loss the executor's masked mean expects.
+
+Because EVERY partition packs to the same fixed-capacity carrier, all
+partitions are ring-compatible by construction — uneven balance and
+multi-value boundaries need no special casing. Params use the stage-sharded
+packed layout (``Pipe.shard_params``), so this is also the path where 1F1B's
+activation cap meets partition-per-device weight placement.
+
+Interleaved schedules (``v > 1``): the module must split into ``v*d``
+partitions; virtual stage ``s`` lives on device ``s % d``, so the packed
+param rows are laid out device-major (row ``p*v + g`` holds virtual stage
+``g*d + p`` — ``stack_interleaved_params`` ordering).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import microbatch as mb
+from ..core.packing import PackPlan, StageParamPack
+from ..core.partition import StageCtx
+from ..core.schedule import Schedule, get_schedule
+from .mesh import DATA_AXIS, STAGE_AXIS
+from .scheduled import ScheduledPipeline
+
+__all__ = ["HeteroScheduledPipeline"]
+
+
+class HeteroScheduledPipeline:
+    """Training executor lowering Pipe partitions onto schedule tables."""
+
+    def __init__(self, mesh, partitions, skip_layout, chunks: int,
+                 checkpoint: str, schedule):
+        self.mesh = mesh
+        self.d = mesh.shape[STAGE_AXIS]
+        self.schedule: Schedule = (get_schedule(schedule)
+                                   if isinstance(schedule, str) else schedule)
+        self.v = self.schedule.v
+        self.S = self.v * self.d
+        if len(partitions) != self.S:
+            raise ValueError(
+                f"{len(partitions)} partitions for schedule "
+                f"{self.schedule.name!r} on a {self.d}-device stage axis "
+                f"(needs v*d = {self.S})")
+        if skip_layout is not None and skip_layout.num_skips > 0:
+            raise NotImplementedError(
+                "@skippable stashes are not routed through the 1F1B/zb "
+                "table executor yet; use schedule='gpipe' for skip models")
+        self.partitions = list(partitions)
+        self.chunks = chunks
+        self.checkpoint = checkpoint
+        self.has_data = DATA_AXIS in mesh.axis_names
+        self.n_data = mesh.shape[DATA_AXIS] if self.has_data else 1
+        self.param_pack: Optional[StageParamPack] = None
+
+    # -- param layout ------------------------------------------------------
+    def row_of(self, s: int) -> int:
+        """Packed-param row holding virtual stage ``s`` (device-major for
+        interleaved: row ``p*v + g`` = virtual stage ``g*d + p``)."""
+        if self.v == 1:
+            return s
+        return (s % self.d) * self.v + (s // self.d)
+
+    def shard_params(self, params_per_stage: Sequence[Any]):
+        """Per-partition trees → ``{dtype: [S, cap]}`` rows sharded over the
+        stage axis in the executor's device-major row order."""
+        if len(params_per_stage) != self.S:
+            raise ValueError(
+                f"{len(params_per_stage)} per-stage trees for {self.S} "
+                f"virtual stages")
+        rows = [params_per_stage[self._stage_of_row(r)]
+                for r in range(self.S)]
+        pack = StageParamPack(rows)
+        packed = pack.shard(self.mesh, rows, stage_axis=STAGE_AXIS)
+        self.param_pack = pack
+        return packed
+
+    def _stage_of_row(self, r: int) -> int:
+        if self.v == 1:
+            return r
+        return (r % self.v) * self.d + (r // self.v)
+
+    def unshard_params(self, packed):
+        if self.param_pack is None:
+            raise ValueError("no StageParamPack: call shard_params() first")
+        rows = self.param_pack.unshard(packed)
+        return [rows[self.row_of(s)] for s in range(self.S)]
+
+    def memory_plan(self, m: Optional[int] = None) -> dict:
+        sp = ScheduledPipeline(self.mesh, stage_fn=None, pre_fn=None,
+                               post_fn=None, checkpoint=self.checkpoint,
+                               schedule=self.schedule)
+        return sp.memory_plan(m if m is not None else self.chunks)
+
+    # -- the training step -------------------------------------------------
+    def loss_and_grad(self, params, *inputs,
+                      targets: Any = None,
+                      loss_fn: Callable,
+                      key: Optional[jax.Array] = None):
+        """One pipelined training step: ``(loss, packed_grads)``.
+
+        ``loss_fn(*outputs, targets_mb) -> [rows]`` maps one micro-batch's
+        final-boundary outputs (the values ``Pipe.__call__`` would return)
+        plus the matching micro-batch of ``targets`` to per-row losses; the
+        executor reduces them as a padding-masked mean. With
+        ``targets=None``, ``loss_fn(*outputs) -> [rows]``.
+
+        Wrap the whole train step in ``jax.jit`` (see tests): the lowering
+        is rebuilt per call (boundary plans depend on the input shapes), so
+        un-jitted use re-traces the pipeline every step.
+        """
+        if not isinstance(params, dict):
+            raise TypeError(
+                "loss_and_grad runs on stage-sharded packed params; call "
+                "Pipe.shard_params/init_sharded first")
+        if self.param_pack is None:
+            raise ValueError(
+                "no StageParamPack on this executor; call shard_params() "
+                "(or Pipe.shard_params) first")
+        self.param_pack.check_packed(params)
+        pack = self.param_pack
+        m = self.chunks
+        mb.check(*inputs)
+
+        # classify inputs exactly like the forward executor: arrays scatter,
+        # NoChunk/static close over
+        kinds: List[str] = []
+        for x in inputs:
+            if isinstance(x, mb.NoChunk):
+                kinds.append("nochunk")
+            elif mb.is_array(x):
+                kinds.append("array")
+            else:
+                kinds.append("static")
+        closed = {p: (x.value if k == "nochunk" else x)
+                  for p, (x, k) in enumerate(zip(inputs, kinds))
+                  if k != "array"}
+        dyn = {str(p): x for p, (x, k) in enumerate(zip(inputs, kinds))
+               if k == "array"}
+        if not dyn:
+            raise TypeError("loss_and_grad needs at least one array input")
+        stacked, true_rows = mb.stack_scatter(dyn, m)
+        w = mb.valid_row_mask(stacked, true_rows)
+        tgt_stacked = None
+        if targets is not None:
+            tgt_stacked, t_rows = mb.stack_scatter(targets, m)
+            if t_rows != true_rows:
+                raise ValueError(
+                    f"targets batch {t_rows} != inputs batch {true_rows}")
+
+        # rows must divide the data axis; zero-pad and zero the mask
+        rows = next(iter(stacked.values())).shape[1]
+        mb_rows = -(-rows // self.n_data) * self.n_data
+        if mb_rows != rows:
+            def pad_rows(v):
+                pad = [(0, 0), (0, mb_rows - rows)] + [(0, 0)] * (v.ndim - 2)
+                return jnp.pad(v, pad)
+            stacked = {p: pad_rows(v) for p, v in stacked.items()}
+            if tgt_stacked is not None:
+                tgt_stacked = jax.tree_util.tree_map(pad_rows, tgt_stacked)
+            w = jnp.pad(w, [(0, 0), (0, mb_rows - rows)])
+        local_rows = mb_rows // self.n_data
+
+        # -- boundary chain (abstract; partition order) -------------------
+        def local_spec(v):
+            return jax.ShapeDtypeStruct((local_rows,) + v.shape[2:], v.dtype)
+
+        in_specs: List[Any] = []
+        for p in range(len(inputs)):
+            if p in closed:
+                in_specs.append(closed[p])
+            else:
+                in_specs.append(local_spec(stacked[str(p)]))
+        plans: List[PackPlan] = []
+        x_plan_specs = [s for p, s in enumerate(in_specs) if p not in closed]
+        plans.append(PackPlan([jax.ShapeDtypeStruct(s.shape, s.dtype)
+                               for s in x_plan_specs]))
+        specs = in_specs
+        boundaries = [in_specs]
+        for s_idx, part in enumerate(self.partitions):
+            out = part.out_spec(pack.abstract_tree(self.row_of(s_idx)),
+                                *specs)
+            specs = list(out) if isinstance(out, (tuple, list)) else [out]
+            boundaries.append(specs)
+            plans.append(PackPlan(
+                [jax.ShapeDtypeStruct(jnp.shape(sp_), jnp.result_type(sp_))
+                 for sp_ in specs]))
+        capacities: Dict[str, int] = {}
+        for plan in plans:
+            for dt, sz in plan.per_dtype.items():
+                capacities[dt] = max(capacities.get(dt, 0), sz)
+
+        dyn_pos = [p for p in range(len(inputs)) if p not in closed]
+
+        # -- executor bodies ----------------------------------------------
+        def pre_fn(prep, x_mb, ctx):
+            del prep
+            vals = [x_mb["in"][str(p)] for p in dyn_pos]
+            return plans[0].pack(vals, capacities)
+
+        def make_branch(s_idx):
+            part = self.partitions[s_idx]
+
+            def branch(params_g, carrier, ctx):
+                packed_vals = plans[s_idx].unpack(carrier)
+                vals: List[Any] = []
+                it = iter(packed_vals)
+                for p in range(len(boundaries[s_idx])):
+                    if s_idx == 0 and p in closed:
+                        vals.append(closed[p])
+                    else:
+                        vals.append(next(it))
+                p_tree = pack.unpack_stage(params_g, self.row_of(s_idx))
+                out = part.apply(p_tree, *vals, ctx=ctx)
+                out_vals = (list(out) if isinstance(out, (tuple, list))
+                            else [out])
+                return plans[s_idx + 1].pack(out_vals, capacities)
+
+            return branch
+
+        branches = [make_branch(s_idx) for s_idx in range(self.S)]
+
+        def stage_fn(params_g, h, ctx):
+            s = ctx.stage
+            if isinstance(s, int):          # d == 1 static specialization
+                return branches[s](params_g, h, ctx)
+            return jax.lax.switch(
+                s, [lambda pg=params_g, hh=h, c=ctx, b=b: b(pg, hh, c)
+                    for b in branches])
+
+        def post_fn(postp, h, x_mb, ctx):
+            del postp
+            outs = plans[self.S].unpack(h)
+            args = list(outs)
+            if targets is not None:
+                args.append(x_mb["tgt"])
+            per_row = loss_fn(*args)
+            if jnp.ndim(per_row) != 1:
+                raise ValueError(
+                    f"loss_fn must return per-row losses [rows]; got shape "
+                    f"{jnp.shape(per_row)}")
+            return per_row
+
+        x = {"in": stacked}
+        if tgt_stacked is not None:
+            x["tgt"] = tgt_stacked
+
+        sp = ScheduledPipeline(self.mesh, stage_fn, pre_fn=pre_fn,
+                               post_fn=post_fn, checkpoint=self.checkpoint,
+                               schedule=self.schedule)
+        # stage-sharded packed rows ARE the stacked stage params; () for
+        # pre/post (packing has no weights; the loss is pure)
+        loss, (g_packed, _, _) = sp.loss_and_grad(params, (), (), x, w,
+                                                  key=key)
+        return loss, g_packed

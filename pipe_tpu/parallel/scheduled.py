@@ -199,6 +199,14 @@ class ScheduledPipeline:
         m = x_leaves[0].shape[0]
         key = key if key is not None else make_key(0)
         data = DATA_AXIS if self.has_data_axis else None
+        # Total loss weight, computed OUTSIDE the device program (w is the
+        # full global array here) and passed in replicated. Keeping this as
+        # an in-program psum over the data axis made it the one SUBGROUP
+        # collective racing the stage-ring ppermutes — a combination that
+        # intermittently starves XLA:CPU's blocking rendezvous into deadlock
+        # on the single-core virtual-device test platform. Hoisting it is
+        # also simply cheaper: one host-side reduction per step.
+        wsum = jnp.sum(w).astype(jnp.float32)
 
         def x_spec(l):
             spec = [None, data] + [None] * (l.ndim - 2)
@@ -212,6 +220,7 @@ class ScheduledPipeline:
             jax.tree_util.tree_map(lambda _: P(), post_params),
             jax.tree_util.tree_map(x_spec, x),
             P(None, data),                # w
+            P(),                          # wsum (precomputed, replicated)
             P(),                          # key
         )
         out_specs = (
@@ -224,7 +233,7 @@ class ScheduledPipeline:
             functools.partial(self._device_program, m=m),
             mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False)
-        return run(stage_params, pre_params, post_params, x, w, key)
+        return run(stage_params, pre_params, post_params, x, w, wsum, key)
 
     # -----------------------------------------------------------------
     def _f_body(self, params_g, prep, h_in, x_mb, kis, s):
@@ -252,9 +261,12 @@ class ScheduledPipeline:
                                 StageCtx(key=jax.random.fold_in(kis, 0),
                                          train=train)),
             lambda: h_in)
+        # ctx.stage carries the VIRTUAL stage index (traced on the d>1 path,
+        # a Python int on the d=1 static path) so heterogeneous adapters can
+        # switch their per-stage bodies on it (parallel.hetero_scheduled).
         return self.stage_fn(params_g, h0,
                              StageCtx(key=jax.random.fold_in(kis, 1),
-                                      train=train))
+                                      train=train, stage=s))
 
     def _post_contrib(self, postp, h1, x_mb, w_mb, kis):
         """UNNORMALIZED loss contribution ``sum(w * per_row)`` of one
@@ -312,7 +324,7 @@ class ScheduledPipeline:
 
     # -----------------------------------------------------------------
     def _device_program_static(self, stage_params, pre_params, post_params,
-                               x, w, key, *, m):
+                               x, w, wsum, key, *, m):
         """Single-stage-device specialization: the tables unrolled at trace
         time into straight-line code.
 
@@ -332,10 +344,6 @@ class ScheduledPipeline:
         v = self.v
         S = self.n_virtual
         mode = self.checkpoint
-
-        wsum = jnp.sum(w).astype(jnp.float32)
-        if self.has_data_axis:
-            wsum = jax.lax.psum(wsum, DATA_AXIS)
         inv_wsum = 1.0 / wsum
 
         tables = self.schedule.op_tables(m, 1)
@@ -448,23 +456,16 @@ class ScheduledPipeline:
 
     # -----------------------------------------------------------------
     def _device_program(self, stage_params, pre_params, post_params, x, w,
-                        key, *, m):
+                        wsum, key, *, m):
         d, v = self.n_stages, self.v
         S = self.n_virtual
         if d == 1 and self._use_static(m):
             return self._device_program_static(
-                stage_params, pre_params, post_params, x, w, key, m=m)
+                stage_params, pre_params, post_params, x, w, wsum, key, m=m)
         j = jax.lax.axis_index(STAGE_AXIS)
         # This device's shard: [v, ...] — its interleave groups in order.
         params_dev = stage_params
         mode = self.checkpoint
-
-        # Total loss weight, global over the data axis (w is replicated over
-        # stage/context) — contributions are pre-divided so loss and grads
-        # come out as the masked mean.
-        wsum = jnp.sum(w).astype(jnp.float32)
-        if self.has_data_axis:
-            wsum = jax.lax.psum(wsum, DATA_AXIS)
 
         # --- local shape specs -------------------------------------------
         ctx0 = StageCtx(key=None, train=True)

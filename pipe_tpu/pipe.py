@@ -94,28 +94,30 @@ class Pipe:
 
         if balance is not None and n_stages is None:
             n_stages = len(balance)
+        sched_obj = (get_schedule(schedule) if isinstance(schedule, str)
+                     else schedule)
         if mesh is not None:
             from .parallel.mesh import STAGE_AXIS
             if STAGE_AXIS not in mesh.axis_names:
                 raise ValueError(
                     f"mesh must have a {STAGE_AXIS!r} axis to drive a Pipe")
             mesh_stages = mesh.shape[STAGE_AXIS]
+            # Interleaved schedules host v virtual stages per device: the
+            # module splits into v*d partitions, virtual stage s on device
+            # s % d. Non-interleaved (v == 1): one partition per device.
+            expected = mesh_stages * sched_obj.v
             if n_stages is None:
-                n_stages = mesh_stages
-            elif n_stages != mesh_stages:
+                n_stages = expected
+            elif n_stages != expected:
                 raise ValueError(
                     f"n_stages={n_stages} does not match the mesh's "
-                    f"{mesh_stages}-device stage axis")
+                    f"{mesh_stages}-device stage axis for schedule "
+                    f"{sched_obj.name!r} (needs v*d = {expected})")
             if deferred_batch_norm:
                 raise NotImplementedError(
                     "deferred_batch_norm requires the whole-minibatch stat "
                     "commit, which only the serial emulator path performs; "
                     "drop mesh= or deferred_batch_norm")
-            if schedule != "gpipe":
-                raise NotImplementedError(
-                    f"schedule={schedule!r} with mesh=: the hetero executor "
-                    "runs the GPipe wavefront; memory-capped 1F1B lives in "
-                    "pipe_tpu.parallel.scheduled (homogeneous stages)")
         if n_stages is None:
             n_stages = 1
         self.balance = split_balance(len(module), n_stages, balance)
@@ -135,7 +137,7 @@ class Pipe:
             for j, part in enumerate(self.partitions)
         ]
         verify_stages(self.stages)
-        self._schedule: Schedule = get_schedule(schedule)
+        self._schedule: Schedule = sched_obj
 
         # Skip-connection wiring: fail-fast verification at init (reference
         # verify_skippables, pipe.py:336) and the static stash->pop layout
@@ -147,14 +149,36 @@ class Pipe:
         # layout pair, so this single flag decides tracker creation.
         self._needs_skip_tracker = self.skip_layout.num_skips > 0
 
-        # mesh= selects the compiled SPMD executor (the reference's flagship
+        # mesh= selects the compiled SPMD executors (the reference's flagship
         # multi-device product: Pipe.__init__ builds the multi-device
-        # Pipeline, pipe.py:344-356; forward runs it, pipe.py:431-494).
+        # Pipeline, pipe.py:344-356; forward runs it, pipe.py:431-494):
+        # * forward (`__call__`): the GPipe-wavefront hetero executor —
+        #   forward has no backward to interleave, so every schedule's
+        #   forward IS the wavefront (v == 1 only; interleaved placements
+        #   have no forward-only executor here);
+        # * training (`loss_and_grad`): the schedule-table executor, giving
+        #   1F1B's min(m, n) activation cap, zb-h1, interleaved-1f1b and the
+        #   exact per-micro-batch checkpoint policy through the flagship API.
         self._executor = None
+        self._train_executor = None
         if mesh is not None:
-            from .parallel.hetero import HeteroSpmdPipeline
-            self._executor = HeteroSpmdPipeline(
-                mesh, self.partitions, self.skip_layout, chunks, checkpoint)
+            if sched_obj.v > 1 and self.skip_layout.num_skips > 0:
+                # would construct with NO usable execution path: v>1 has no
+                # forward executor and skips cannot ride the table executor
+                raise NotImplementedError(
+                    "@skippable models cannot use interleaved schedules on "
+                    "a mesh (no executor supports both); use "
+                    "schedule='gpipe' or '1f1b'")
+            if sched_obj.v == 1:
+                from .parallel.hetero import HeteroSpmdPipeline
+                self._executor = HeteroSpmdPipeline(
+                    mesh, self.partitions, self.skip_layout, chunks,
+                    checkpoint)
+            if self.skip_layout.num_skips == 0 and not deferred_batch_norm:
+                from .parallel.hetero_scheduled import HeteroScheduledPipeline
+                self._train_executor = HeteroScheduledPipeline(
+                    mesh, self.partitions, self.skip_layout, chunks,
+                    checkpoint, sched_obj)
 
     # --- container protocol (reference pipe.py:358-386) ---
 
@@ -218,11 +242,21 @@ class Pipe:
         dict is a plain pytree — differentiate with respect to it, feed it
         to optax — and :meth:`unshard_params` converts either params or
         grads back to per-stage trees."""
+        if self._train_executor is not None:
+            # Row order follows the schedule's placement (device-major when
+            # interleaved); identical to partition order at v == 1, so the
+            # forward executor shares the same pack.
+            packed = self._train_executor.shard_params(params)
+            if self._executor is not None:
+                self._executor.param_pack = self._train_executor.param_pack
+            return packed
         if self._executor is None:
             raise ValueError("shard_params requires Pipe(mesh=...)")
         return self._executor.shard_params(params)
 
     def unshard_params(self, packed):
+        if self._train_executor is not None:
+            return self._train_executor.unshard_params(packed)
         if self._executor is None:
             raise ValueError("unshard_params requires Pipe(mesh=...)")
         return self._executor.unshard_params(packed)
@@ -237,6 +271,37 @@ class Pipe:
         return self.shard_params(
             self.init(key, *example_inputs, _host=True))
 
+    # --- training through the schedule tables (the capability the
+    # reference's fork/join machinery exists for, pipeline.py:128-132) ---
+
+    def loss_and_grad(self, params, *inputs, targets: Any = None,
+                      loss_fn, key: Optional[jax.Array] = None):
+        """One pipelined training step through the configured schedule:
+        ``(loss, packed_grads)``, with 1F1B/zb-h1/interleaved memory caps
+        and the exact per-micro-batch checkpoint policy. ``params`` must be
+        the stage-sharded packed layout (:meth:`shard_params`);
+        ``loss_fn(*outputs, targets_mb) -> [rows]`` is the per-row loss.
+        Works for every schedule incl. ``gpipe`` (which thereby gains the
+        exact ``except_last`` policy the AD wavefront executor approximates
+        statically)."""
+        if self._train_executor is None:
+            if self.mesh is None:
+                raise ValueError("loss_and_grad requires Pipe(mesh=...)")
+            raise NotImplementedError(
+                "loss_and_grad is unavailable for this Pipe: @skippable "
+                "stashes / deferred BatchNorm are not routed through the "
+                "schedule-table executor (use the forward path + jax.grad)")
+        return self._train_executor.loss_and_grad(
+            params, *inputs, targets=targets, loss_fn=loss_fn, key=key)
+
+    def memory_plan(self, chunks: Optional[int] = None) -> dict:
+        """Static per-device buffer counts of the training executor — the
+        activation-memory story (1F1B: ``min(m, n)`` stashed inputs),
+        inspectable from the flagship API."""
+        if self._train_executor is None:
+            raise ValueError("memory_plan requires a mesh= training path")
+        return self._train_executor.memory_plan(chunks)
+
     # --- forward (reference pipe.py:431-494) ---
 
     def __call__(self, params: Sequence[Any], *inputs,
@@ -248,6 +313,11 @@ class Pipe:
         if self._executor is not None:
             return self._executor(params, *inputs, key=key, train=train,
                                   remat_policy=remat_policy)
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "interleaved placements (v > 1) have no forward-only "
+                "executor; use loss_and_grad for training, or an emulator "
+                "Pipe for inference")
         if isinstance(params, dict):
             raise TypeError(
                 "stage-sharded packed params need Pipe(mesh=...); the serial "

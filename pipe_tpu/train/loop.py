@@ -25,6 +25,7 @@ from ..models.transformer_lm import LMConfig, PipelinedLM
 from ..parallel.mesh import make_mesh
 from ..parallel.spmd import SpmdPipeline, stack_stage_params
 from ..data import lm_text
+from ..utils.platform import sync_if_forced_cpu
 from ..utils.rng import make_key
 from .state import TrainState
 
@@ -273,6 +274,10 @@ class Trainer:
             state, loss = self._step_fn(state, x, w,
                                         jax.random.fold_in(key, b),
                                         jnp.float32(lr))
+            # Virtual-CPU platform: serialize steps (see sync_if_forced_cpu —
+            # interleaved async runs livelock the collective rendezvous
+            # there). No-op on real TPU.
+            sync_if_forced_cpu(loss)
             losses.append(loss)
             if b == 0:
                 float(loss)               # sync out the compile

@@ -28,12 +28,42 @@ def force_cpu_platform(num_devices: int = 8) -> None:
     after ``import jax``.
     """
     os.environ.setdefault("PIPE_TPU_FORCED_CPU", "1")
+    # N virtual devices time-share the host cores (often ONE core in CI).
+    # XLA:CPU's collective rendezvous hard-terminates the process when a
+    # participant is >45s late — which a device legitimately is whenever its
+    # pre-collective compute runs serialized behind 7 siblings. Give the
+    # rendezvous real headroom; these flags must be set before backend init.
+    flags = os.environ.get("XLA_FLAGS", "")
+    for flag in ("xla_cpu_collective_timeout_seconds",
+                 "xla_cpu_collective_call_terminate_timeout_seconds"):
+        if flag not in flags:       # never override an operator's setting
+            flags = f"{flags} --{flag}=600".strip()
+    os.environ["XLA_FLAGS"] = flags
     import jax
     from jax._src import xla_bridge as xb
 
     xb._backend_factories.pop("axon", None)
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", num_devices)
+
+
+def sync_if_forced_cpu(x):
+    """Block on ``x`` when running on the forced-CPU virtual platform.
+
+    On N virtual devices time-sharing few host cores, jax's async dispatch
+    lets successive compiled runs interleave; blocked collective-rendezvous
+    waiters from run k+1 can then starve the worker threads run k still
+    needs — a livelock (observed: 7 devices parked in run k+1's first
+    ppermute while run k never finishes on the one remaining thread).
+    Serializing steps with a host sync removes the hazard. On real TPU this
+    is a no-op: async dispatch is exactly what overlaps host and device
+    there, and the rendezvous mechanism does not exist.
+    """
+    if os.environ.get("PIPE_TPU_FORCED_CPU"):
+        import jax
+
+        jax.block_until_ready(x)
+    return x
 
 
 def on_real_tpu() -> bool:
