@@ -195,6 +195,14 @@ class StageParamPack:
                     f"{(self.n, cap)} — params packed by a different "
                     f"Pipe/balance?")
 
+    def replace_stage(self, packed: Dict[str, jax.Array], s: int,
+                      new_tree) -> Dict[str, jax.Array]:
+        """New packed dict with stage ``s``'s row rebuilt from ``new_tree``
+        (traced ops — usable under jit; the other rows alias through)."""
+        leaves = jax.tree_util.tree_leaves(new_tree)
+        row = self.plans[s].pack(leaves, self.capacities)
+        return {dt: packed[dt].at[s].set(row[dt]) for dt in packed}
+
     # -- in-program views (traced) ----------------------------------------
     def unpack_stage(self, local_rows: Dict[str, jax.Array], s: int):
         """Stage ``s``'s param tree from a device's local ``{dtype: [cap]}``

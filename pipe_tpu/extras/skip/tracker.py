@@ -97,10 +97,20 @@ class SkipTracker:
         partial sums accumulate across the whole mini-batch and are read once
         after the schedule drains (reference ``batchnorm.py`` capability,
         ``README.md:549-554``). Gradients are not tracked through stats.
+
+        In ``spec_mode`` the accumulator records ShapeDtypeStructs instead
+        (overwriting, not adding) — the compiled executor uses this to size
+        the per-stage stat lanes before tracing.
         """
         import jax
-        value = jax.tree_util.tree_map(jax.lax.stop_gradient, value)
         key = (ns, name)
+        if self.spec_mode:
+            import jax.numpy as jnp
+            self.accum[key] = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(jnp.shape(v),
+                                               jnp.result_type(v)), value)
+            return
+        value = jax.tree_util.tree_map(jax.lax.stop_gradient, value)
         if key in self.accum:
             self.accum[key] = jax.tree_util.tree_map(
                 lambda a, b: a + b, self.accum[key], value)
@@ -112,9 +122,12 @@ class SkipTracker:
 
 
 def accumulate(ns, name: str, value: Any) -> bool:
-    """Accumulate into the active tracker; False (no-op) outside a run."""
+    """Accumulate into the active tracker; False (no-op) outside a run.
+
+    Spec-mode trackers record shapes (see :meth:`SkipTracker.accumulate`) so
+    executors can size stat lanes; they still return True."""
     scope = _current.get()
-    if scope is None or scope.tracker.spec_mode:
+    if scope is None:
         return False
     scope.tracker.accumulate(ns, name, value)
     return True

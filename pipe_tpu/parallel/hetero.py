@@ -69,6 +69,18 @@ from ..utils.rng import make_key
 __all__ = ["HeteroSpmdPipeline"]
 
 
+def _zeros_of(spec_tree):
+    """Zero arrays from a tree of ShapeDtypeStructs."""
+    return jax.tree_util.tree_map(
+        lambda sp_: jnp.zeros(sp_.shape, sp_.dtype), spec_tree)
+
+
+def _apply_train(part, p, *xs):
+    """Train-mode apply for the stat-lane spec pass (key None ⇒ dropout
+    no-op; only BN's accumulate channel distinguishes it from out_spec)."""
+    return part.apply(p, *xs, ctx=StageCtx(train=True))
+
+
 class HeteroSpmdPipeline:
     """Executor over a ``(stage[, data])`` mesh for Pipe's partitions."""
 
@@ -97,6 +109,13 @@ class HeteroSpmdPipeline:
                     self.lane_keys.append((ns, name, src, dst))
         # Established by shard_params(); None until then (replicated layout).
         self.param_pack: Optional[StageParamPack] = None
+        # Deferred-BN: stat-bearing layers accumulate (sum, sum_sq, count)
+        # per micro-batch; the executor threads those accumulators through
+        # the scan as explicit lanes (reference batchnorm.py capability,
+        # README.md:549-554).
+        from ..extras.norm import DeferredBatchNorm
+        self.has_bn = any(isinstance(l, DeferredBatchNorm)
+                          for part in self.partitions for l in part)
 
     # -----------------------------------------------------------------
     def shard_params(self, params_per_stage: Sequence[Any]):
@@ -195,6 +214,33 @@ class HeteroSpmdPipeline:
         lane_specs = [spec_tracker._store[(0, ns, name)]
                       for ns, name, _, _ in self.lane_keys]
 
+        # Deferred-BN stat lanes: a train-mode spec pass per partition
+        # discovers each stage's accumulator keys and shapes. Reuses the
+        # same spec tracker so skip stash specs resolve; dropout is a no-op
+        # (ctx.key is None), so only the stat channel differs from the
+        # boundary walk above.
+        stat_keys: List[list] = [[] for _ in range(n)]
+        stat_specs: List[list] = [[] for _ in range(n)]
+        collect_stats = self.has_bn and train
+        if collect_stats:
+            if bs % (m * self.n_data):
+                raise ValueError(
+                    f"deferred BatchNorm needs the batch ({bs} rows) to "
+                    f"divide evenly into chunks*data ({m}*{self.n_data}): "
+                    "padded rows would contaminate the batch statistics")
+            with use_skip_tracker(spec_tracker):
+                for jdx, part in enumerate(self.partitions):
+                    seen = set(spec_tracker.accum)
+                    p_j = (self.param_pack.abstract_tree(jdx) if packed
+                           else params[jdx])
+                    jax.eval_shape(
+                        functools.partial(_apply_train, part),
+                        p_j, *boundaries[jdx])
+                    for k_ in spec_tracker.accum:
+                        if k_ not in seen:
+                            stat_keys[jdx].append(k_)
+                            stat_specs[jdx].append(spec_tracker.accum[k_])
+
         # pack plans for boundaries 1..n-1 (stage inputs beyond stage 0)
         plans = [None] + [_PackPlan(boundaries[b]) for b in range(1, n)]
         capacities: dict = {}
@@ -229,29 +275,48 @@ class HeteroSpmdPipeline:
         else:
             p_arg = tuple(params)
             p_spec = jax.tree_util.tree_map(lambda _: P(), p_arg)
+        stat_sp = tuple(
+            tuple(jax.tree_util.tree_map(
+                lambda _: (P(STAGE_AXIS, DATA_AXIS) if self.has_data
+                           else P(STAGE_AXIS)), sp_)
+                for sp_ in stage_specs)
+            for stage_specs in stat_specs)
         run = jax.shard_map(
             functools.partial(
                 self._device_program, m=m, plans=plans,
                 capacities=capacities, lane_specs=lane_specs,
                 out_specs_local=out_specs_local, train=train, keyed=keyed,
                 remat_on=stop > 0, remat_policy=remat_policy,
-                static_vals=static_vals, kinds=kinds, packed=packed),
+                static_vals=static_vals, kinds=kinds, packed=packed,
+                stat_keys=stat_keys, stat_specs=stat_specs),
             mesh=self.mesh,
             in_specs=(p_spec, x_specs, P()),
-            out_specs=out_sp,
+            out_specs=(out_sp, stat_sp),
             check_vma=False)
-        stacked_out = run(p_arg, stacked, key)
+        stacked_out, stats_out = run(p_arg, stacked, key)
         # device n-1's slice holds the real outputs: [n, m, rows...] -> [m, ...]
         outs = tuple(o[-1] for o in stacked_out)
         if mb_rows != true_rows:  # drop data-axis padding before gather
             outs = tuple(o[:, :true_rows] for o in outs)
         gathered = tuple(mb.stack_gather(o, bs) for o in outs)
-        return gathered if len(gathered) > 1 else gathered[0]
+        result = gathered if len(gathered) > 1 else gathered[0]
+        if not collect_stats:
+            return result
+        # Stage s's stats live in row s (zeros elsewhere); data shards sum
+        # HOST-SIDE — no in-program subgroup collective (see scheduled.py's
+        # wsum note for why that matters on the virtual CPU platform).
+        stats: dict = {}
+        for jdx in range(n):
+            for k_, st in zip(stat_keys[jdx], stats_out[jdx]):
+                stats[k_] = jax.tree_util.tree_map(
+                    lambda a: (a[jdx].sum(axis=0) if self.has_data
+                               else a[jdx]), st)
+        return result, stats
 
     # -----------------------------------------------------------------
     def _make_branch(self, s, all_params, train, keyed, remat_on,
                      remat_policy, plans, capacities, out_specs_local,
-                     static_vals, kinds, packed):
+                     static_vals, kinds, packed, stat_keys, stat_specs):
         from ..extras.skip import SkipTracker
 
         n = self.n_stages
@@ -283,7 +348,13 @@ class HeteroSpmdPipeline:
                 with local.scope(0, s), jax.named_scope(f"stage{s}"):
                     out = part.apply(p, *vals, ctx=ctx)
                 stash_vals = [local.load(0, ns, name) for ns, name in stashes]
-                return out, stash_vals
+                # This stage's deferred-BN stat contributions (explicit remat
+                # outputs, like the stashes — stop_gradient'd at source)
+                stat_vals = tuple(
+                    (local.accum[k_] if k_ in local.accum
+                     else _zeros_of(spec))
+                    for k_, spec in zip(stat_keys[s], stat_specs[s]))
+                return out, stash_vals, stat_vals
 
             wrapped = apply_remat(task, enabled=remat_on, policy=remat_policy)
             if packed:
@@ -294,7 +365,7 @@ class HeteroSpmdPipeline:
                     {dt: a[0] for dt, a in all_params.items()}, s)
             else:
                 p_s = all_params[s]
-            out, stash_vals = wrapped(p_s, kij, pop_vals, *vals)
+            out, stash_vals, stat_vals = wrapped(p_s, kij, pop_vals, *vals)
             out_vals = list(out) if isinstance(out, (tuple, list)) else [out]
             lanes2 = list(lanes)
             for idx, v in zip(stash_idx, stash_vals):
@@ -306,21 +377,29 @@ class HeteroSpmdPipeline:
                 out_t = tuple(jnp.zeros(sp.shape, sp.dtype)
                               for sp in out_specs_local)
                 carrier2 = plans[s + 1].pack(out_vals, capacities)
-            return carrier2, tuple(lanes2), out_t
+            # uniform switch-branch structure: this stage's stats in slot s,
+            # zeros for every other stage's slots (tiny trees)
+            stat_t = tuple(
+                stat_vals if s2 == s
+                else tuple(_zeros_of(spec) for spec in stat_specs[s2])
+                for s2 in range(n))
+            return carrier2, tuple(lanes2), out_t, stat_t
 
         return branch
 
     # -----------------------------------------------------------------
     def _device_program(self, all_params, x, key, *, m, plans, capacities,
                         lane_specs, out_specs_local, train, keyed, remat_on,
-                        remat_policy, static_vals, kinds, packed):
+                        remat_policy, static_vals, kinds, packed, stat_keys,
+                        stat_specs):
         n = self.n_stages
         j = jax.lax.axis_index(STAGE_AXIS)
 
         branches = [
             self._make_branch(s, all_params, train, keyed, remat_on,
                               remat_policy, plans, capacities,
-                              out_specs_local, static_vals, kinds, packed)
+                              out_specs_local, static_vals, kinds, packed,
+                              stat_keys, stat_specs)
             for s in range(n)]
 
         carrier0 = {dt: jnp.zeros((cap,), dtype=np.dtype(dt))
@@ -328,6 +407,9 @@ class HeteroSpmdPipeline:
         lanes0 = tuple(jnp.zeros(sp.shape, sp.dtype) for sp in lane_specs)
         outbuf0 = tuple(jnp.zeros((m + 1,) + tuple(sp.shape), sp.dtype)
                         for sp in out_specs_local)
+        bn_acc0 = tuple(
+            tuple(_zeros_of(spec) for spec in stage_specs)
+            for stage_specs in stat_specs)
         fwd_perm = [(k, k + 1) for k in range(n - 1)]
 
         def index_x(t):
@@ -336,17 +418,23 @@ class HeteroSpmdPipeline:
                     l, t, 0, keepdims=False), x)
 
         def cycle(carry, t):
-            carrier, lanes, outbuf = carry
+            carrier, lanes, outbuf, bn_acc = carry
             i = t - j
             x_t = index_x(jnp.clip(t, 0, m - 1))
             kij = jax.random.fold_in(jax.random.fold_in(key, i), j)
-            carrier2, lanes2, out_t = jax.lax.switch(
+            carrier2, lanes2, out_t, stat_t = jax.lax.switch(
                 j, branches, x_t, carrier, lanes, kij)
             valid = (j == n - 1) & (i >= 0) & (i < m)
             widx = jnp.where(valid, jnp.clip(i, 0, m - 1), m)
             outbuf = tuple(
                 jax.lax.dynamic_update_index_in_dim(buf, o, widx, 0)
                 for buf, o in zip(outbuf, out_t))
+            # BN stats only from cycles where this device computes a REAL
+            # micro-batch — fill/drain cycles run the branch on garbage
+            # (zero carriers), whose statistics must not leak in.
+            valid_c = (i >= 0) & (i < m)
+            bn_acc = jax.tree_util.tree_map(
+                lambda a, c: a + jnp.where(valid_c, c, 0), bn_acc, stat_t)
             if n > 1:
                 carrier2 = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm),
@@ -354,9 +442,14 @@ class HeteroSpmdPipeline:
                 lanes2 = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm),
                     lanes2)
-            return (carrier2, lanes2, outbuf), None
+            return (carrier2, lanes2, outbuf, bn_acc), None
 
-        (carrier, lanes, outbuf), _ = jax.lax.scan(
-            cycle, (carrier0, lanes0, outbuf0), jnp.arange(m + n - 1))
-        # drop the garbage slot; stack under a stage axis for out_specs
-        return tuple(b[None, :m] for b in outbuf)
+        (carrier, lanes, outbuf, bn_acc), _ = jax.lax.scan(
+            cycle, (carrier0, lanes0, outbuf0, bn_acc0),
+            jnp.arange(m + n - 1))
+        # drop the garbage slot; stack under a stage axis for out_specs;
+        # stats gain leading (stage[, data]) axes for host-side reduction
+        lead = ((lambda l: l[None, None]) if self.has_data
+                else (lambda l: l[None]))
+        stats_out = jax.tree_util.tree_map(lead, bn_acc)
+        return tuple(b[None, :m] for b in outbuf), stats_out

@@ -113,11 +113,10 @@ class Pipe:
                     f"n_stages={n_stages} does not match the mesh's "
                     f"{mesh_stages}-device stage axis for schedule "
                     f"{sched_obj.name!r} (needs v*d = {expected})")
-            if deferred_batch_norm:
+            if deferred_batch_norm and sched_obj.name != "gpipe":
                 raise NotImplementedError(
-                    "deferred_batch_norm requires the whole-minibatch stat "
-                    "commit, which only the serial emulator path performs; "
-                    "drop mesh= or deferred_batch_norm")
+                    "deferred_batch_norm through mesh= rides the GPipe "
+                    "wavefront executor (stat lanes); pick schedule='gpipe'")
         if n_stages is None:
             n_stages = 1
         self.balance = split_balance(len(module), n_stages, balance)
@@ -311,8 +310,12 @@ class Pipe:
         from .extras.norm import DeferredBatchNorm, commit_batchnorm_stats
 
         if self._executor is not None:
-            return self._executor(params, *inputs, key=key, train=train,
-                                  remat_policy=remat_policy)
+            res = self._executor(params, *inputs, key=key, train=train,
+                                 remat_policy=remat_policy)
+            if self._executor.has_bn and train:
+                out, stats = res
+                return out, self._commit_bn_mesh(params, stats)
+            return res
         if self.mesh is not None:
             raise NotImplementedError(
                 "interleaved placements (v > 1) have no forward-only "
@@ -346,3 +349,37 @@ class Pipe:
         return out
 
     forward = __call__
+
+    def _commit_bn_mesh(self, params, stats: dict):
+        """One running-stats momentum update per mini-batch from the
+        executor's accumulated stat lanes (reference ``batchnorm.py``
+        capability, ``pipe.py:341-342``): pipelined BN running stats equal
+        the unpipelined model's. Works on per-stage trees or the packed
+        stage-sharded layout (row rebuild via the pack plans); traced ops,
+        so it composes with jit."""
+        from .extras.norm import (DeferredBatchNorm, _STATS,
+                                  commit_batchnorm_stats)
+
+        class _StatsShim:   # tracker-shaped view over the executor's stats
+            accum = stats
+
+        if not isinstance(params, dict):
+            return commit_batchnorm_stats(self.partitions, list(params),
+                                          _StatsShim)
+        pack = self._executor.param_pack
+        new_params = params
+        for j, part in enumerate(self.partitions):
+            tree_j = None
+            for i, layer in enumerate(part):
+                if not isinstance(layer, DeferredBatchNorm):
+                    continue
+                st = stats.get((layer.ns, _STATS))
+                if st is None:
+                    continue
+                if tree_j is None:
+                    tree_j = pack.unpack_stage(
+                        {dt: a[j] for dt, a in params.items()}, j)
+                tree_j[i] = layer.commit(tree_j[i], st)
+            if tree_j is not None:
+                new_params = pack.replace_stage(new_params, j, tree_j)
+        return new_params

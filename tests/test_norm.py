@@ -109,3 +109,129 @@ def test_momentum_accumulates_over_steps():
     m2 = np.asarray(p2[0][0]["mean"])
     np.testing.assert_allclose(m1, 0.2, atol=1e-6)        # 0.9*0 + 0.1*2
     np.testing.assert_allclose(m2, 0.38, atol=1e-6)       # 0.9*0.2 + 0.1*2
+
+
+# ---------- deferred BN through the compiled mesh path (VERDICT r2 #4) ----
+
+def _stage_mesh(n_stages, n_data=1):
+    from pipe_tpu.parallel.mesh import make_mesh
+    return make_mesh(n_stages, n_data,
+                     devices=jax.devices()[:n_stages * n_data])
+
+
+@pytest.mark.parametrize("checkpoint", ["never", "always"])
+def test_mesh_running_stats_match_emulator(checkpoint):
+    """Pipelined BN stats through Pipe(mesh=) == the serial emulator's ==
+    the whole-batch update (reference pipe.py:341-342 converts BN and runs
+    it on the multi-device pipeline)."""
+    module = Sequential([Linear(6), BatchNorm()])
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    mesh_pipe = Pipe(module, chunks=4, checkpoint=checkpoint,
+                     mesh=_stage_mesh(2), deferred_batch_norm=True)
+    emu_pipe = Pipe(module, chunks=4, checkpoint=checkpoint, n_stages=2,
+                    deferred_batch_norm=True)
+    params = mesh_pipe.init(jax.random.key(0), x)
+
+    out_m, new_m = mesh_pipe(params, x, train=True, key=jax.random.key(2))
+    out_e, new_e = emu_pipe(params, x, train=True, key=jax.random.key(2))
+    np.testing.assert_allclose(np.asarray(out_m), np.asarray(out_e),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(new_m),
+                    jax.tree_util.tree_leaves(new_e)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    h = module[0].apply(params[0][0], x)
+    exp_mean, exp_var = whole_batch_reference_stats(h)
+    got = new_m[1][0]
+    np.testing.assert_allclose(np.asarray(got["mean"]), exp_mean,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["var"]), exp_var,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_mesh_bn_packed_params_commit():
+    """Stage-sharded packed params: the commit rebuilds only BN stages'
+    rows; round-trip shows the updated running stats."""
+    module = Sequential([Linear(6), BatchNorm()])
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    pipe = Pipe(module, chunks=2, checkpoint="never", mesh=_stage_mesh(2),
+                deferred_batch_norm=True)
+    params = pipe.init(jax.random.key(0), x)
+    packed = pipe.shard_params(params)
+
+    out, new_packed = pipe(packed, x, train=True)
+    emu = Pipe(module, chunks=2, checkpoint="never", n_stages=2,
+               deferred_batch_norm=True)
+    _, new_e = emu(params, x, train=True)
+    new_trees = pipe.unshard_params(new_packed)
+    for a, b in zip(jax.tree_util.tree_leaves(new_trees),
+                    jax.tree_util.tree_leaves(new_e)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_mesh_bn_with_data_axis():
+    """PP x DP: per-shard partial sums reduce host-side; committed stats
+    are the exact whole-mini-batch statistics."""
+    module = Sequential([Linear(6), BatchNorm()])
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    mesh_pipe = Pipe(module, chunks=2, checkpoint="never",
+                     mesh=_stage_mesh(2, n_data=2),
+                     deferred_batch_norm=True)
+    emu_pipe = Pipe(module, chunks=2, checkpoint="never", n_stages=2,
+                    deferred_batch_norm=True)
+    params = mesh_pipe.init(jax.random.key(0), x)
+    _, new_m = mesh_pipe(params, x, train=True)
+    _, new_e = emu_pipe(params, x, train=True)
+    got, exp = new_m[1][0], new_e[1][0]
+    np.testing.assert_allclose(np.asarray(got["mean"]),
+                               np.asarray(exp["mean"]), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["var"]),
+                               np.asarray(exp["var"]), rtol=1e-5, atol=1e-6)
+
+
+def test_mesh_bn_rejects_padded_rows():
+    """Zero-padded rows would contaminate batch statistics: fail fast."""
+    module = Sequential([Linear(6), BatchNorm()])
+    pipe = Pipe(module, chunks=4, checkpoint="never", mesh=_stage_mesh(2),
+                deferred_batch_norm=True)
+    x = jax.random.normal(jax.random.key(1), (7, 6))  # 7 % 4 != 0
+    params = pipe.init(jax.random.key(0), jnp.zeros((8, 6)))
+    with pytest.raises(ValueError):
+        pipe(params, x, train=True)
+
+
+def test_mesh_bn_non_gpipe_schedule_rejected():
+    module = Sequential([Linear(6), BatchNorm()])
+    with pytest.raises(NotImplementedError):
+        Pipe(module, chunks=2, mesh=_stage_mesh(2),
+             deferred_batch_norm=True, schedule="1f1b")
+
+
+@pytest.mark.parametrize("checkpoint", ["never", "always"])
+def test_mesh_bn_training_grads_match_emulator(checkpoint):
+    """jax.grad through the mesh BN forward — the supported training route
+    for deferred-BN models on a mesh — matches the emulator."""
+    module = Sequential([Linear(6), BatchNorm(), Lambda(jax.nn.relu),
+                         Linear(1)])
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    mesh_pipe = Pipe(module, chunks=4, checkpoint=checkpoint,
+                     mesh=_stage_mesh(2), deferred_batch_norm=True)
+    emu_pipe = Pipe(module, chunks=4, checkpoint=checkpoint, n_stages=2,
+                    deferred_batch_norm=True)
+    params = mesh_pipe.init(jax.random.key(0), x)
+
+    def loss_mesh(p):
+        out, _ = mesh_pipe(p, x, train=True)
+        return jnp.mean(out ** 2)
+
+    def loss_emu(p):
+        out, _ = emu_pipe(p, x, train=True)
+        return jnp.mean(out ** 2)
+
+    gm = jax.grad(loss_mesh)(params)
+    ge = jax.grad(loss_emu)(params)
+    for a, b in zip(jax.tree_util.tree_leaves(gm),
+                    jax.tree_util.tree_leaves(ge)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
