@@ -55,6 +55,12 @@ class StageCtx:
     train: bool = False
     microbatch: int = 0
     stage: int = 0
+    # Name of a bound data-parallel mesh axis when the body runs inside a
+    # data-sharded device program (shard_map), else None. Batch-statistics
+    # layers (BatchNorm) psum over it so a data-sharded micro-batch
+    # normalizes by the SAME whole-micro-batch statistics as the unsharded
+    # run — the SPMD promise that mesh factorization never changes the math.
+    data_axis: Optional[str] = None
 
     def fold(self, *data: int) -> "StageCtx":
         """Derive a ctx with a key folded over the given integers."""

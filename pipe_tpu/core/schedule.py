@@ -120,6 +120,14 @@ class Schedule:
         return 0
 
     @property
+    def splits_backward(self) -> bool:
+        """True when op tables carry separate B (input-grad) and W
+        (weight-grad) ops — the zero-bubble lineage. Executors consult this
+        to shape carries and to warn on checkpoint modes that defeat the
+        split (see :class:`ZeroBubbleSchedule`'s executor note)."""
+        return False
+
+    @property
     def v(self) -> int:
         """Interleave depth: virtual stages per device (1 = not interleaved)."""
         return 1
@@ -441,8 +449,10 @@ class ZeroBubbleSchedule(Schedule):
     weight-gradient only — depends only on its own B, so it can be deferred
     into slots that would otherwise idle during fill and drain). With
     roughly equal F/B/W op costs the drain bubble fills completely: e.g.
-    (m=8, n=4) per-op-slot bubble drops from 33% (1F1B counting B+W as two
-    units in one slot) to ~8%.
+    (m=8, n=4) per-op-slot bubble drops from 27.3% (1F1B counting B+W as
+    two units in one slot) to 11.1% — ~2.4x less idle (the exact figures
+    ``bubble()`` reports and ``test_zb_tables_verify_and_beat_1f1b_bubble``
+    pins).
 
     Memory matches 1F1B's activation cap in steady state, plus the deferred
     window: stashed stage inputs live until their W (not their B) consumes
@@ -452,11 +462,14 @@ class ZeroBubbleSchedule(Schedule):
     Executor note (``parallel.scheduled``): with ``checkpoint='never'`` the
     stored vjp closure serves both B and W — XLA's dead-code elimination
     prunes the weight-grad matmuls from the B call and the input-grad
-    matmuls from the W call, so total compute equals one combined backward.
-    Recompute modes re-run the forward at BOTH B and W on the dynamic
-    (multi-device) path — the d=1 static specialization computes the vjp
-    once at B and defers only the accumulation; zero-bubble scheduling is
-    designed for (and shines with) stored activations.
+    matmuls from the W call, so total compute equals one combined backward
+    split across two schedulable slots. Under recompute modes the vjp only
+    exists once the forward has been re-run at B, so the executor computes
+    the FULL backward there and the W slots carry no compute (d>1 dynamic
+    path; the d=1 static path defers just the accumulation) — correct and
+    recompute-once, but the bubble-filling premise is gone, and
+    construction warns. Zero-bubble scheduling is designed for (and shines
+    with) stored activations: pair with ``checkpoint='never'``.
 
     Measurement honesty: the win is the table's idle fraction, which pays
     off when per-cycle time is compute-dominated (real multi-chip). On the
@@ -467,6 +480,10 @@ class ZeroBubbleSchedule(Schedule):
     """
 
     name: str = "zb-h1"
+
+    @property
+    def splits_backward(self) -> bool:
+        return True
 
     def cycles(self, m: int, n: int) -> List[List[Tuple[int, int]]]:
         raise NotImplementedError(

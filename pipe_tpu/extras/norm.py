@@ -63,12 +63,31 @@ class BatchNorm(Module):
         y = (x - mean) * jax.lax.rsqrt(var + self.eps)
         return y * params["scale"] + params["bias"]
 
+    def _batch_stats(self, x, ctx: StageCtx):
+        """Micro-batch (mean, var) — psum'd over a bound data axis so a
+        data-sharded micro-batch normalizes by the same whole-micro-batch
+        statistics as the unsharded run (mesh factorization must not change
+        the math; torch has no DP composition here to mirror, reference
+        ``pipe.py:290-293``)."""
+        axes = tuple(range(x.ndim - 1))
+        if ctx.data_axis is None:
+            return jnp.mean(x, axis=axes), jnp.var(x, axis=axes)
+        n = 1
+        for a in axes:
+            n *= x.shape[a]
+        n_tot = n * jax.lax.psum(1, ctx.data_axis)
+        mean = jax.lax.psum(jnp.sum(x, axis=axes), ctx.data_axis) / n_tot
+        # centered two-pass variance (one extra psum) — same numerical
+        # stability as jnp.var, so size-1 data axes are bit-comparable to
+        # the unsharded path within float tolerance
+        var = jax.lax.psum(jnp.sum(jnp.square(x - mean), axis=axes),
+                           ctx.data_axis) / n_tot
+        return mean, var
+
     def apply(self, params, x, ctx: StageCtx = StageCtx()):
         if not ctx.train:
             return self._normalize(params, x, params["mean"], params["var"])
-        axes = tuple(range(x.ndim - 1))
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        mean, var = self._batch_stats(x, ctx)
         return self._normalize(params, x, mean, var)
 
 
@@ -92,16 +111,19 @@ class DeferredBatchNorm(BatchNorm):
         if not ctx.train:
             return self._normalize(params, x, params["mean"], params["var"])
         axes = tuple(range(x.ndim - 1))
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
         n = 1
         for a in axes:
             n *= x.shape[a]
+        # Accumulate SHARD-LOCAL partial sums: the executor's host-side
+        # cross-shard reduction owns the data-axis sum for the running
+        # stats (a second psum here would double-count by n_data).
         accumulate(self.ns, _STATS, {
             "sum": jnp.sum(x, axis=axes),
             "sum_sq": jnp.sum(jnp.square(x), axis=axes),
             "count": jnp.asarray(n, jnp.float32),
         })
+        # Normalize by whole-micro-batch statistics (psum'd if sharded).
+        mean, var = self._batch_stats(x, ctx)
         return self._normalize(params, x, mean, var)
 
     def commit(self, params, stats) -> Any:

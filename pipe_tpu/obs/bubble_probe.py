@@ -20,7 +20,9 @@ import time
 
 
 def main(n_stages: int = 4, chunks: int = 8,
-         compare_schedules: bool = False) -> dict:
+         compare_schedules: bool = False, d_model: int = 256,
+         d_ff: int = 512, seq_len: int = 64, skip_slope: bool = False,
+         iters: int = 4) -> dict:
     from pipe_tpu.utils.platform import force_cpu_platform
     force_cpu_platform(8)
 
@@ -34,8 +36,8 @@ def main(n_stages: int = 4, chunks: int = 8,
     from pipe_tpu.parallel.mesh import make_mesh
     from pipe_tpu.parallel.spmd import SpmdPipeline, stack_stage_params
 
-    cfg = LMConfig(vocab=512, d_model=256, nhead=4, d_ff=512,
-                   n_layers=n_stages, seq_len=64, dropout=0.0)
+    cfg = LMConfig(vocab=512, d_model=d_model, nhead=4, d_ff=d_ff,
+                   n_layers=n_stages, seq_len=seq_len, dropout=0.0)
     mesh = make_mesh(n_stages, 1, devices=jax.devices()[:n_stages])
     model = PipelinedLM(cfg, n_stages)
     sp, prep, postp = model.init(jax.random.key(0))
@@ -55,7 +57,7 @@ def main(n_stages: int = 4, chunks: int = 8,
         return mb.stack_scatter(
             {"tokens": tokens, "targets": jnp.roll(tokens, -1, -1)}, m)
 
-    def step_time(m: int, iters: int = 8) -> float:
+    def step_time(m: int, iters: int = iters) -> float:
         x, _ = make_batch(m)
 
         @jax.jit
@@ -73,16 +75,21 @@ def main(n_stages: int = 4, chunks: int = 8,
         return (time.perf_counter() - t0) / iters
 
     m = chunks
-    t_m, t_2m = step_time(m), step_time(2 * m)
     out = {
         "platform": "cpu8",
         "n_stages": n_stages,
         "chunks": m,
-        "t_m_sec": round(t_m, 5),
-        "t_2m_sec": round(t_2m, 5),
-        "measured_bubble": round(measured_bubble_slope(t_m, t_2m, m), 4),
+        "d_model": d_model,
         "analytic_bubble": round(bubble_fraction(m, n_stages), 4),
     }
+    if not skip_slope:
+        t_m, t_2m = step_time(m), step_time(2 * m)
+        out.update({
+            "t_m_sec": round(t_m, 5),
+            "t_2m_sec": round(t_2m, 5),
+            "measured_bubble": round(
+                measured_bubble_slope(t_m, t_2m, m), 4),
+        })
 
     if compare_schedules:
         # Head-to-head step timings of the table executor per schedule at
@@ -106,11 +113,11 @@ def main(n_stages: int = 4, chunks: int = 8,
                 sp, prep, postp, x, w))
             jax.block_until_ready(lg(sp))
             t0 = time.perf_counter()
-            for _ in range(4):
+            for _ in range(iters):
                 out_lg = lg(sp)
             jax.block_until_ready(out_lg)
             scheds[name] = {
-                "sec_per_step": round((time.perf_counter() - t0) / 4, 5),
+                "sec_per_step": round((time.perf_counter() - t0) / iters, 5),
                 # __post_init__ already built the Schedule; reuse it
                 "analytic_bubble": round(
                     pipe.schedule.bubble(m, n_stages), 4),
@@ -122,7 +129,18 @@ def main(n_stages: int = 4, chunks: int = 8,
 if __name__ == "__main__":
     args = sys.argv[1:]
     cmp_scheds = "--schedules" in args
-    pos = [a for a in args if a != "--schedules"]
+    skip_slope = "--no-slope" in args
+    kw = {}
+    pos = []
+    for a in args:
+        if a in ("--schedules", "--no-slope"):
+            continue
+        if "=" in a and a.startswith("--"):
+            k, v = a[2:].split("=", 1)
+            kw[k.replace("-", "_")] = int(v)
+        else:
+            pos.append(a)
     n = int(pos[0]) if len(pos) > 0 else 4
     m = int(pos[1]) if len(pos) > 1 else 8
-    print(json.dumps(main(n, m, compare_schedules=cmp_scheds)))
+    print(json.dumps(main(n, m, compare_schedules=cmp_scheds,
+                          skip_slope=skip_slope, **kw)))

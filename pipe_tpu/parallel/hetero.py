@@ -113,9 +113,14 @@ class HeteroSpmdPipeline:
         # per micro-batch; the executor threads those accumulators through
         # the scan as explicit lanes (reference batchnorm.py capability,
         # README.md:549-554).
-        from ..extras.norm import DeferredBatchNorm
+        from ..extras.norm import BatchNorm, DeferredBatchNorm
         self.has_bn = any(isinstance(l, DeferredBatchNorm)
                           for part in self.partitions for l in part)
+        # Any batch-statistics layer (plain OR deferred BN) makes padded
+        # rows unacceptable in train mode: fake zero rows would enter the
+        # normalization statistics.
+        self.has_batch_stats = any(isinstance(l, BatchNorm)
+                                   for part in self.partitions for l in part)
 
     # -----------------------------------------------------------------
     def shard_params(self, params_per_stage: Sequence[Any]):
@@ -222,12 +227,12 @@ class HeteroSpmdPipeline:
         stat_keys: List[list] = [[] for _ in range(n)]
         stat_specs: List[list] = [[] for _ in range(n)]
         collect_stats = self.has_bn and train
+        if self.has_batch_stats and train and bs % (m * self.n_data):
+            raise ValueError(
+                f"BatchNorm needs the batch ({bs} rows) to divide evenly "
+                f"into chunks*data ({m}*{self.n_data}): padded rows would "
+                "contaminate the batch statistics")
         if collect_stats:
-            if bs % (m * self.n_data):
-                raise ValueError(
-                    f"deferred BatchNorm needs the batch ({bs} rows) to "
-                    f"divide evenly into chunks*data ({m}*{self.n_data}): "
-                    "padded rows would contaminate the batch statistics")
             with use_skip_tracker(spec_tracker):
                 for jdx, part in enumerate(self.partitions):
                     seen = set(spec_tracker.accum)
@@ -344,7 +349,10 @@ class HeteroSpmdPipeline:
                 local = SkipTracker(self.layout)
                 for (ns, name), v in zip(pops, pop_vals):
                     local.save(0, ns, name, v)
-                ctx = StageCtx(key=k if keyed else None, train=train)
+                ctx = StageCtx(key=k if keyed else None, train=train,
+                               data_axis=DATA_AXIS
+                               if self.has_data and self.n_data > 1
+                               else None)
                 with local.scope(0, s), jax.named_scope(f"stage{s}"):
                     out = part.apply(p, *vals, ctx=ctx)
                 stash_vals = [local.load(0, ns, name) for ns, name in stashes]

@@ -88,6 +88,9 @@ class InterleavedSpmdPipeline:
             raise ValueError("interleave depth v must be >= 1")
         self.n_devices = self.mesh.shape[STAGE_AXIS]
         self.has_data_axis = DATA_AXIS in self.mesh.axis_names
+        # see spmd.SpmdPipeline.bn_axis
+        self.bn_axis = (DATA_AXIS if self.has_data_axis
+                        and self.mesh.shape[DATA_AXIS] > 1 else None)
         self._pre = self.pre_fn or (lambda p, x, ctx: x)
         if self.post_fn is None:
             self._post = lambda p, h, x_mb, ctx: h
@@ -197,7 +200,8 @@ class InterleavedSpmdPipeline:
 
         def body(params_g, k, h):
             return self.stage_fn(params_g, h,
-                                 StageCtx(key=k, train=train))
+                                 StageCtx(key=k, train=train,
+                                          data_axis=self.bn_axis))
 
         if stop > 0:
             body = jax.checkpoint(body, policy=self.remat_policy) \
@@ -218,7 +222,8 @@ class InterleavedSpmdPipeline:
                 (s == 0) & active,
                 lambda: self._pre(pre_params, x_i,
                                   StageCtx(key=jax.random.fold_in(ckey, 0),
-                                           train=train)),
+                                           train=train,
+                                           data_axis=self.bn_axis)),
                 lambda: idx_tree(buf, i))
 
             params_g = idx_tree(stage_params, g)
@@ -229,7 +234,8 @@ class InterleavedSpmdPipeline:
                 emit,
                 lambda: self._post(post_params, out, x_i,
                                    StageCtx(key=jax.random.fold_in(ckey, 2),
-                                            train=train)),
+                                            train=train,
+                                            data_axis=self.bn_axis)),
                 lambda: jax.tree_util.tree_map(zeros, out_spec))
             outbuf = set_tree(outbuf, i, post_val, emit)
 

@@ -76,6 +76,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from typing import Any, Callable, Optional
 
 import jax
@@ -157,9 +158,22 @@ class ScheduledPipeline:
                 raise ValueError(
                     f"schedule {self.schedule!r} has no op_tables")
         self.n_stages = self.mesh.shape[STAGE_AXIS]      # devices d
+        if (getattr(self.schedule, "splits_backward", False)
+                and self.checkpoint != "never"):
+            warnings.warn(
+                f"schedule {self.schedule.name!r} splits backward into B/W "
+                f"ops to fill bubble slots with weight-grad compute, but "
+                f"checkpoint={self.checkpoint!r} recomputes the forward at "
+                f"B and the full backward runs there — the W slots carry no "
+                f"compute and the zero-bubble advantage is lost. Pair "
+                f"zero-bubble schedules with checkpoint='never'.",
+                stacklevel=2)
         self.v = self.schedule.v
         self.n_virtual = self.v * self.n_stages
         self.has_data_axis = DATA_AXIS in self.mesh.axis_names
+        # see spmd.SpmdPipeline.bn_axis
+        self.bn_axis = (DATA_AXIS if self.has_data_axis
+                        and self.mesh.shape[DATA_AXIS] > 1 else None)
         if self.context_axis and self.context_axis not in self.mesh.axis_names:
             raise ValueError(
                 f"mesh has no {self.context_axis!r} axis for context_axis")
@@ -169,7 +183,11 @@ class ScheduledPipeline:
         """Static per-device buffer counts — the memory story, inspectable."""
         d, v = self.n_stages, self.v
         Sg = self.schedule.stash_slots(m, d)
-        Wg = self.schedule.wstash_slots(m, d)
+        # The B->W cotangent park exists only under stored residuals; in
+        # recompute modes split-backward tables run the full backward at B
+        # and the W slots park nothing (see _device_program).
+        Wg = (self.schedule.wstash_slots(m, d)
+              if self.checkpoint == "never" else 0)
         R = {"always": 0, "except_last": v,
              "never": v * Sg}[self.checkpoint]
         return {"cycles": self._cycles(m), "stash_slots": v * Sg,
@@ -259,14 +277,16 @@ class ScheduledPipeline:
             s == 0,
             lambda: self.pre_fn(prep, x_mb,
                                 StageCtx(key=jax.random.fold_in(kis, 0),
-                                         train=train)),
+                                         train=train,
+                                         data_axis=self.bn_axis)),
             lambda: h_in)
         # ctx.stage carries the VIRTUAL stage index (traced on the d>1 path,
         # a Python int on the d=1 static path) so heterogeneous adapters can
         # switch their per-stage bodies on it (parallel.hetero_scheduled).
         return self.stage_fn(params_g, h0,
                              StageCtx(key=jax.random.fold_in(kis, 1),
-                                      train=train, stage=s))
+                                      train=train, stage=s,
+                                      data_axis=self.bn_axis))
 
     def _post_contrib(self, postp, h1, x_mb, w_mb, kis):
         """UNNORMALIZED loss contribution ``sum(w * per_row)`` of one
@@ -275,7 +295,8 @@ class ScheduledPipeline:
         return jnp.sum(
             w_mb * self.post_fn(postp, h1, x_mb,
                                 StageCtx(key=jax.random.fold_in(kis, 2),
-                                         train=True))
+                                         train=True,
+                                         data_axis=self.bn_axis))
         ).astype(jnp.float32)
 
     def _vjp_wrt(self, params_g, prep, h_in, x_mb, kis, s):
@@ -493,7 +514,13 @@ class ScheduledPipeline:
         # parked cotangent for the weight grads. Static: shapes the carry
         # and the branch list.
         has_w = bool((op_np == WGRAD).any())
-        Wg = self.schedule.wstash_slots(m, d) if has_w else 0
+        # Stored-residual mode: the one stored vjp serves both halves (XLA
+        # DCE prunes weight-grad matmuls from B and input-grad matmuls from
+        # W), so B parks its cotangent for W. Recompute modes: the vjp only
+        # exists once the forward re-runs at B, so the FULL backward
+        # accumulates there and W is a no-op — recompute-once, no park.
+        split_dce = has_w and mode == "never"
+        Wg = self.schedule.wstash_slots(m, d) if split_dce else 0
 
         # --- carry -------------------------------------------------------
         def zeros_of(spec):
@@ -524,7 +551,8 @@ class ScheduledPipeline:
             lambda s_: exact_slots_of(s_, Sg), h_spec)
         # Deferred-W cotangent park (B -> W window), activation-sized slots.
         wstash = (jax.tree_util.tree_map(
-            lambda s_: exact_slots_of(s_, v * Wg), h_spec) if has_w else ())
+            lambda s_: exact_slots_of(s_, v * Wg), h_spec)
+            if split_dce else ())
         n_res = self.memory_plan(m)["residual_slots"]
         res_store = ([exact_slots_of(s_, n_res) for s_ in res_specs]
                      if mode != "always" else [])
@@ -682,20 +710,28 @@ class ScheduledPipeline:
 
                 gp, gpre, gh = apply_vjp(seed_h)
                 add = functools.partial(jax.tree_util.tree_map, jnp.add)
-                if has_w:
-                    # split backward: B emits only the input grad (XLA DCE
-                    # prunes the unused weight-grad matmuls from the stored-
-                    # residual call); the cotangent parks for the W op.
+                if split_dce:
+                    # split backward, stored residuals: B emits only the
+                    # input grad (XLA DCE prunes the unused weight-grad
+                    # matmuls from the stored-residual call); the cotangent
+                    # parks for the W op.
                     new_wstash = jax.tree_util.tree_map(
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, g * Wg + i % Wg, 0), wstash, seed_h)
                     return (h_last, new_wstash, res_store, g_sp, g_pre,
                             add(g_post, gpost), loss, h_ring, gh)
+                # combined backward (non-split tables), or a split table
+                # under a recompute mode — the vjp was just built from the
+                # single forward recompute, so weight grads accumulate here
+                # and the table's W slot (if any) is a no-op.
                 return (h_last, wstash, res_store, scatter_gp(g_sp, gp),
                         add(g_pre, gpre), add(g_post, gpost), loss,
                         h_ring, gh)
 
             def wgrad_branch():
+                if not split_dce:
+                    # recompute modes: full backward already ran at B.
+                    return idle_branch()
                 seed_h = jax.tree_util.tree_map(
                     lambda st: jax.lax.dynamic_index_in_dim(
                         st, g * Wg + i % Wg, 0, keepdims=False), wstash)

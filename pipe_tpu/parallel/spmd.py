@@ -133,6 +133,11 @@ class SpmdPipeline:
                 "silently return one context shard's activations")
         self.n_stages = self.mesh.shape[STAGE_AXIS]
         self.has_data_axis = DATA_AXIS in self.mesh.axis_names
+        # Bound data axis for batch-statistics layers (BatchNorm psums its
+        # normalization stats over it — mesh factorization must not change
+        # the math); None when absent or size 1.
+        self.bn_axis = (DATA_AXIS if self.has_data_axis
+                        and self.mesh.shape[DATA_AXIS] > 1 else None)
         self._pre = self.pre_fn or _identity
         if self.post_fn is None:
             self._post = lambda p, h, x_mb, ctx: h
@@ -282,7 +287,8 @@ class SpmdPipeline:
                     l, idx, 0, keepdims=False), x_fill)
 
         def body(p, k, h):
-            return self.stage_fn(p, h, StageCtx(key=k, train=train))
+            return self.stage_fn(p, h, StageCtx(key=k, train=train,
+                                                data_axis=self.bn_axis))
 
         if stop > 0:
             # remat'd when the mode asks for any remat at all (static
@@ -291,7 +297,9 @@ class SpmdPipeline:
                 if self.remat_policy is not None else jax.checkpoint(body)
 
         def post_body(p, h, x_mb, k):
-            return self._post(p, h, x_mb, StageCtx(key=k, train=train))
+            return self._post(p, h, x_mb,
+                              StageCtx(key=k, train=train,
+                                       data_axis=self.bn_axis))
 
         # see remat_post field docstring: drop the [rows, seq, vocab]-scale
         # loss residuals, recompute the decode at backward time
@@ -308,7 +316,7 @@ class SpmdPipeline:
             ctx_key = jax.random.fold_in(jax.random.fold_in(key, t), 0)
             h = self._pre(pre_params, x_t,
                           StageCtx(key=jax.random.fold_in(ctx_key, 0),
-                                   train=train))
+                                   train=train, data_axis=self.bn_axis))
             h = body(params_j, jax.random.fold_in(ctx_key, 1), h)
             out_t = post_fn(post_params, h, x_t,
                             jax.random.fold_in(ctx_key, 2))
@@ -327,7 +335,8 @@ class SpmdPipeline:
                 lambda: self._pre(pre_params,
                                   x_t,
                                   StageCtx(key=jax.random.fold_in(ctx_key, 0),
-                                           train=train)),
+                                           train=train,
+                                           data_axis=self.bn_axis)),
                 lambda: h)
 
             h = body(params_j, jax.random.fold_in(ctx_key, 1), h)

@@ -196,9 +196,17 @@ def test_zb_h1_trainer(corpus):
     and its first-step loss matches the 1f1b trainer bitwise (same math,
     same key scheme — only the op order differs)."""
     source, _ = corpus
-    trainer, model_cfg, _ = tiny_trainer(schedule="zb-h1")
+    with pytest.warns(UserWarning, match="checkpoint='never'"):
+        # default checkpoint is a recompute mode: construction warns that
+        # the W slots carry no compute, and no cotangent park is allocated
+        # (the full backward runs at B).
+        trainer, model_cfg, _ = tiny_trainer(schedule="zb-h1")
     plan = trainer.pipe.memory_plan(2)
-    assert plan["wstash_slots"] >= 1  # deferred-W cotangent park exists
+    assert plan["wstash_slots"] == 0
+    t_never, _, _ = tiny_trainer(schedule="zb-h1", checkpoint="never")
+    # stored residuals: the designed pairing — B/W split is real, the
+    # deferred-W cotangent park exists.
+    assert t_never.pipe.memory_plan(2)["wstash_slots"] >= 1
     state, m = trainer.train_epoch(source, max_steps=8, log_every=0)
     assert m["loss"] < np.log(model_cfg.vocab)
     assert np.isfinite(trainer.evaluate(source, state, max_steps=2))

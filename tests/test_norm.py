@@ -172,7 +172,9 @@ def test_mesh_bn_packed_params_commit():
 
 def test_mesh_bn_with_data_axis():
     """PP x DP: per-shard partial sums reduce host-side; committed stats
-    are the exact whole-mini-batch statistics."""
+    are the exact whole-mini-batch statistics — AND the train-mode forward
+    itself matches the emulator (normalization stats psum over the data
+    axis, so shard-local rows see whole-micro-batch statistics)."""
     module = Sequential([Linear(6), BatchNorm()])
     x = jax.random.normal(jax.random.key(1), (8, 6))
     mesh_pipe = Pipe(module, chunks=2, checkpoint="never",
@@ -181,13 +183,42 @@ def test_mesh_bn_with_data_axis():
     emu_pipe = Pipe(module, chunks=2, checkpoint="never", n_stages=2,
                     deferred_batch_norm=True)
     params = mesh_pipe.init(jax.random.key(0), x)
-    _, new_m = mesh_pipe(params, x, train=True)
-    _, new_e = emu_pipe(params, x, train=True)
+    out_m, new_m = mesh_pipe(params, x, train=True)
+    out_e, new_e = emu_pipe(params, x, train=True)
+    np.testing.assert_allclose(np.asarray(out_m), np.asarray(out_e),
+                               rtol=1e-5, atol=1e-6)
     got, exp = new_m[1][0], new_e[1][0]
     np.testing.assert_allclose(np.asarray(got["mean"]),
                                np.asarray(exp["mean"]), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got["var"]),
                                np.asarray(exp["var"]), rtol=1e-5, atol=1e-6)
+
+
+def test_mesh_bn_data_axis_grads_match_emulator():
+    """jax.grad of the data-sharded mesh BN forward matches the emulator:
+    the data axis is purely a layout choice, never a math choice."""
+    module = Sequential([Linear(6), BatchNorm(), Lambda(jax.nn.relu),
+                         Linear(1)])
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    mesh_pipe = Pipe(module, chunks=2, checkpoint="never",
+                     mesh=_stage_mesh(2, n_data=2),
+                     deferred_batch_norm=True)
+    emu_pipe = Pipe(module, chunks=2, checkpoint="never", n_stages=2,
+                    deferred_batch_norm=True)
+    params = mesh_pipe.init(jax.random.key(0), x)
+
+    def loss(pipe):
+        def f(p):
+            out, _ = pipe(p, x, train=True)
+            return jnp.sum(out ** 2)
+        return f
+
+    g_m = jax.grad(loss(mesh_pipe))(params)
+    g_e = jax.grad(loss(emu_pipe))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_m),
+                    jax.tree_util.tree_leaves(g_e)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
 
 
 def test_mesh_bn_rejects_padded_rows():
@@ -198,6 +229,18 @@ def test_mesh_bn_rejects_padded_rows():
     x = jax.random.normal(jax.random.key(1), (7, 6))  # 7 % 4 != 0
     params = pipe.init(jax.random.key(0), jnp.zeros((8, 6)))
     with pytest.raises(ValueError):
+        pipe(params, x, train=True)
+
+
+def test_mesh_plain_bn_rejects_padded_rows():
+    """PLAIN BatchNorm (no deferred conversion) hits the same guard: its
+    train-mode normalization statistics are just as contaminated by fake
+    zero rows as the deferred accumulators are."""
+    module = Sequential([Linear(6), BatchNorm()])
+    pipe = Pipe(module, chunks=4, checkpoint="never", mesh=_stage_mesh(2))
+    x = jax.random.normal(jax.random.key(1), (7, 6))  # 7 % 4 != 0
+    params = pipe.init(jax.random.key(0), jnp.zeros((8, 6)))
+    with pytest.raises(ValueError, match="BatchNorm"):
         pipe(params, x, train=True)
 
 

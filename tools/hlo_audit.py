@@ -1,0 +1,187 @@
+"""HLO audit of the schedule-table executor (VERDICT r2 #5 / #3).
+
+``python tools/hlo_audit.py [--d=4] [--m=8] [--schedules=1f1b,zb-h1]
+[--checkpoint=never] [--d-model=256]``
+
+Compiles one ``ScheduledPipeline.loss_and_grad`` step per schedule on the
+virtual cpu8 mesh, then reports per-program:
+
+* ``flops`` — XLA's own cost model (``compiled.cost_analysis()``), the
+  decisive number for "does the B/W split execute extra matmul work";
+* ``bytes accessed`` — HBM-traffic proxy;
+* optimized-HLO op censuses: ``copy`` (conditional-copy tax), ``dot``
+  (matmul count), ``while``/``conditional`` structure;
+* cycles in the schedule table, so overhead can be attributed per cycle.
+
+Prints one JSON line; also used by docs/architecture.md's overhead table.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+
+def audit(n_stages: int = 4, chunks: int = 8, checkpoint: str = "never",
+          schedules=("1f1b", "zb-h1"), d_model: int = 256,
+          d_ff: int = 512, seq_len: int = 64) -> dict:
+    from pipe_tpu.utils.platform import force_cpu_platform
+    force_cpu_platform(8)
+
+    import jax
+    import jax.numpy as jnp
+
+    from pipe_tpu.core import microbatch as mb
+    from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+    from pipe_tpu.parallel.mesh import make_mesh
+    from pipe_tpu.parallel.scheduled import ScheduledPipeline
+    from pipe_tpu.parallel.spmd import stack_stage_params
+
+    cfg = LMConfig(vocab=512, d_model=d_model, nhead=4, d_ff=d_ff,
+                   n_layers=n_stages, seq_len=seq_len, dropout=0.0)
+    mesh = make_mesh(n_stages, 1, devices=jax.devices()[:n_stages])
+    model = PipelinedLM(cfg, n_stages)
+    sp, prep, postp = model.init(jax.random.key(0))
+    sp = stack_stage_params(sp)
+
+    m = chunks
+    tokens = jax.random.randint(jax.random.key(1), (4 * m, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    x, n_rows = mb.stack_scatter(
+        {"tokens": tokens, "targets": jnp.roll(tokens, -1, -1)}, m)
+    w = mb.valid_row_mask(x, n_rows)
+
+    out = {"platform": "cpu8", "n_stages": n_stages, "chunks": m,
+           "checkpoint": checkpoint, "d_model": d_model, "programs": {}}
+    for name in schedules:
+        pipe = ScheduledPipeline(
+            mesh, model.stage_fn, pre_fn=model.pre_fn,
+            post_fn=model.loss_post_fn, checkpoint=checkpoint,
+            schedule=name)
+        lowered = jax.jit(
+            lambda s, pipe=pipe: pipe.loss_and_grad(s, prep, postp, x, w)
+        ).lower(sp)
+        compiled = lowered.compile()
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        except Exception:  # cost model absent on some backends
+            ca = {}
+        hlo = compiled.as_text()
+        census = {}
+        for op in ("copy", "dot", "while", "conditional", "fusion",
+                   "dynamic-update-slice", "dynamic-slice",
+                   "collective-permute", "all-reduce"):
+            # op names appear as `%foo.N = <type> op(`; the type may
+            # contain spaces/parens (tuples), so anchor on ` op(` instead.
+            census[op] = len(re.findall(rf" {op}\(", hlo)) + \
+                len(re.findall(rf" {op}-start\(", hlo))
+        out["programs"][name] = {
+            "cycles": pipe._cycles(m),
+            "flops": ca.get("flops"),
+            "bytes_accessed": ca.get("bytes accessed"),
+            "optimized_hlo_ops": census,
+            "hlo_lines": hlo.count("\n"),
+        }
+    progs = out["programs"]
+    if len(progs) == 2:
+        a, b = list(progs)
+        fa, fb = progs[a].get("flops"), progs[b].get("flops")
+        if fa and fb:
+            out["flops_ratio"] = round(fb / fa, 4)
+    return out
+
+
+def percycle(checkpoint: str = "except_last", d_model: int = 256,
+             d_ff: int = 512, seq_len: int = 64, iters: int = 4) -> dict:
+    """Per-cycle cost of each executor variant at IDENTICAL per-op work
+    (one transformer layer per virtual stage, same shapes everywhere).
+
+    For each variant, times one compiled step at m=4 and m=8 micro-batches;
+    the slope over the known cycle-count delta is the marginal cost of one
+    table cycle (op compute + scan/switch/slot machinery + ring hop), and
+    comparing variants at the same per-op compute isolates the machinery:
+
+    * ``d1_static``  — trace-time unrolled straight-line program (the
+      branch-free baseline: pure op compute);
+    * ``d1_dynamic`` — the same table through the dynamic scan (adds
+      lax.switch + masked slot writes + carry copies);
+    * ``d2``/``d4``  — the dynamic scan on a real stage ring. NOTE: the
+      virtual cpu8 mesh serializes all devices onto this host's single
+      core, so a cycle's cost is the SUM of active devices' op compute,
+      not the max — d>1 slopes carry that serialization and upper-bound
+      the real per-cycle machinery.
+    """
+    from pipe_tpu.utils.platform import force_cpu_platform
+    force_cpu_platform(8)
+
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pipe_tpu.core import microbatch as mb
+    from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+    from pipe_tpu.parallel.mesh import make_mesh
+    from pipe_tpu.parallel.scheduled import ScheduledPipeline
+    from pipe_tpu.parallel.spmd import stack_stage_params
+
+    def step_time(pipe, model, sp, prep, postp, cfg, m):
+        tokens = jax.random.randint(jax.random.key(1), (4 * m, cfg.seq_len),
+                                    0, cfg.vocab, jnp.int32)
+        x, n_rows = mb.stack_scatter(
+            {"tokens": tokens, "targets": jnp.roll(tokens, -1, -1)}, m)
+        w = mb.valid_row_mask(x, n_rows)
+        lg = jax.jit(lambda s: pipe.loss_and_grad(s, prep, postp, x, w))
+        jax.block_until_ready(lg(sp))
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            r = lg(sp)
+        jax.block_until_ready(r)
+        return (_time.perf_counter() - t0) / iters
+
+    out = {"platform": "cpu8", "checkpoint": checkpoint, "d_model": d_model,
+           "per_op_work": "1 transformer layer", "variants": {}}
+    variants = [("d1_static", 1, True), ("d1_dynamic", 1, False),
+                ("d2", 2, None), ("d4", 4, None)]
+    for name, d, unroll in variants:
+        cfg = LMConfig(vocab=512, d_model=d_model, nhead=4, d_ff=d_ff,
+                       n_layers=d, seq_len=seq_len, dropout=0.0)
+        mesh = make_mesh(d, 1, devices=jax.devices()[:d])
+        model = PipelinedLM(cfg, d)
+        sp, prep, postp = model.init(jax.random.key(0))
+        sp = stack_stage_params(sp)
+        pipe = ScheduledPipeline(
+            mesh, model.stage_fn, pre_fn=model.pre_fn,
+            post_fn=model.loss_post_fn, checkpoint=checkpoint,
+            schedule="1f1b", static_unroll=unroll)
+        times, cycles = {}, {}
+        for m in (4, 8):
+            times[m] = step_time(pipe, model, sp, prep, postp, cfg, m)
+            cycles[m] = pipe._cycles(m)
+        slope = (times[8] - times[4]) / (cycles[8] - cycles[4])
+        out["variants"][name] = {
+            "t_m4_sec": round(times[4], 5), "t_m8_sec": round(times[8], 5),
+            "cycles_m4": cycles[4], "cycles_m8": cycles[8],
+            "per_cycle_ms": round(slope * 1e3, 3),
+        }
+    base = out["variants"]["d1_static"]["per_cycle_ms"]
+    for v in out["variants"].values():
+        v["machinery_tax_vs_static"] = round(v["per_cycle_ms"] / base, 3) \
+            if base else None
+    return out
+
+
+if __name__ == "__main__":
+    kw = {}
+    mode = audit
+    for a in sys.argv[1:]:
+        if a == "--percycle":
+            mode = percycle
+            continue
+        k, v = a.lstrip("-").split("=", 1)
+        k = k.replace("-", "_")
+        kw[k] = tuple(v.split(",")) if k == "schedules" else (
+            v if k == "checkpoint" else int(v))
+    print(json.dumps(mode(**kw)))
