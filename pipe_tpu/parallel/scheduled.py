@@ -144,6 +144,18 @@ class ScheduledPipeline:
     # config it exceeds a 16G chip where the dynamic path fits; set False
     # (or rely on the cycle cap) in that regime.
     static_unroll: Optional[bool] = None
+    # Selective rematerialization for the RECOMPUTE micro-batches (a
+    # ``jax.checkpoint_policies`` member, e.g. ``dots_saveable``): instead
+    # of stashing the stage input and re-running the whole forward at
+    # backward time, the forward stores the policy-saved residual subset
+    # (matmul outputs) and the backward recomputes only the cheap
+    # elementwise remainder — the FLOPs-vs-HBM dial the reference's
+    # all-or-nothing Checkpoint lacks. The per-micro-batch mode semantics
+    # are unchanged: SAVED micro-batches (never: all; except_last: m-1)
+    # still store full residuals. d=1 static path only (the policy-saved
+    # residual structure differs from the full set, and the dynamic scan's
+    # slot store needs one uniform structure); the dynamic path raises.
+    remat_policy: Optional[Any] = None
 
     def __post_init__(self):
         validate_mode(self.checkpoint)
@@ -305,6 +317,14 @@ class ScheduledPipeline:
             lambda a, b, dd: self._f_body(a, b, dd, x_mb, kis, s),
             params_g, prep, h_in)
 
+    def _vjp_wrt_policy(self, params_g, prep, h_in, x_mb, kis, s):
+        """Policy-selective vjp: residuals are only what ``remat_policy``
+        saves (the backward recomputes the rest in place)."""
+        wrapped = jax.checkpoint(
+            lambda a, b, dd: self._f_body(a, b, dd, x_mb, kis, s),
+            policy=self.remat_policy)
+        return jax.vjp(wrapped, params_g, prep, h_in)
+
     # -----------------------------------------------------------------
     def _host_tables(self, m):
         """Static (cycle, device) tables + receive-slot plan, host-side."""
@@ -410,6 +430,12 @@ class ScheduledPipeline:
                     h1, vjp_fn = self._vjp_wrt(
                         params_g, pre_params, h_in, x_mb, kis, s)
                     res[(i, g)] = vjp_fn
+                elif self.remat_policy is not None:
+                    # selective remat: store the policy-saved residual
+                    # subset now; backward recomputes only the remainder
+                    h1, vjp_fn = self._vjp_wrt_policy(
+                        params_g, pre_params, h_in, x_mb, kis, s)
+                    res[(i, g)] = vjp_fn
                 else:
                     h1 = self._f_body(params_g, pre_params, h_in, x_mb,
                                       kis, s)
@@ -483,6 +509,12 @@ class ScheduledPipeline:
         if d == 1 and self._use_static(m):
             return self._device_program_static(
                 stage_params, pre_params, post_params, x, w, wsum, key, m=m)
+        if self.remat_policy is not None:
+            raise NotImplementedError(
+                "remat_policy needs the d=1 static program: policy-saved "
+                "residuals have a different pytree structure than the full "
+                "set, and the dynamic scan's slot store requires one "
+                "uniform residual structure across micro-batches")
         j = jax.lax.axis_index(STAGE_AXIS)
         # This device's shard: [v, ...] — its interleave groups in order.
         params_dev = stage_params

@@ -1,0 +1,119 @@
+"""Two-process CPU dryrun of the multi-host runtime (VERDICT r2 #8).
+
+Launched as ``python -m pipe_tpu.runtime._multiproc_check <pid> <nprocs>
+<port> <out_file>`` once per process. Each process:
+
+* boots a 2-local-device CPU platform (so 2 processes give a 4-device
+  global topology: stage axis within a process — the ICI analogue — and
+  the data axis across processes — the DCN analogue);
+* wires the runtime with :func:`pipe_tpu.runtime.distributed.initialize`
+  (explicit local coordinator);
+* builds :func:`global_pipeline_mesh` (2 stages x 2 data), assembles its
+  host-local quarter of the global batch via :func:`host_local_batch`,
+  and runs ONE 1F1B pipeline train step (ScheduledPipeline.loss_and_grad)
+  across both processes;
+* process 0 writes the loss to ``out_file``.
+
+The launcher (``tests/test_multiprocess.py`` or ``tools/multiproc_dryrun``)
+compares the loss against the same step computed single-process on a local
+4-device mesh — the multi-host data plane must be a pure layout choice.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+# Deterministic tiny workload shared by the 2-process run and the
+# single-process reference (keys fixed; pure function of nothing).
+WIDTH = 16
+ROWS_PER_CHUNK = 4
+CHUNKS = 2
+N_STAGES = 2
+N_DATA = 2
+
+
+def _build(mesh):
+    """Pipeline + params + FULL global batch (deterministic)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import microbatch as mb
+    from ..parallel.scheduled import ScheduledPipeline
+    from ..parallel.spmd import stack_stage_params
+
+    def stage_fn(p, h, ctx):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def pre_fn(p, x, ctx):
+        return x
+
+    def post_fn(p, h, x, ctx):
+        return jnp.sum((h - 1.0) ** 2, axis=-1)
+
+    ks = jax.random.split(jax.random.key(0), N_STAGES)
+    params = [{"w": jax.random.normal(k, (WIDTH, WIDTH)) * 0.3,
+               "b": jnp.zeros((WIDTH,))} for k in ks]
+    stacked = stack_stage_params(params)
+    pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
+                             checkpoint="except_last", schedule="1f1b")
+    rows = ROWS_PER_CHUNK * CHUNKS * N_DATA
+    x_full = jax.random.normal(jax.random.key(1), (rows, WIDTH))
+    xs, n_rows = mb.stack_scatter(x_full, CHUNKS)   # [m, rows_g, W]
+    w = mb.valid_row_mask(xs, n_rows)
+    return pipe, stacked, xs, w
+
+
+def single_process_loss(devices=None) -> float:
+    """Reference: the same step on a single-process 4-device mesh."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    devices = devices if devices is not None else jax.devices()[:4]
+    mesh = make_mesh(N_STAGES, N_DATA, devices=devices)
+    pipe, stacked, xs, w = _build(mesh)
+    loss, _ = jax.jit(pipe.loss_and_grad)(stacked, {}, {}, xs, w)
+    return float(loss)
+
+
+def worker(process_id: int, num_processes: int, port: int,
+           out_file: str) -> None:
+    from ..utils.platform import force_cpu_platform
+    force_cpu_platform(2)  # 2 local devices per process
+
+    import jax
+    import numpy as np
+
+    from . import distributed as dist
+
+    dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                    num_processes=num_processes, process_id=process_id)
+    assert jax.process_count() == num_processes, dist.process_summary()
+    assert jax.device_count() == 2 * num_processes
+
+    mesh = dist.global_pipeline_mesh(N_STAGES, N_DATA)
+    pipe, stacked, xs_global, w_global = _build(mesh)
+
+    # Re-create xs as if each host loaded ONLY its data shard: slice this
+    # process's rows out of the deterministic global batch, then assemble
+    # the global array from per-host shards (the multi-host data-loading
+    # contract).
+    rows_g = xs_global.shape[1]
+    lo = process_id * (rows_g // num_processes)
+    hi = lo + rows_g // num_processes
+    xs_local = np.asarray(xs_global)[:, lo:hi]
+    xs = dist.host_local_batch(mesh, xs_local, batch_axis=1)
+    w = dist.host_local_batch(mesh, np.asarray(w_global)[:, lo:hi],
+                              batch_axis=1)
+
+    loss, grads = jax.jit(pipe.loss_and_grad)(stacked, {}, {}, xs, w)
+    jax.block_until_ready(grads)
+    if process_id == 0:
+        with open(out_file, "w") as f:
+            f.write(repr(float(loss)))
+
+
+if __name__ == "__main__":
+    worker(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+           sys.argv[4])

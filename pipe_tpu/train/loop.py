@@ -52,6 +52,11 @@ class TrainerConfig:
     schedule: str = "gpipe"    # gpipe | 1f1b | zb-h1 | interleaved
                                # | interleaved-1f1b
     interleave: int = 2        # virtual stages per device (interleaved only)
+    # Directory for TensorBoard scalar event files (SURVEY §5 "stdout +
+    # TensorBoard scalars"); None disables. Scalars mirror the stdout log
+    # lines (train/loss, train/ppl, train/tok_s, train/ms_batch, train/lr,
+    # pipeline/bubble) plus per-epoch train/epoch_loss and eval/loss.
+    tb_dir: Optional[str] = None
 
 
 class Trainer:
@@ -137,6 +142,11 @@ class Trainer:
         )
         self._step_fn = jax.jit(self._train_step, donate_argnums=(0,))
         self._eval_fn = jax.jit(self._eval_loss)
+        if cfg.tb_dir is not None:
+            from ..obs.tb_writer import ScalarWriter
+            self.tb: Optional["ScalarWriter"] = ScalarWriter(cfg.tb_dir)
+        else:
+            self.tb = None
 
     # --- state ---
 
@@ -295,7 +305,22 @@ class Trainer:
                        f"| tok/s {tokens_per_step/dt:,.0f} "
                        f"| loss {l:.3f} | ppl {np.exp(min(l, 20.0)):.2f} "
                        f"| bubble {self.analytic_bubble():.1%}")
+                if self.tb is not None:
+                    gstep = int(state.step)
+                    self.tb.add_scalar("train/loss", l, gstep)
+                    self.tb.add_scalar("train/ppl",
+                                       float(np.exp(min(l, 20.0))), gstep)
+                    self.tb.add_scalar("train/tok_s",
+                                       tokens_per_step / dt, gstep)
+                    self.tb.add_scalar("train/ms_batch", dt * 1000, gstep)
+                    self.tb.add_scalar("train/lr", lr, gstep)
+                    self.tb.add_scalar("pipeline/bubble",
+                                       self.analytic_bubble(), gstep)
+                    self.tb.flush()  # visible live; crash loses nothing
         final = float(losses[-1]) if losses else float("nan")
+        if self.tb is not None and losses:
+            self.tb.add_scalar("train/epoch_loss", final, int(state.step))
+            self.tb.flush()
         # t0 was reset after step 0, so elapsed covers len(losses)-1 steps
         return state, {"loss": final,
                        "steps": len(losses),
@@ -305,7 +330,8 @@ class Trainer:
     def evaluate(self, source: np.ndarray, state: TrainState,
                  max_steps: Optional[int] = None) -> float:
         """Mean eval loss over ``source`` (reference ``evaluate``,
-        ``main.py:275-289``, there commented out)."""
+        ``main.py:275-289``, there commented out). Logged to
+        ``eval/loss`` when a TB writer is configured."""
         cfg = self.cfg
         n = lm_text.num_batches(source, cfg.bptt)
         if max_steps is not None:
@@ -321,4 +347,8 @@ class Trainer:
             loss = self._eval_fn(state.params, x, w)
             total += float(loss) * data.size
             count += data.size
-        return total / max(count, 1)
+        mean = total / max(count, 1)
+        if self.tb is not None and count:
+            self.tb.add_scalar("eval/loss", mean, int(state.step))
+            self.tb.flush()
+        return mean

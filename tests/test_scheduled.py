@@ -432,6 +432,50 @@ def test_interleaved_1f1b_with_data_axis():
     np.testing.assert_allclose(float(loss), float(exp), rtol=1e-5)
 
 
+@pytest.mark.parametrize("checkpoint", ["always", "except_last"])
+def test_remat_policy_transparency(checkpoint):
+    """Selective remat (jax.checkpoint_policies.dots_saveable) on the d=1
+    static program: identical loss and grads to the full-recompute path —
+    the policy changes what is stored, never the math."""
+    m = 4
+    stage_fn, params = make_stage(2, jax.random.key(0))
+    mesh = make_mesh(1, 1, devices=jax.devices()[:1])
+    x = jax.random.normal(jax.random.key(1), (2 * m, WIDTH))
+    xs, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    stacked = stack_stage_params(params[:1])
+
+    results = []
+    for policy in (None, jax.checkpoint_policies.dots_saveable):
+        pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn,
+                                 post_fn=post_fn, checkpoint=checkpoint,
+                                 schedule="1f1b", remat_policy=policy)
+        loss, (gsp, _, _) = jax.jit(pipe.loss_and_grad)(
+            stacked, {}, {}, xs, w, key=jax.random.key(9))
+        results.append((float(loss), gsp))
+    (l_full, g_full), (l_pol, g_pol) = results
+    assert l_full == pytest.approx(l_pol, rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g_full),
+                    jax.tree_util.tree_leaves(g_pol)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_remat_policy_rejected_on_dynamic_path():
+    stage_fn, params = make_stage(2, jax.random.key(0))
+    mesh = make_mesh(2, 1, devices=jax.devices()[:2])
+    xs, _ = mb.stack_scatter(jax.random.normal(jax.random.key(1),
+                                               (8, WIDTH)), 4)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    pipe = ScheduledPipeline(
+        mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
+        checkpoint="except_last", schedule="1f1b",
+        remat_policy=jax.checkpoint_policies.dots_saveable)
+    with pytest.raises(NotImplementedError, match="static"):
+        jax.jit(pipe.loss_and_grad)(stack_stage_params(params), {}, {},
+                                    xs, w)
+
+
 @pytest.mark.parametrize("schedule", ["1f1b", "zb-h1"])
 def test_static_unroll_matches_dynamic_at_d1(schedule):
     """static_unroll=True (trace-time straight-line) and =False (the
