@@ -420,10 +420,13 @@ def main():
     except Exception as e:  # full batch can OOM where micro-batching fits
         print(f"full-batch baseline failed: {e}", file=sys.stderr)
 
-    # With a dots-saving policy the recompute re-runs only elementwise ops —
-    # zero extra MACs, so hardware FLOPs collapse to the required count.
-    req_tok, hw_tok = train_flops_per_token(
-        cfg, "never" if policy is not None else CHECKPOINT, CHUNKS)
+    # dots_saveable saves EVERY matmul output, so its recompute re-runs only
+    # elementwise ops — zero extra MACs, hardware FLOPs = required. Other
+    # policies re-run some matmuls; without a per-policy MAC model, keep the
+    # mode's full-recompute count as the honest upper bound.
+    hw_mode = ("never" if policy is not None
+               and REMAT_POLICY == "dots_saveable" else CHECKPOINT)
+    req_tok, hw_tok = train_flops_per_token(cfg, hw_mode, CHUNKS)
     model_flops = req_tok * tokens_per_step
     peak = peak_flops_per_chip()
     mfu = (req_tok * pipe_tps_chip) / peak

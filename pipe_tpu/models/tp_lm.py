@@ -1,0 +1,59 @@
+"""Tensor-parallel Transformer LM: the PP x TP x DP factorization.
+
+Beyond the reference (no TP there — SURVEY §2 strategy table): the same
+embed | k blocks per stage | decode factorization as
+:class:`~pipe_tpu.models.transformer_lm.PipelinedLM`, but the block is the
+Megatron-split :mod:`~pipe_tpu.ops.tp_layers` block whose head and FFN dims
+shard over a ``model`` mesh axis. ``stage_param_specs()`` hands the
+executors the per-leaf ``PartitionSpec``s (stage axis prepended by the
+executor), so each device holds ``1/(n_stages * tp)`` of the block weights
+— pipeline memory scaling times tensor memory scaling.
+"""
+
+from __future__ import annotations
+
+from ..core.partition import StageCtx
+from ..ops.tp_layers import tp_block_apply, tp_block_init, tp_block_specs
+from ..parallel.mesh import MODEL_AXIS
+from .transformer_lm import LMConfig, PipelinedLM
+
+__all__ = ["TPPipelinedLM"]
+
+
+class _TPBlock:
+    """Module shim over the functional TP block (init/apply contract)."""
+
+    def __init__(self, cfg: LMConfig, tp_axis):
+        self.cfg = cfg
+        self.tp_axis = tp_axis
+
+    def init(self, key, h_spec):
+        del h_spec
+        cfg = self.cfg
+        return tp_block_init(key, cfg.d_model, cfg.nhead, cfg.d_ff)
+
+    def apply(self, p, h, ctx: StageCtx = StageCtx()):
+        return tp_block_apply(p, h, ctx, dropout=self.cfg.dropout,
+                              causal=self.cfg.causal, tp_axis=self.tp_axis)
+
+
+class TPPipelinedLM(PipelinedLM):
+    """embed | k TP blocks per stage | decode, over (stage, data, model).
+
+    Identical factorization, embed/posenc/loss path, and key schedule to
+    :class:`PipelinedLM` — only the block differs (the Megatron-split
+    :mod:`~pipe_tpu.ops.tp_layers` block). ``tp_axis=None`` runs the same
+    math unsharded (the transparency yardstick); embed/decoder stay
+    replicated over the model axis (their vocab-scale matmuls amortize
+    over the whole pipeline once, and the reference keeps them on edge
+    stages anyway).
+    """
+
+    def __init__(self, cfg: LMConfig, n_stages: int, tp_axis=MODEL_AXIS):
+        super().__init__(cfg, n_stages)
+        self.block = _TPBlock(cfg, tp_axis)
+
+    def stage_param_specs(self):
+        """Specs for ONE stage's params (list of per-layer block trees);
+        executors prepend the stage axis for the stacked layout."""
+        return [tp_block_specs() for _ in range(self.layers_per_stage)]

@@ -1,0 +1,211 @@
+"""Tensor-parallel transformer block (Megatron-style column/row sharding).
+
+Beyond the reference (which has no tensor parallelism — SURVEY §2 strategy
+table: TP absent), built the TPU way: parameters carry per-leaf
+``PartitionSpec``s over a ``model`` mesh axis, ``shard_map`` hands each
+device its local shard, and the block body is written for local shards with
+exactly TWO ``psum``s per block (attention output projection and FFN second
+matmul) — the canonical column-then-row split:
+
+* QKV projection: **column-parallel** — heads are split over the model axis
+  (weights ``[d, 3, H, hd]`` sharded on the head dim), so each device
+  computes attention for its ``H/tp`` heads with no communication;
+* attention output projection: **row-parallel** — local heads contract
+  against the local slice of ``W_O`` (``[H, hd, d]`` sharded on dim 0),
+  partial results ``psum`` over the model axis;
+* FFN: ``W1 [d, ff]`` column-sharded on dim 1, ``W2 [ff, d]`` row-sharded
+  on dim 0, one ``psum`` after ``W2``.
+
+Biases that live on sharded dims (``b_qkv``, ``b1``) are sharded with their
+weights; output-side biases (``b_o``, ``b2``) and LayerNorm params are
+replicated and added/applied AFTER the psum (once, not tp times).
+
+Invariance: with dropout applied only to REPLICATED activations (the two
+residual dropouts, post-psum), the tp=k forward/backward equals the tp=1
+computation exactly (up to fp reduction order) — asserted in
+``tests/test_tp.py``. Attention-probability dropout would act on
+head-sharded tensors (same key ⇒ same mask per shard ⇒ different math from
+tp=1), so this block deliberately uses residual dropout only.
+
+Differentiation contract: these blocks are built for IN-PROGRAM vjp — the
+schedule-table executor computes ``jax.vjp`` inside the shard_map body and
+never reduces gradients over the model axis (see :func:`tp_enter`). Do NOT
+wrap a ``shard_map`` of this block in an outer ``jax.grad`` with replicated
+``in_specs``: the boundary transpose inserts its own model-axis psum on
+replicated operands' cotangents, double-counting every replicated leaf's
+gradient on top of the tp_enter contract.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.partition import StageCtx
+from ..parallel.mesh import MODEL_AXIS
+
+__all__ = ["tp_block_init", "tp_block_apply", "tp_block_specs", "tp_enter",
+           "tp_allreduce"]
+
+
+def tp_block_init(key: jax.Array, d_model: int, nhead: int, d_ff: int,
+                  dtype=jnp.float32) -> Dict[str, Any]:
+    """Full (unsharded) parameter tree; sharding comes from the specs."""
+    hd = d_model // nhead
+    if hd * nhead != d_model:
+        raise ValueError(f"d_model={d_model} not divisible by nhead={nhead}")
+    ks = jax.random.split(key, 4)
+    s_attn = 1.0 / jnp.sqrt(d_model)
+    s_ff = 1.0 / jnp.sqrt(d_ff)
+    return {
+        "ln1": {"scale": jnp.ones((d_model,), dtype),
+                "bias": jnp.zeros((d_model,), dtype)},
+        "wqkv": jax.random.normal(ks[0], (d_model, 3, nhead, hd),
+                                  dtype) * s_attn,
+        "bqkv": jnp.zeros((3, nhead, hd), dtype),
+        "wo": jax.random.normal(ks[1], (nhead, hd, d_model), dtype) * s_attn,
+        "bo": jnp.zeros((d_model,), dtype),
+        "ln2": {"scale": jnp.ones((d_model,), dtype),
+                "bias": jnp.zeros((d_model,), dtype)},
+        "w1": jax.random.normal(ks[2], (d_model, d_ff), dtype) * s_attn,
+        "b1": jnp.zeros((d_ff,), dtype),
+        "w2": jax.random.normal(ks[3], (d_ff, d_model), dtype) * s_ff,
+        "b2": jnp.zeros((d_model,), dtype),
+    }
+
+
+def tp_block_specs() -> Dict[str, Any]:
+    """Per-leaf PartitionSpecs over the block's OWN dims (no stage dim):
+    heads and ff sharded over ``model``, everything else replicated."""
+    m = MODEL_AXIS
+    return {
+        "ln1": {"scale": P(), "bias": P()},
+        "wqkv": P(None, None, m, None),
+        "bqkv": P(None, m, None),
+        "wo": P(m, None, None),
+        "bo": P(),
+        "ln2": {"scale": P(), "bias": P()},
+        "w1": P(None, m),
+        "b1": P(m),
+        "w2": P(m, None),
+        "b2": P(),
+    }
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_enter(h, axis):
+    """Megatron's *f* operator: identity forward, psum backward.
+
+    Marks the entry into a tensor-parallel region — applied to the
+    PARALLEL-REGION inputs (the LayerNorm outputs feeding QKV and W1), NOT
+    the block input: the residual stream must stay outside the f…psum pair
+    or its (already replicated) cotangent would be overcounted tp times.
+    Each shard's backward produces only its own heads'/features'
+    contribution to ``d loss/d hn``; the all-reduce here makes every
+    cotangent upstream of it (LayerNorm params, the residual stream, the
+    previous stage, the embed) **identical across model shards**. That
+    invariant is the grad contract: executors never reduce gradients over
+    the model axis — sharded leaves' grads are local by construction,
+    replicated leaves' grads are model-identical by this operator.
+    """
+    return h
+
+
+def _tp_enter_fwd(h, axis):
+    return h, None
+
+
+def _tp_enter_bwd(axis, _, g):
+    return (jax.lax.psum(g, axis),)
+
+
+tp_enter.defvjp(_tp_enter_fwd, _tp_enter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_allreduce(x, axis):
+    """Megatron's *g* operator: psum forward, IDENTITY backward.
+
+    The row-parallel output sum must not be differentiated as a raw
+    ``lax.psum``: JAX's transpose rule for psum is psum, which is correct
+    when the output's cotangents vary per shard (e.g. the BN data-axis
+    stats) but here the loss is symmetric across model shards, the
+    cotangent is replicated, and the transpose-psum would multiply every
+    upstream gradient by tp (measured exactly 2x at tp=2). Each shard's
+    true ``d loss/d partial_k`` is the unsummed replicated cotangent —
+    identity."""
+    return jax.lax.psum(x, axis)
+
+
+def _tp_allreduce_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _tp_allreduce_bwd(axis, _, g):
+    return (g,)
+
+
+tp_allreduce.defvjp(_tp_allreduce_fwd, _tp_allreduce_bwd)
+
+
+def _layernorm(x, p, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _dropout(x, rate: float, key: Optional[jax.Array]):
+    if not rate or key is None:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def tp_block_apply(p: Dict[str, Any], h: jax.Array, ctx: StageCtx,
+                   *, dropout: float = 0.0, causal: bool = True,
+                   tp_axis: Optional[str] = MODEL_AXIS) -> jax.Array:
+    """Pre-LN transformer block on LOCAL parameter shards.
+
+    ``h`` is replicated over the model axis (``[rows, seq, d]``); inside
+    ``shard_map`` the sharded leaves arrive as their local slices, so the
+    same code runs at tp=1 with ``tp_axis=None`` (no psum) on full params.
+    """
+    if tp_axis is not None:
+        psum = lambda v: tp_allreduce(v, tp_axis)
+        enter = lambda v: tp_enter(v, tp_axis)
+    else:
+        psum = enter = lambda v: v
+    rows, seq, d = h.shape
+    key1 = key2 = None
+    if ctx.key is not None:
+        key1, key2 = jax.random.split(ctx.key)
+
+    # --- attention (local heads) ---
+    hn = enter(_layernorm(h, p["ln1"]))
+    qkv = jnp.einsum("bsd,dthk->btshk", hn, p["wqkv"]) + p["bqkv"][:, None]
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # [rows, seq, Hl, hd]
+    hd = q.shape[-1]
+    scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
+        jnp.asarray(hd, h.dtype))
+    if causal:
+        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+        h.dtype)
+    attn = jnp.einsum("bhst,bthk->bshk", probs, v)   # [rows, seq, Hl, hd]
+    # row-parallel out projection: partial sums psum over the model axis;
+    # the replicated bias is added AFTER (once) — its cotangent is the
+    # replicated output grad, identical on every model shard, per the
+    # tp_enter grad contract (no model-axis grad reduction anywhere).
+    out = psum(jnp.einsum("bshk,hkd->bsd", attn, p["wo"])) + p["bo"]
+    h = h + _dropout(out, dropout, key1)
+
+    # --- FFN (column then row) ---
+    hn2 = enter(_layernorm(h, p["ln2"]))
+    inner = jax.nn.gelu(hn2 @ p["w1"] + p["b1"])     # [rows, seq, ff_local]
+    ff = psum(inner @ p["w2"]) + p["b2"]
+    return h + _dropout(ff, dropout, key2)

@@ -17,45 +17,59 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "STAGE_AXIS", "DATA_AXIS", "CONTEXT_AXIS"]
+__all__ = ["make_mesh", "STAGE_AXIS", "DATA_AXIS", "CONTEXT_AXIS",
+           "MODEL_AXIS"]
 
 STAGE_AXIS = "stage"
 DATA_AXIS = "data"
 CONTEXT_AXIS = "context"
+MODEL_AXIS = "model"
 
 
 def make_mesh(n_stages: int,
               n_data: Optional[int] = None,
               *,
               n_context: Optional[int] = None,
+              n_model: Optional[int] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a ``(stage[, data][, context])`` mesh.
+    """Build a ``(stage[, data][, context][, model])`` mesh.
 
     With ``n_data=None`` the data axis is sized to use all remaining devices
-    (``len(devices) // (n_stages * n_context)``); pass ``n_data=1`` for a
-    pure pipeline mesh. Stage is the *outer* axis so consecutive stages land
-    on ICI-adjacent devices in the common case; the context axis (sequence
-    parallelism) is innermost so its K/V ring also stays ICI-local.
+    (``len(devices) // (n_stages * n_context * n_model)``); pass ``n_data=1``
+    for a pure pipeline mesh. Stage is the *outer* axis so consecutive stages
+    land on ICI-adjacent devices in the common case; the context axis
+    (sequence parallelism) and the model axis (tensor parallelism) are
+    innermost so their per-layer collectives (K/V ring; the two psums per
+    block) stay ICI-local — TP has the highest collective frequency, so it
+    gets the fastest links (the scaling-book layout).
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_stages <= 0:
         raise ValueError("n_stages must be positive")
     if n_context is not None and n_context <= 0:
         raise ValueError("n_context must be positive (or None for no axis)")
+    if n_model is not None and n_model <= 0:
+        raise ValueError("n_model must be positive (or None for no axis)")
     ctx = n_context or 1
-    if len(devices) % (n_stages * ctx):
+    tp = n_model or 1
+    if len(devices) % (n_stages * ctx * tp):
         raise ValueError(
             f"{len(devices)} devices not divisible by "
-            f"n_stages*n_context={n_stages * ctx}")
+            f"n_stages*n_context*n_model={n_stages * ctx * tp}")
     if n_data is None:
-        n_data = len(devices) // (n_stages * ctx)
-    used = n_stages * n_data * ctx
+        n_data = len(devices) // (n_stages * ctx * tp)
+    used = n_stages * n_data * ctx * tp
     if used > len(devices):
         raise ValueError(
-            f"mesh {n_stages}x{n_data}x{ctx} needs {used} devices, "
+            f"mesh {n_stages}x{n_data}x{ctx}x{tp} needs {used} devices, "
             f"have {len(devices)}")
-    if n_context is None:
-        grid = np.asarray(devices[:used]).reshape(n_stages, n_data)
-        return Mesh(grid, (STAGE_AXIS, DATA_AXIS))
-    grid = np.asarray(devices[:used]).reshape(n_stages, n_data, ctx)
-    return Mesh(grid, (STAGE_AXIS, DATA_AXIS, CONTEXT_AXIS))
+    shape = [n_stages, n_data]
+    names = [STAGE_AXIS, DATA_AXIS]
+    if n_context is not None:
+        shape.append(ctx)
+        names.append(CONTEXT_AXIS)
+    if n_model is not None:
+        shape.append(tp)
+        names.append(MODEL_AXIS)
+    grid = np.asarray(devices[:used]).reshape(shape)
+    return Mesh(grid, tuple(names))

@@ -89,7 +89,7 @@ from ..core.remat import validate_mode
 from ..core.schedule import (BWD, FWD, WGRAD, GPipeSchedule,
                              InterleavedOneFOneBSchedule, OneFOneBSchedule,
                              Schedule, get_schedule)
-from .mesh import DATA_AXIS, STAGE_AXIS
+from .mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from ..utils.rng import make_key
 
 __all__ = ["ScheduledPipeline"]
@@ -144,6 +144,16 @@ class ScheduledPipeline:
     # config it exceeds a 16G chip where the dynamic path fits; set False
     # (or rely on the cycle cap) in that regime.
     static_unroll: Optional[bool] = None
+    # Per-leaf PartitionSpecs for ONE stage's param tree over the leaf's
+    # OWN dims (tensor parallelism): e.g. a Megatron block's
+    # ``{"wqkv": P(None, None, 'model', None), ...}`` — the executor
+    # prepends the stage axis for the stacked layout, hands each device
+    # its local shard inside shard_map, and NEVER reduces gradients over
+    # the model axis (the TP grad contract: sharded leaves' grads are
+    # local by construction, replicated leaves' grads are model-identical
+    # via the block's tp_enter operator — see ops/tp_layers.py). None =
+    # every leaf replicated over non-stage axes (the homogeneous default).
+    stage_param_specs: Optional[Any] = None
     # Selective rematerialization for the RECOMPUTE micro-batches (a
     # ``jax.checkpoint_policies`` member, e.g. ``dots_saveable``): instead
     # of stashing the stage input and re-running the whole forward at
@@ -244,8 +254,9 @@ class ScheduledPipeline:
                 spec[self.context_dim] = self.context_axis
             return P(*spec)
 
+        sp_specs = self._stage_param_in_specs(stage_params)
         in_specs = (
-            jax.tree_util.tree_map(lambda _: P(STAGE_AXIS), stage_params),
+            sp_specs,
             jax.tree_util.tree_map(lambda _: P(), pre_params),
             jax.tree_util.tree_map(lambda _: P(), post_params),
             jax.tree_util.tree_map(x_spec, x),
@@ -255,7 +266,7 @@ class ScheduledPipeline:
         )
         out_specs = (
             P(),                          # loss
-            (jax.tree_util.tree_map(lambda _: P(STAGE_AXIS), stage_params),
+            (sp_specs,
              jax.tree_util.tree_map(lambda _: P(), pre_params),
              jax.tree_util.tree_map(lambda _: P(), post_params)),
         )
@@ -264,6 +275,32 @@ class ScheduledPipeline:
             mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False)
         return run(stage_params, pre_params, post_params, x, w, wsum, key)
+
+    # -----------------------------------------------------------------
+    def _stage_param_in_specs(self, stage_params):
+        """Stacked-layout PartitionSpecs: P(stage) per leaf, or
+        P(stage, *leaf_spec) when ``stage_param_specs`` names per-leaf
+        shardings (tensor parallelism)."""
+        if self.stage_param_specs is None:
+            return jax.tree_util.tree_map(lambda _: P(STAGE_AXIS),
+                                          stage_params)
+        is_p = lambda v: isinstance(v, P)
+        specs = jax.tree_util.tree_map(
+            lambda s_: P(STAGE_AXIS, *s_), self.stage_param_specs,
+            is_leaf=is_p)
+        got = jax.tree_util.tree_structure(specs)
+        want = jax.tree_util.tree_structure(stage_params)
+        if got != want:
+            raise ValueError(
+                f"stage_param_specs structure {got} does not match the "
+                f"stacked stage params {want}")
+        return specs
+
+    def _grad_reduce_axes(self):
+        """Mesh axes grads sum over: every non-stage axis EXCEPT the model
+        axis (TP grad contract — see ``stage_param_specs``)."""
+        return tuple(a for a in self.mesh.axis_names
+                     if a not in (STAGE_AXIS, MODEL_AXIS))
 
     # -----------------------------------------------------------------
     def _f_body(self, params_g, prep, h_in, x_mb, kis, s):
@@ -488,7 +525,7 @@ class ScheduledPipeline:
             lambda *rows: jnp.stack(rows, axis=0),
             *[g_per_group[g] for g in range(v)])
 
-        other_axes = tuple(a for a in self.mesh.axis_names if a != STAGE_AXIS)
+        other_axes = self._grad_reduce_axes()
         if other_axes:
             g_sp = jax.tree_util.tree_map(
                 lambda gg: jax.lax.psum(gg, other_axes), g_sp)
@@ -797,8 +834,8 @@ class ScheduledPipeline:
 
         # --- cross-device reductions ------------------------------------
         # stage grads: per-device shards stay put; replicas over other axes
-        # sum
-        other_axes = tuple(a for a in self.mesh.axis_names if a != STAGE_AXIS)
+        # sum (never the model axis — TP grad contract)
+        other_axes = self._grad_reduce_axes()
         if other_axes:
             g_sp = jax.tree_util.tree_map(
                 lambda gg: jax.lax.psum(gg, other_axes), g_sp)

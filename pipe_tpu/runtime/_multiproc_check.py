@@ -14,9 +14,11 @@ Launched as ``python -m pipe_tpu.runtime._multiproc_check <pid> <nprocs>
   across both processes;
 * process 0 writes the loss to ``out_file``.
 
-The launcher (``tests/test_multiprocess.py`` or ``tools/multiproc_dryrun``)
-compares the loss against the same step computed single-process on a local
-4-device mesh — the multi-host data plane must be a pure layout choice.
+The launchers (``tests/test_multiprocess.py`` under ``PIPE_TPU_MULTIPROC=1``
+and ``__graft_entry__.dryrun_multichip``, both via
+:func:`launch_two_process_check`) compare the loss against the same step
+computed single-process on a local 4-device mesh — the multi-host data
+plane must be a pure layout choice.
 """
 
 from __future__ import annotations
@@ -112,6 +114,58 @@ def worker(process_id: int, num_processes: int, port: int,
     if process_id == 0:
         with open(out_file, "w") as f:
             f.write(repr(float(loss)))
+
+
+def launch_two_process_check(out_file: str, *, timeout: float = 600.0,
+                             repo_root: str = None) -> float:
+    """Spawn the two workers as REAL processes and return process 0's loss.
+
+    Shared by the gated test and the dryrun. Raises
+    ``subprocess.TimeoutExpired``/``OSError`` when the environment cannot
+    launch or connect the processes (callers may classify those as
+    sandbox restrictions), and ``RuntimeError`` when a worker genuinely
+    fails or breaks the output contract — never leaves orphans.
+    """
+    import os
+    import socket
+    import subprocess
+
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    # Fresh interpreters must not boot the axon TPU plugin (it would hang
+    # CPU selection) and must not inherit any forced device count: the
+    # workers set their own 2-device CPU platform.
+    env["PYTHONPATH"] = repo_root
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = []
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "pipe_tpu.runtime._multiproc_check",
+             str(i), "2", str(port), str(out_file)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for i in range(2)]
+        texts = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:               # never leave orphaned JAX processes
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    if any(p.returncode != 0 for p in procs):
+        raise RuntimeError(
+            "multiproc worker failed:\n" +
+            "\n".join(t.decode(errors="replace")[-3000:] for t in texts))
+    try:
+        with open(out_file) as f:
+            return float(f.read())
+    except (OSError, ValueError) as e:
+        raise RuntimeError(
+            f"workers exited 0 but the loss file contract broke: {e}")
 
 
 if __name__ == "__main__":

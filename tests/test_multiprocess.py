@@ -6,14 +6,11 @@ cross-process 1F1B pipeline step whose loss must equal the single-process
 run bit-for-tolerance.
 
 Heavy (spawns 2 JAX processes, each compiling the step), so gated behind
-``PIPE_TPU_MULTIPROC=1``; ``tools/multiproc_dryrun.py`` runs it standalone
-and the round dryrun invokes it.
+``PIPE_TPU_MULTIPROC=1``; ``__graft_entry__.dryrun_multichip`` also runs
+the same check (shared launcher: ``launch_two_process_check``).
 """
 
 import os
-import socket
-import subprocess
-import sys
 
 import pytest
 
@@ -21,46 +18,11 @@ pytestmark = pytest.mark.skipif(
     os.environ.get("PIPE_TPU_MULTIPROC") != "1",
     reason="2-process dryrun is heavy; set PIPE_TPU_MULTIPROC=1 to run")
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
 
 def test_two_process_pipeline_step_matches_single_process(tmp_path):
-    port = _free_port()
-    out = tmp_path / "loss.txt"
-    env = dict(os.environ)
-    # Fresh interpreters must not boot the axon TPU plugin (it would hang
-    # CPU selection) and must not inherit the test process's 8-device
-    # forcing: the worker sets its own 2-device CPU platform.
-    env["PYTHONPATH"] = REPO
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-m", "pipe_tpu.runtime._multiproc_check",
-             str(i), "2", str(port), str(out)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for i in range(2)
-    ]
-    try:
-        outputs = []
-        for p in procs:
-            stdout, _ = p.communicate(timeout=600)
-            outputs.append(stdout.decode(errors="replace"))
-    finally:
-        for p in procs:           # never leave orphaned JAX processes
-            if p.poll() is None:
-                p.kill()
-                p.wait()
-    for p, text in zip(procs, outputs):
-        assert p.returncode == 0, f"worker failed:\n{text[-3000:]}"
-    multi = float(out.read_text())
+    from pipe_tpu.runtime._multiproc_check import (launch_two_process_check,
+                                                   single_process_loss)
 
-    from pipe_tpu.runtime._multiproc_check import single_process_loss
+    multi = launch_two_process_check(str(tmp_path / "loss.txt"))
     single = single_process_loss()
     assert multi == pytest.approx(single, rel=1e-6), (multi, single)

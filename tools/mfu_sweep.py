@@ -71,8 +71,9 @@ def main(configs):
                               "error": str(e)[:300]}))
             continue
         tps = tokens_per_step / sec
-        req, _ = train_flops_per_token(
-            cfg, "never" if policy is not None else checkpoint, CHUNKS)
+        # MFU's numerator is the required (no-recompute) FLOPs — checkpoint
+        # mode and policy never change it
+        req, _ = train_flops_per_token(cfg, "never", CHUNKS)
         print(json.dumps({
             "checkpoint": checkpoint, "policy": policy_name,
             "sec_per_step": round(sec, 5),
