@@ -1,0 +1,65 @@
+"""Mixture-of-Experts Transformer LM: the PP x DP x EP factorization.
+
+Same embed | k blocks per stage | decode scaffolding as
+:class:`~pipe_tpu.models.transformer_lm.PipelinedLM`; the block is the
+hybrid :func:`~pipe_tpu.ops.moe.moe_block_apply` (TP attention + MoE FFN,
+experts and heads sharded over the ``model`` mesh axis).
+
+Aux-loss note: the load-balance auxiliary is available at the layer level
+(``moe_ffn_apply`` returns it); the homogeneous stage contract is
+``h -> h``, so the pipelined model trains the router through the GATING
+path only (top-k gate values multiply expert outputs, so router gradients
+flow regardless) and drops the aux regularizer — threading a scalar
+accumulator channel through the table executor is the documented follow-up
+(the hetero path's deferred-BN lanes show the mechanism).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..core.partition import StageCtx
+from ..ops.moe import moe_block_apply, moe_block_init, moe_block_specs
+from ..parallel.mesh import MODEL_AXIS
+from .transformer_lm import LMConfig, PipelinedLM
+
+__all__ = ["MoELMConfig", "MoEPipelinedLM"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoELMConfig(LMConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+
+class _MoEBlock:
+    def __init__(self, cfg: MoELMConfig, ep_axis):
+        self.cfg = cfg
+        self.ep_axis = ep_axis
+
+    def init(self, key, h_spec):
+        del h_spec
+        cfg = self.cfg
+        return moe_block_init(key, cfg.d_model, cfg.nhead, cfg.d_ff,
+                              cfg.n_experts)
+
+    def apply(self, p, h, ctx: StageCtx = StageCtx()):
+        cfg = self.cfg
+        out, _aux = moe_block_apply(
+            p, h, ctx, n_experts=cfg.n_experts, k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor, dropout=cfg.dropout,
+            causal=cfg.causal, ep_axis=self.ep_axis)
+        return out
+
+
+class MoEPipelinedLM(PipelinedLM):
+    """embed | k MoE blocks per stage | decode over (stage, data, model)."""
+
+    def __init__(self, cfg: MoELMConfig, n_stages: int,
+                 ep_axis=MODEL_AXIS):
+        super().__init__(cfg, n_stages)
+        self.block = _MoEBlock(cfg, ep_axis)
+
+    def stage_param_specs(self):
+        return [moe_block_specs() for _ in range(self.layers_per_stage)]

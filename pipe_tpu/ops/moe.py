@@ -1,0 +1,222 @@
+"""Mixture-of-Experts FFN with expert parallelism (GShard/Switch lineage).
+
+Beyond the reference (no MoE/EP there — SURVEY §2 strategy table). The
+TPU-shaped design:
+
+* **Dense dispatch, static shapes**: routing is expressed as one-hot
+  dispatch/combine einsums over a fixed per-expert ``capacity`` (GShard's
+  formulation) — no dynamic shapes, no sorting; XLA tiles the whole layer
+  onto the MXU. Tokens over capacity fall through on the residual stream
+  (standard switch behavior).
+* **Expert sharding over the ``model`` mesh axis**: expert-indexed leaves
+  (``w1/b1/w2/b2`` ``[E, ...]`` and the router's expert columns) shard on
+  their expert dim, so each device holds ``E/ep`` experts and computes
+  only their capacity slots — compute and memory scale ``1/ep``.
+* **Same grad contract as tensor parallelism** (:mod:`.tp_layers`): the
+  region is bracketed by the *f*/*g* custom-vjp operators (``tp_enter`` /
+  ``tp_allreduce``), every sharded leaf's gradient is local by
+  construction (the router weight is sharded BY EXPERT COLUMN for exactly
+  this reason — its full-logit row assembles through one ``tp_allreduce``
+  of zero-padded local logits), replicated leaves' gradients are
+  model-identical, and executors never reduce gradients over the axis.
+  Communication: two psums per MoE layer (logits assembly + output
+  combine), riding the innermost (fastest-ICI) axis.
+
+``ep_axis=None`` runs the identical math unsharded — the transparency
+yardstick (``tests/test_moe.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.partition import StageCtx
+from ..parallel.mesh import MODEL_AXIS
+from .tp_layers import (tp_allreduce, tp_attention_init,
+                        tp_attention_sublayer, tp_enter, _dropout,
+                        _layernorm)
+
+__all__ = ["moe_ffn_init", "moe_ffn_apply", "moe_ffn_specs", "moe_capacity",
+           "moe_block_init", "moe_block_apply", "moe_block_specs"]
+
+
+def moe_ffn_init(key: jax.Array, d_model: int, d_ff: int, n_experts: int,
+                 dtype=jnp.float32) -> Dict[str, Any]:
+    ks = jax.random.split(key, 3)
+    s_in = 1.0 / jnp.sqrt(d_model)
+    s_out = 1.0 / jnp.sqrt(d_ff)
+    return {
+        "wr": jax.random.normal(ks[0], (d_model, n_experts), dtype) * s_in,
+        "br": jnp.zeros((n_experts,), dtype),
+        "w1": jax.random.normal(ks[1], (n_experts, d_model, d_ff),
+                                dtype) * s_in,
+        "b1": jnp.zeros((n_experts, d_ff), dtype),
+        "w2": jax.random.normal(ks[2], (n_experts, d_ff, d_model),
+                                dtype) * s_out,
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def moe_ffn_specs() -> Dict[str, Any]:
+    """Per-leaf PartitionSpecs: every expert-indexed dim shards over the
+    model axis (incl. the router's expert columns)."""
+    m = MODEL_AXIS
+    return {
+        "wr": P(None, m), "br": P(m),
+        "w1": P(m, None, None), "b1": P(m, None),
+        "w2": P(m, None, None), "b2": P(m, None),
+    }
+
+
+def moe_capacity(n_tokens: int, n_experts: int, k: int,
+                 capacity_factor: float) -> int:
+    """GShard capacity: per-expert slot count for a ``[n_tokens]`` batch."""
+    return max(1, int(capacity_factor * n_tokens * k / n_experts))
+
+
+def moe_ffn_apply(p: Dict[str, Any], h: jax.Array, ctx: StageCtx, *,
+                  n_experts: int, k: int = 2,
+                  capacity_factor: float = 1.25,
+                  ep_axis: Optional[str] = MODEL_AXIS):
+    """Top-k token-choice MoE FFN on LOCAL expert shards.
+
+    ``h``: ``[rows, seq, d]`` replicated over the expert axis. Returns
+    ``(out, aux_loss)`` where ``aux_loss`` is the standard load-balancing
+    auxiliary (mean over experts of fraction-routed x mean-gate, scaled by
+    E — Switch's formulation), identical on every shard.
+    """
+    if ep_axis is not None:
+        psum = lambda v: tp_allreduce(v, ep_axis)
+        h = tp_enter(h, ep_axis)
+        ep = jax.lax.psum(1, ep_axis)
+        shard = jax.lax.axis_index(ep_axis)
+        ep_static = jax.core.concrete_or_error(
+            int, ep, "expert-axis size must be static")
+        if n_experts % ep_static:
+            raise ValueError(
+                f"n_experts={n_experts} not divisible by the expert-axis "
+                f"size {ep_static}: orphaned experts would receive router "
+                f"mass but produce zero output")
+    else:
+        psum = lambda v: v
+        ep = 1
+        shard = 0
+    rows, seq, d = h.shape
+    T = rows * seq
+    E = n_experts
+    e_local = E // ep
+    x = h.reshape(T, d)
+
+    # --- router: local expert columns -> full logits via one psum ------
+    local_logits = x @ p["wr"] + p["br"]            # [T, E/ep]
+    if ep_axis is not None:
+        full = jnp.zeros((T, E), local_logits.dtype)
+        full = jax.lax.dynamic_update_slice(
+            full, local_logits, (0, shard * e_local))
+        logits_raw = psum(full)
+        # The GATING path's cotangents are shard-partial (each shard's
+        # combine touches only its local experts' terms) and softmax
+        # couples every column, so the full-logit cotangent must psum
+        # before the router weight's column slice: a second f operator.
+        # (softmax's vjp is linear in the cotangent, so psum-below ==
+        # psum-above.) The AUX path's cotangents are shard-identical
+        # (replicated aux value), so it branches off BEFORE tp_enter —
+        # through the f operator it would be overcounted ep times.
+        logits = tp_enter(logits_raw, ep_axis)
+    else:
+        logits_raw = local_logits
+        logits = local_logits
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gates_aux = jax.nn.softmax(logits_raw.astype(jnp.float32), axis=-1)
+
+    top_g, top_e = jax.lax.top_k(gates, k)          # [T, k]
+    top_g = top_g / jnp.sum(top_g, axis=-1, keepdims=True)  # renormalize
+
+    # --- capacity positions (computed identically on every shard) -----
+    C = moe_capacity(T, E, k, capacity_factor)
+    # flatten the k slots in priority order (slot 0 of every token first)
+    flat_e = top_e.T.reshape(-1)                    # [k*T]
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)      # [kT, E]
+    pos = jnp.cumsum(onehot, axis=0) - 1            # position within expert
+    flat_pos = jnp.sum(pos * onehot, axis=-1)       # [kT]
+    keep = flat_pos < C
+    flat_g = top_g.T.reshape(-1).astype(h.dtype) * keep
+
+    # --- dispatch/combine one-hots over LOCAL experts ------------------
+    le = flat_e - shard * e_local                   # local expert index
+    local = (flat_e >= shard * e_local) & (flat_e < (shard + 1) * e_local)
+    sel = local & keep
+    # [kT, E/ep, C] one-hot (0 rows where not selected)
+    disp = (jax.nn.one_hot(le, e_local, dtype=h.dtype)[:, :, None]
+            * jax.nn.one_hot(flat_pos, C, dtype=h.dtype)[:, None, :]
+            * sel[:, None, None].astype(h.dtype))
+    tok = jnp.tile(jnp.arange(T), k)                # [kT] token of each slot
+    xk = x[tok]                                     # [kT, d]
+    x_e = jnp.einsum("tec,td->ecd", disp, xk)       # [E/ep, C, d]
+
+    inner = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", x_e, p["w1"])
+                        + p["b1"][:, None])
+    y_e = jnp.einsum("ecf,efd->ecd", inner, p["w2"]) + p["b2"][:, None]
+
+    comb = disp * flat_g[:, None, None]             # gate-weighted combine
+    y_flat = jnp.einsum("tec,ecd->td", comb, y_e)   # [kT, d] partial
+    y_tok = jnp.sum(y_flat.reshape(k, T, d), axis=0)
+    out = psum(y_tok).reshape(rows, seq, d)
+
+    # --- load-balance aux (Switch): E * sum_e f_e * m_e ----------------
+    # computed from the pre-tp_enter softmax (see router note above)
+    assign1 = jax.nn.one_hot(top_e[:, 0], E, dtype=jnp.float32)
+    frac = jnp.mean(assign1, axis=0)                # fraction routed (top-1)
+    mean_gate = jnp.mean(gates_aux, axis=0)
+    aux = E * jnp.sum(frac * mean_gate)
+    return out, aux.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# MoE transformer block: TP attention + MoE FFN (the standard hybrid —
+# attention heads AND experts shard over the same innermost mesh axis)
+# ---------------------------------------------------------------------------
+
+def moe_block_init(key: jax.Array, d_model: int, nhead: int, d_ff: int,
+                   n_experts: int, dtype=jnp.float32) -> Dict[str, Any]:
+    ka, km = jax.random.split(key)
+    p = tp_attention_init(ka, d_model, nhead, dtype)   # attention + both LNs
+    p["moe"] = moe_ffn_init(km, d_model, d_ff, n_experts, dtype)
+    return p
+
+
+def moe_block_specs() -> Dict[str, Any]:
+    from .tp_layers import tp_block_specs
+    t = tp_block_specs()
+    return {
+        "ln1": t["ln1"], "wqkv": t["wqkv"], "bqkv": t["bqkv"],
+        "wo": t["wo"], "bo": t["bo"], "ln2": t["ln2"],
+        "moe": moe_ffn_specs(),
+    }
+
+
+def moe_block_apply(p: Dict[str, Any], h: jax.Array, ctx: StageCtx, *,
+                    n_experts: int, k: int = 2,
+                    capacity_factor: float = 1.25, dropout: float = 0.0,
+                    causal: bool = True,
+                    ep_axis: Optional[str] = MODEL_AXIS):
+    """Pre-LN block: TP attention sublayer, then the MoE FFN on the
+    LayerNorm'd stream with a residual add (dropped tokens pass through on
+    the residual). Returns ``(h, aux)``."""
+    key1 = key2 = None
+    if ctx.key is not None:
+        key1, key2 = jax.random.split(ctx.key)
+    h = tp_attention_sublayer(p, h, causal=causal, dropout=dropout,
+                              key=key1, tp_axis=ep_axis)
+    hn = _layernorm(h, p["ln2"])
+    # moe_ffn_apply is deterministic (no ctx.key use); key2 is reserved
+    # for the residual dropout below
+    ff, aux = moe_ffn_apply(p["moe"], hn, StageCtx(), k=k,
+                            n_experts=n_experts,
+                            capacity_factor=capacity_factor,
+                            ep_axis=ep_axis)
+    return h + _dropout(ff, dropout, key2), aux

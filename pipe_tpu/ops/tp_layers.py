@@ -49,18 +49,18 @@ from ..core.partition import StageCtx
 from ..parallel.mesh import MODEL_AXIS
 
 __all__ = ["tp_block_init", "tp_block_apply", "tp_block_specs", "tp_enter",
-           "tp_allreduce"]
+           "tp_allreduce", "tp_attention_sublayer", "tp_attention_init"]
 
 
-def tp_block_init(key: jax.Array, d_model: int, nhead: int, d_ff: int,
-                  dtype=jnp.float32) -> Dict[str, Any]:
-    """Full (unsharded) parameter tree; sharding comes from the specs."""
+def tp_attention_init(key: jax.Array, d_model: int, nhead: int,
+                      dtype=jnp.float32) -> Dict[str, Any]:
+    """Attention + both LayerNorms (the sublayer shared with the MoE
+    block); full (unsharded) shapes — sharding comes from the specs."""
     hd = d_model // nhead
     if hd * nhead != d_model:
         raise ValueError(f"d_model={d_model} not divisible by nhead={nhead}")
-    ks = jax.random.split(key, 4)
+    ks = jax.random.split(key, 2)
     s_attn = 1.0 / jnp.sqrt(d_model)
-    s_ff = 1.0 / jnp.sqrt(d_ff)
     return {
         "ln1": {"scale": jnp.ones((d_model,), dtype),
                 "bias": jnp.zeros((d_model,), dtype)},
@@ -71,11 +71,24 @@ def tp_block_init(key: jax.Array, d_model: int, nhead: int, d_ff: int,
         "bo": jnp.zeros((d_model,), dtype),
         "ln2": {"scale": jnp.ones((d_model,), dtype),
                 "bias": jnp.zeros((d_model,), dtype)},
-        "w1": jax.random.normal(ks[2], (d_model, d_ff), dtype) * s_attn,
-        "b1": jnp.zeros((d_ff,), dtype),
-        "w2": jax.random.normal(ks[3], (d_ff, d_model), dtype) * s_ff,
-        "b2": jnp.zeros((d_model,), dtype),
     }
+
+
+def tp_block_init(key: jax.Array, d_model: int, nhead: int, d_ff: int,
+                  dtype=jnp.float32) -> Dict[str, Any]:
+    """Full (unsharded) parameter tree; sharding comes from the specs."""
+    ka, kf = jax.random.split(key)
+    p = tp_attention_init(ka, d_model, nhead, dtype)
+    ks = jax.random.split(kf, 2)
+    s_in = 1.0 / jnp.sqrt(d_model)
+    s_out = 1.0 / jnp.sqrt(d_ff)
+    p.update({
+        "w1": jax.random.normal(ks[0], (d_model, d_ff), dtype) * s_in,
+        "b1": jnp.zeros((d_ff,), dtype),
+        "w2": jax.random.normal(ks[1], (d_ff, d_model), dtype) * s_out,
+        "b2": jnp.zeros((d_model,), dtype),
+    })
+    return p
 
 
 def tp_block_specs() -> Dict[str, Any]:
@@ -165,26 +178,22 @@ def _dropout(x, rate: float, key: Optional[jax.Array]):
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
 
 
-def tp_block_apply(p: Dict[str, Any], h: jax.Array, ctx: StageCtx,
-                   *, dropout: float = 0.0, causal: bool = True,
-                   tp_axis: Optional[str] = MODEL_AXIS) -> jax.Array:
-    """Pre-LN transformer block on LOCAL parameter shards.
-
-    ``h`` is replicated over the model axis (``[rows, seq, d]``); inside
-    ``shard_map`` the sharded leaves arrive as their local slices, so the
-    same code runs at tp=1 with ``tp_axis=None`` (no psum) on full params.
-    """
+def _ops_for(tp_axis):
     if tp_axis is not None:
-        psum = lambda v: tp_allreduce(v, tp_axis)
-        enter = lambda v: tp_enter(v, tp_axis)
-    else:
-        psum = enter = lambda v: v
-    rows, seq, d = h.shape
-    key1 = key2 = None
-    if ctx.key is not None:
-        key1, key2 = jax.random.split(ctx.key)
+        return (lambda v: tp_allreduce(v, tp_axis),
+                lambda v: tp_enter(v, tp_axis))
+    ident = lambda v: v
+    return ident, ident
 
-    # --- attention (local heads) ---
+
+def tp_attention_sublayer(p: Dict[str, Any], h: jax.Array, *,
+                          causal: bool, dropout: float,
+                          key: Optional[jax.Array],
+                          tp_axis: Optional[str]) -> jax.Array:
+    """Pre-LN self-attention with column/row head sharding, incl. the
+    residual add (shared by the TP block and the MoE block)."""
+    psum, enter = _ops_for(tp_axis)
+    rows, seq, d = h.shape
     hn = enter(_layernorm(h, p["ln1"]))
     qkv = jnp.einsum("bsd,dthk->btshk", hn, p["wqkv"]) + p["bqkv"][:, None]
     q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # [rows, seq, Hl, hd]
@@ -202,7 +211,25 @@ def tp_block_apply(p: Dict[str, Any], h: jax.Array, ctx: StageCtx,
     # replicated output grad, identical on every model shard, per the
     # tp_enter grad contract (no model-axis grad reduction anywhere).
     out = psum(jnp.einsum("bshk,hkd->bsd", attn, p["wo"])) + p["bo"]
-    h = h + _dropout(out, dropout, key1)
+    return h + _dropout(out, dropout, key)
+
+
+def tp_block_apply(p: Dict[str, Any], h: jax.Array, ctx: StageCtx,
+                   *, dropout: float = 0.0, causal: bool = True,
+                   tp_axis: Optional[str] = MODEL_AXIS) -> jax.Array:
+    """Pre-LN transformer block on LOCAL parameter shards.
+
+    ``h`` is replicated over the model axis (``[rows, seq, d]``); inside
+    ``shard_map`` the sharded leaves arrive as their local slices, so the
+    same code runs at tp=1 with ``tp_axis=None`` (no psum) on full params.
+    """
+    psum, enter = _ops_for(tp_axis)
+    key1 = key2 = None
+    if ctx.key is not None:
+        key1, key2 = jax.random.split(ctx.key)
+
+    h = tp_attention_sublayer(p, h, causal=causal, dropout=dropout,
+                              key=key1, tp_axis=tp_axis)
 
     # --- FFN (column then row) ---
     hn2 = enter(_layernorm(h, p["ln2"]))
