@@ -12,12 +12,52 @@ executor), so each device holds ``1/(n_stages * tp)`` of the block weights
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
 from ..core.partition import StageCtx
-from ..ops.tp_layers import tp_block_apply, tp_block_init, tp_block_specs
+from ..ops.tp_layers import (tp_block_apply, tp_block_init, tp_block_specs,
+                             tp_block_tapped, tp_block_wgrad, tp_block_zs)
 from ..parallel.mesh import MODEL_AXIS
 from .transformer_lm import LMConfig, PipelinedLM
 
-__all__ = ["TPPipelinedLM"]
+__all__ = ["TPPipelinedLM", "tp_split_backward_stage"]
+
+
+def tp_split_backward_stage(cfg: LMConfig):
+    """A :class:`~pipe_tpu.parallel.scheduled.SplitBackwardStage` for a
+    stage of TP-block layers (``tp_axis=None`` math — the structural-split
+    executor owns the parallelism axes): per-layer tapped forwards chain,
+    zs/taps are per-layer lists, and the W op is the per-layer weight-grad
+    contractions cast back to the parameter dtype. Key folding matches
+    ``PipelinedTransformer.stage_fn`` (``ctx.fold(l)`` per layer), so
+    dropout is bit-identical to the plain executor path."""
+    from ..parallel.scheduled import SplitBackwardStage
+
+    cd = cfg.compute_dtype
+
+    def cast(bp):
+        return jax.tree_util.tree_map(lambda p: p.astype(cd), bp)
+
+    def tapped_fn(params_g, h, ctx, zs):
+        taps = []
+        for l, (bp, z) in enumerate(zip(params_g, zs)):
+            h, t = tp_block_tapped(cast(bp), h, ctx.fold(l), z,
+                                   dropout=cfg.dropout, causal=cfg.causal)
+            taps.append(t)
+        return h, taps
+
+    def zs_fn(params_g, h):
+        # activation shape is ring-invariant, so one zs set per layer
+        return [tp_block_zs(h, bp) for bp in params_g]
+
+    def wgrad_fn(taps, gzs):
+        return [jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32), tp_block_wgrad(t, gz))
+            for t, gz in zip(taps, gzs)]
+
+    return SplitBackwardStage(tapped_fn=tapped_fn, wgrad_fn=wgrad_fn,
+                              zs_fn=zs_fn)
 
 
 class _TPBlock:

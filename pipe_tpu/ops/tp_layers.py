@@ -171,6 +171,14 @@ def _layernorm(x, p, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
 
 
+def _layernorm_tapped(x, p, eps=1e-5):
+    """LayerNorm returning its normalized input (the scale-grad tap)."""
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    xhat = (x - mu) * jax.lax.rsqrt(var + eps)
+    return xhat * p["scale"] + p["bias"], xhat
+
+
 def _dropout(x, rate: float, key: Optional[jax.Array]):
     if not rate or key is None:
         return x
@@ -212,6 +220,93 @@ def tp_attention_sublayer(p: Dict[str, Any], h: jax.Array, *,
     # tp_enter grad contract (no model-axis grad reduction anywhere).
     out = psum(jnp.einsum("bshk,hkd->bsd", attn, p["wo"])) + p["bo"]
     return h + _dropout(out, dropout, key)
+
+
+def tp_block_tapped(p: Dict[str, Any], h: jax.Array, ctx: StageCtx, zs,
+                    *, dropout: float = 0.0,
+                    causal: bool = True):
+    """Split-backward form of :func:`tp_block_apply` (tp_axis=None math):
+    identical forward values, plus
+
+    * ``zs``: a zero pytree (:func:`tp_block_zs`) added at each
+      param-consuming op's OUTPUT — vjp w.r.t. ``zs`` (with the params held
+      CONSTANT) yields exactly the per-op output cotangents, so the B pass
+      contains zero weight-grad matmuls by construction;
+    * returns ``(out, taps)`` where ``taps`` are the per-op INPUTS —
+      :func:`tp_block_wgrad` turns ``(taps, g_zs)`` into the parameter
+      gradients as pure tap x cotangent contractions (the W pass).
+
+    Numerics match ``tp_block_apply(..., tp_axis=None)`` bit-for-bit (the
+    zero injections are exact no-ops forward) — deliberately a separate
+    function rather than a flag on the shared sublayers so the plain path
+    carries zero split machinery; the bit-exact forward equality is pinned
+    by ``test_zb_split.py`` (``assert_array_equal``), which is the tripwire
+    if the two copies ever drift.
+    """
+    rows, seq, d = h.shape
+    key1 = key2 = None
+    if ctx.key is not None:
+        key1, key2 = jax.random.split(ctx.key)
+
+    ln1_out, xhat1 = _layernorm_tapped(h, p["ln1"])
+    hn = ln1_out + zs["ln1"]
+    qkv = (jnp.einsum("bsd,dthk->btshk", hn, p["wqkv"]) + p["bqkv"][:, None]
+           + zs["qkv"])
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    hd = q.shape[-1]
+    scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
+        jnp.asarray(hd, h.dtype))
+    if causal:
+        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+        h.dtype)
+    attn = jnp.einsum("bhst,bthk->bshk", probs, v)
+    out = jnp.einsum("bshk,hkd->bsd", attn, p["wo"]) + p["bo"] + zs["out"]
+    h = h + _dropout(out, dropout, key1)
+
+    ln2_out, xhat2 = _layernorm_tapped(h, p["ln2"])
+    hn2 = ln2_out + zs["ln2"]
+    pre_act = hn2 @ p["w1"] + p["b1"] + zs["ff1"]
+    act = jax.nn.gelu(pre_act)
+    ff = act @ p["w2"] + p["b2"] + zs["ff2"]
+    h_out = h + _dropout(ff, dropout, key2)
+    taps = {"xhat1": xhat1, "hn": hn, "attn": attn, "xhat2": xhat2,
+            "hn2": hn2, "act": act}
+    return h_out, taps
+
+
+def tp_block_zs(h: jax.Array, p: Dict[str, Any]):
+    """Zero injection points for :func:`tp_block_tapped` (shapes from the
+    activation and the param tree)."""
+    rows, seq, d = h.shape
+    _, three, H, hd = p["wqkv"].shape
+    ff = p["w1"].shape[1]
+    z = lambda *s: jnp.zeros(s, h.dtype)
+    return {"ln1": z(rows, seq, d), "qkv": z(rows, three, seq, H, hd),
+            "out": z(rows, seq, d), "ln2": z(rows, seq, d),
+            "ff1": z(rows, seq, ff), "ff2": z(rows, seq, d)}
+
+
+def tp_block_wgrad(taps: Dict[str, Any], gzs: Dict[str, Any]
+                   ) -> Dict[str, Any]:
+    """Parameter gradients from (taps, per-op output cotangents) — the W
+    pass: nothing here but the weight-grad contractions themselves."""
+    sum_b = lambda a: jnp.sum(a, axis=(0, 1))
+    return {
+        "ln1": {"scale": jnp.sum(taps["xhat1"] * gzs["ln1"], axis=(0, 1)),
+                "bias": sum_b(gzs["ln1"])},
+        "wqkv": jnp.einsum("bsd,btshk->dthk", taps["hn"], gzs["qkv"]),
+        "bqkv": jnp.sum(gzs["qkv"], axis=(0, 2)),
+        "wo": jnp.einsum("bshk,bsd->hkd", taps["attn"], gzs["out"]),
+        "bo": sum_b(gzs["out"]),
+        "ln2": {"scale": jnp.sum(taps["xhat2"] * gzs["ln2"], axis=(0, 1)),
+                "bias": sum_b(gzs["ln2"])},
+        "w1": jnp.einsum("bsd,bsf->df", taps["hn2"], gzs["ff1"]),
+        "b1": sum_b(gzs["ff1"]),
+        "w2": jnp.einsum("bsf,bsd->fd", taps["act"], gzs["ff2"]),
+        "b2": sum_b(gzs["ff2"]),
+    }
 
 
 def tp_block_apply(p: Dict[str, Any], h: jax.Array, ctx: StageCtx,

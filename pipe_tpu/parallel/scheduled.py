@@ -92,7 +92,40 @@ from ..core.schedule import (BWD, FWD, WGRAD, GPipeSchedule,
 from .mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from ..utils.rng import make_key
 
-__all__ = ["ScheduledPipeline"]
+__all__ = ["ScheduledPipeline", "SplitBackwardStage"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitBackwardStage:
+    """Structural B/W split of a stage body (zero-bubble's real contract).
+
+    The round-3 audit (docs/architecture.md) measured that applying a
+    stored vjp at both B and W executes the FULL transpose twice — XLA
+    does not prune the unused outputs inside switch branches. This
+    protocol makes the split structural instead of hoped-for:
+
+    * ``tapped_fn(params_g, h, ctx, zs) -> (h_out, taps)`` — the stage
+      forward with a zero pytree ``zs`` injected at every param-consuming
+      op's OUTPUT and the per-op INPUTS returned as ``taps``;
+    * the executor takes ``jax.vjp`` w.r.t. ``(pre, h, zs)`` with the
+      stage params CLOSED OVER AS CONSTANTS — the stored transpose
+      therefore contains zero weight-grad contractions by construction
+      (verified by HLO dot census in tests), and applying it at B yields
+      the input-grad chain plus ``g_zs``, the per-op output cotangents;
+    * ``wgrad_fn(taps, gzs) -> params_g-structured grads`` — the W op:
+      nothing but the weight-grad contractions themselves.
+
+    Pair with ``checkpoint='never'`` and a ``splits_backward`` schedule
+    (zb-h1); the executor rejects other combinations. Memory: ``taps``
+    ride ``Sg`` FIFO slots (FWD -> W window) and ``g_zs`` ride the
+    ``Wg`` cotangent-park window — both activation-scale.
+
+    ``zs_fn(params_g, h) -> zeros pytree`` sizes the injection points.
+    """
+
+    tapped_fn: Any
+    wgrad_fn: Any
+    zs_fn: Any
 
 # Auto cutoff for the d == 1 trace-time unroll (ScheduledPipeline
 # .static_unroll=None): tables longer than this use the dynamic scan — HLO
@@ -154,6 +187,10 @@ class ScheduledPipeline:
     # via the block's tp_enter operator — see ops/tp_layers.py). None =
     # every leaf replicated over non-stage axes (the homogeneous default).
     stage_param_specs: Optional[Any] = None
+    # Structural B/W split of the stage body for zero-bubble schedules —
+    # see :class:`SplitBackwardStage`. Requires checkpoint='never' and a
+    # splits_backward schedule; replaces stage_fn for fwd/bwd purposes.
+    split_stage: Optional[SplitBackwardStage] = None
     # Selective rematerialization for the RECOMPUTE micro-batches (a
     # ``jax.checkpoint_policies`` member, e.g. ``dots_saveable``): instead
     # of stashing the stage input and re-running the whole forward at
@@ -180,6 +217,26 @@ class ScheduledPipeline:
                 raise ValueError(
                     f"schedule {self.schedule!r} has no op_tables")
         self.n_stages = self.mesh.shape[STAGE_AXIS]      # devices d
+        if self.split_stage is not None:
+            if not getattr(self.schedule, "splits_backward", False):
+                raise ValueError(
+                    "split_stage requires a splits_backward schedule "
+                    "(zb-h1): B/W table ops are where the split executes")
+            if self.checkpoint != "never":
+                raise ValueError(
+                    "split_stage requires checkpoint='never': the stored "
+                    "params-constant vjp IS the activation store")
+            if self.stage_param_specs is not None:
+                raise ValueError(
+                    "split_stage does not compose with stage_param_specs "
+                    "(tensor-parallel sharded stage params): the tapped/"
+                    "wgrad fns are written for unsharded math and would "
+                    "silently drop the cross-shard psums")
+            if self.remat_policy is not None:
+                raise ValueError(
+                    "split_stage already defines its storage (full "
+                    "residuals + taps); remat_policy would be silently "
+                    "inert — drop one of the two")
         if (getattr(self.schedule, "splits_backward", False)
                 and self.checkpoint != "never"):
             warnings.warn(
@@ -215,6 +272,8 @@ class ScheduledPipeline:
         return {"cycles": self._cycles(m), "stash_slots": v * Sg,
                 "stash_slots_per_virtual_stage": Sg, "residual_slots": R,
                 "h_last_slots": Sg, "wstash_slots": v * Wg,
+                "taps_slots": (v * Sg if self.split_stage is not None
+                               else 0),
                 "virtual_stages_per_device": v}
 
     def _cycles(self, m: int) -> int:
@@ -354,6 +413,31 @@ class ScheduledPipeline:
             lambda a, b, dd: self._f_body(a, b, dd, x_mb, kis, s),
             params_g, prep, h_in)
 
+    def _f_body_split(self, params_g, prep, h_in, x_mb, kis, s, zs):
+        """Split-backward twin of :meth:`_f_body`: pre (stage 0 only) then
+        the TAPPED stage body. Returns ``(h_out, taps)``."""
+        train = True
+        h0 = jax.lax.cond(
+            s == 0,
+            lambda: self.pre_fn(prep, x_mb,
+                                StageCtx(key=jax.random.fold_in(kis, 0),
+                                         train=train,
+                                         data_axis=self.bn_axis)),
+            lambda: h_in)
+        return self.split_stage.tapped_fn(
+            params_g, h0,
+            StageCtx(key=jax.random.fold_in(kis, 1), train=train, stage=s,
+                     data_axis=self.bn_axis), zs)
+
+    def _vjp_wrt_split(self, params_g, prep, h_in, x_mb, kis, s):
+        """Params-constant vjp of the tapped body w.r.t. (pre, h, zs):
+        ``(h1, vjp_fn, taps)``; ``vjp_fn(seed) -> (gpre, gh, gzs)``."""
+        zs = self.split_stage.zs_fn(params_g, h_in)
+        return jax.vjp(
+            lambda b, dd, zz: self._f_body_split(
+                params_g, b, dd, x_mb, kis, s, zz),
+            prep, h_in, zs, has_aux=True)
+
     def _vjp_wrt_policy(self, params_g, prep, h_in, x_mb, kis, s):
         """Policy-selective vjp: residuals are only what ``remat_policy``
         saves (the backward recomputes the rest in place)."""
@@ -433,7 +517,9 @@ class ScheduledPipeline:
         res = {}       # (i, g) -> vjp_fn (policy-gated)
         h_last = {}    # i -> last virtual stage's output (pops at BWD)
         gbuf = {}      # (i, s) -> cotangent from stage s+1 (pops at BWD)
-        wpend = {}     # (i, g) -> deferred (gp, gpre) for the W slot
+        wpend = {}     # (i, g) -> deferred (gp, gpre) or (structural
+        #                split) the per-op output cotangents g_zs
+        tapsd = {}     # (i, g) -> taps (structural split only)
         g_per_group = {}
         g_pre = jax.tree_util.tree_map(jnp.zeros_like, pre_params)
         g_post = jax.tree_util.tree_map(jnp.zeros_like, post_params)
@@ -463,7 +549,12 @@ class ScheduledPipeline:
             if opj == FWD:
                 save = (mode == "never"
                         or (mode == "except_last" and i == m - 1))
-                if save:
+                if self.split_stage is not None:   # never mode guaranteed
+                    h1, vjp_fn, taps = self._vjp_wrt_split(
+                        params_g, pre_params, h_in, x_mb, kis, s)
+                    res[(i, g)] = vjp_fn
+                    tapsd[(i, g)] = taps
+                elif save:
                     h1, vjp_fn = self._vjp_wrt(
                         params_g, pre_params, h_in, x_mb, kis, s)
                     res[(i, g)] = vjp_fn
@@ -492,6 +583,15 @@ class ScheduledPipeline:
                     g_post = add(g_post, gpost)
                 else:
                     seed_h = gbuf.pop((i, s))
+                if self.split_stage is not None:
+                    # structural split: stored params-constant vjp — the
+                    # input-grad chain only; per-op cotangents park for W
+                    gpre, gh, gzs = res.pop((i, g))(seed_h)
+                    g_pre = add(g_pre, gpre)
+                    wpend[(i, g)] = gzs
+                    if s > 0:
+                        gbuf[(i, s - 1)] = gh
+                    continue
                 vjp_fn = res.pop((i, g), None)
                 if vjp_fn is None:
                     _, vjp_fn = self._vjp_wrt(
@@ -513,13 +613,19 @@ class ScheduledPipeline:
                 if not split_w:
                     stash.pop((i, s), None)
             else:                 # WGRAD
-                gp, gpre = wpend.pop((i, g))
+                if self.split_stage is not None:
+                    # structural split: pure weight-grad contractions
+                    gp = self.split_stage.wgrad_fn(tapsd.pop((i, g)),
+                                                   wpend.pop((i, g)))
+                else:
+                    gp, gpre = wpend.pop((i, g))
+                    g_pre = add(g_pre, gpre)
                 g_per_group[g] = (add(g_per_group[g], gp)
                                   if g in g_per_group else gp)
-                g_pre = add(g_pre, gpre)
                 stash.pop((i, s), None)
         assert not stash and not res and not h_last and not gbuf \
-            and not wpend, "static schedule left unconsumed state"
+            and not wpend and not tapsd, \
+            "static schedule left unconsumed state"
 
         g_sp = jax.tree_util.tree_map(
             lambda *rows: jnp.stack(rows, axis=0),
@@ -567,9 +673,17 @@ class ScheduledPipeline:
         # Canonical vjp structure (abstract — no tracers leak in):
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         key_spec = jax.eval_shape(lambda: jax.random.key(0))
-        _, vjp_fn_spec = jax.eval_shape(
-            self._vjp_wrt, params_g_spec, pre_params, h_spec,
-            x_mb_spec, key_spec, i32)
+        if self.split_stage is not None:
+            zs_spec = jax.eval_shape(self.split_stage.zs_fn,
+                                     params_g_spec, h_spec)
+            _, vjp_fn_spec, taps_spec = jax.eval_shape(
+                self._vjp_wrt_split, params_g_spec, pre_params, h_spec,
+                x_mb_spec, key_spec, i32)
+        else:
+            zs_spec = taps_spec = None
+            _, vjp_fn_spec = jax.eval_shape(
+                self._vjp_wrt, params_g_spec, pre_params, h_spec,
+                x_mb_spec, key_spec, i32)
         res_specs, res_treedef = jax.tree_util.tree_flatten(vjp_fn_spec)
         inv_wsum = 1.0 / wsum
 
@@ -618,10 +732,17 @@ class ScheduledPipeline:
         # BWD(i, S-1).
         h_last = jax.tree_util.tree_map(
             lambda s_: exact_slots_of(s_, Sg), h_spec)
-        # Deferred-W cotangent park (B -> W window), activation-sized slots.
+        # Deferred-W park (B -> W window), activation-scale slots: the
+        # downstream cotangent seed (legacy stored-vjp split) or the
+        # per-op output cotangents g_zs (structural split).
+        wpark_spec = zs_spec if self.split_stage is not None else h_spec
         wstash = (jax.tree_util.tree_map(
-            lambda s_: exact_slots_of(s_, v * Wg), h_spec)
+            lambda s_: exact_slots_of(s_, v * Wg), wpark_spec)
             if split_dce else ())
+        # Structural split: per-op input taps, FWD -> W FIFO window.
+        taps_store = (jax.tree_util.tree_map(
+            lambda s_: exact_slots_of(s_, v * Sg), taps_spec)
+            if self.split_stage is not None else ())
         n_res = self.memory_plan(m)["residual_slots"]
         res_store = ([exact_slots_of(s_, n_res) for s_ in res_specs]
                      if mode != "always" else [])
@@ -645,8 +766,8 @@ class ScheduledPipeline:
             return g  # except_last: slot g holds micro-batch m-1
 
         def cycle(carry, row):
-            (h_ring, g_ring, stash, h_last, wstash, res_store, g_sp, g_pre,
-             g_post, loss) = carry
+            (h_ring, g_ring, stash, h_last, wstash, taps_store, res_store,
+             g_sp, g_pre, g_post, loss) = carry
             op_r, mb_r, grp_r, rx_r = row
             opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
             i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
@@ -718,23 +839,39 @@ class ScheduledPipeline:
                     slot = res_slot_for(i, g)
                     return h1, [
                         jax.lax.dynamic_update_index_in_dim(st, l, slot, 0)
+                        for st, l in zip(res_store, leaves)], taps_store
+
+                def split_vjp_and_store():
+                    # structural split: params-constant vjp + taps store
+                    h1, vjp_fn, taps = self._vjp_wrt_split(
+                        params_g, pre_params, h_in, x_mb, kis, s)
+                    leaves = jax.tree_util.tree_leaves(vjp_fn)
+                    slot = res_slot_for(i, g)
+                    new_res = [
+                        jax.lax.dynamic_update_index_in_dim(st, l, slot, 0)
                         for st, l in zip(res_store, leaves)]
+                    new_taps = jax.tree_util.tree_map(
+                        lambda st, l: jax.lax.dynamic_update_index_in_dim(
+                            st, l, g * Sg + i % Sg, 0), taps_store, taps)
+                    return h1, new_res, new_taps
 
                 def body_only():
                     return (self._f_body(params_g, pre_params, h_in, x_mb,
-                                         kis, s), res_store)
+                                         kis, s), res_store, taps_store)
 
-                if mode == "always":
-                    h1, new_res = body_only()
+                if self.split_stage is not None:   # never mode guaranteed
+                    h1, new_res, new_taps = split_vjp_and_store()
+                elif mode == "always":
+                    h1, new_res, new_taps = body_only()
                 elif mode == "never":
-                    h1, new_res = vjp_and_store()
+                    h1, new_res, new_taps = vjp_and_store()
                 else:
                     # except_last: ONLY micro-batch m-1 pays the residual
                     # capture and store; the rest run the plain body (they
                     # recompute at BWD). Without the gate every forward
                     # would stream a full residual set into a sentinel slot
                     # — wasted HBM traffic and a doubled store.
-                    h1, new_res = jax.lax.cond(
+                    h1, new_res, new_taps = jax.lax.cond(
                         i == m - 1, vjp_and_store, body_only)
                 is_last = s == S - 1
                 # loss contribution: forward value only (its vjp is rebuilt
@@ -750,8 +887,8 @@ class ScheduledPipeline:
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, i % Sg, 0), h_last, h1),
                     lambda: h_last)
-                return (new_h_last, wstash, new_res, g_sp, g_pre, g_post,
-                        loss + contrib, h1, g_ring)
+                return (new_h_last, wstash, new_taps, new_res, g_sp, g_pre,
+                        g_post, loss + contrib, h1, g_ring)
 
             def bwd_branch():
                 is_last = s == S - 1
@@ -776,9 +913,30 @@ class ScheduledPipeline:
                                                    post_params), g_ring)
 
                 gpost, seed_h = jax.lax.cond(is_last, post_seed, ring_seed)
+                add = functools.partial(jax.tree_util.tree_map, jnp.add)
+
+                if self.split_stage is not None:
+                    # structural split: the stored params-constant vjp IS
+                    # the input-grad chain (zero weight-grad contractions
+                    # in it by construction); per-op output cotangents
+                    # park for W, pre grads accumulate here (edge-stage
+                    # embed path only).
+                    slot = res_slot_for(i, g)
+                    leaves = [
+                        jax.lax.dynamic_index_in_dim(st, slot, 0,
+                                                     keepdims=False)
+                        for st in res_store]
+                    vjp_fn = jax.tree_util.tree_unflatten(res_treedef,
+                                                          leaves)
+                    gpre, gh, gzs = vjp_fn(seed_h)
+                    new_wstash = jax.tree_util.tree_map(
+                        lambda st, l: jax.lax.dynamic_update_index_in_dim(
+                            st, l, g * Wg + i % Wg, 0), wstash, gzs)
+                    return (h_last, new_wstash, taps_store, res_store,
+                            g_sp, add(g_pre, gpre), add(g_post, gpost),
+                            loss, h_ring, gh)
 
                 gp, gpre, gh = apply_vjp(seed_h)
-                add = functools.partial(jax.tree_util.tree_map, jnp.add)
                 if split_dce:
                     # split backward, stored residuals: B emits only the
                     # input grad (XLA DCE prunes the unused weight-grad
@@ -787,17 +945,33 @@ class ScheduledPipeline:
                     new_wstash = jax.tree_util.tree_map(
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, g * Wg + i % Wg, 0), wstash, seed_h)
-                    return (h_last, new_wstash, res_store, g_sp, g_pre,
-                            add(g_post, gpost), loss, h_ring, gh)
+                    return (h_last, new_wstash, taps_store, res_store,
+                            g_sp, g_pre, add(g_post, gpost), loss,
+                            h_ring, gh)
                 # combined backward (non-split tables), or a split table
                 # under a recompute mode — the vjp was just built from the
                 # single forward recompute, so weight grads accumulate here
                 # and the table's W slot (if any) is a no-op.
-                return (h_last, wstash, res_store, scatter_gp(g_sp, gp),
-                        add(g_pre, gpre), add(g_post, gpost), loss,
-                        h_ring, gh)
+                return (h_last, wstash, taps_store, res_store,
+                        scatter_gp(g_sp, gp), add(g_pre, gpre),
+                        add(g_post, gpost), loss, h_ring, gh)
 
             def wgrad_branch():
+                add = functools.partial(jax.tree_util.tree_map, jnp.add)
+                if self.split_stage is not None:
+                    # structural split: NOTHING here but the weight-grad
+                    # contractions from (taps, per-op cotangents).
+                    taps = jax.tree_util.tree_map(
+                        lambda st: jax.lax.dynamic_index_in_dim(
+                            st, g * Sg + i % Sg, 0, keepdims=False),
+                        taps_store)
+                    gzs = jax.tree_util.tree_map(
+                        lambda st: jax.lax.dynamic_index_in_dim(
+                            st, g * Wg + i % Wg, 0, keepdims=False), wstash)
+                    gp = self.split_stage.wgrad_fn(taps, gzs)
+                    return (h_last, wstash, taps_store, res_store,
+                            scatter_gp(g_sp, gp), g_pre, g_post, loss,
+                            h_ring, g_ring)
                 if not split_dce:
                     # recompute modes: full backward already ran at B.
                     return idle_branch()
@@ -805,31 +979,31 @@ class ScheduledPipeline:
                     lambda st: jax.lax.dynamic_index_in_dim(
                         st, g * Wg + i % Wg, 0, keepdims=False), wstash)
                 gp, gpre, _ = apply_vjp(seed_h)
-                add = functools.partial(jax.tree_util.tree_map, jnp.add)
-                return (h_last, wstash, res_store, scatter_gp(g_sp, gp),
-                        add(g_pre, gpre), g_post, loss, h_ring, g_ring)
+                return (h_last, wstash, taps_store, res_store,
+                        scatter_gp(g_sp, gp), add(g_pre, gpre), g_post,
+                        loss, h_ring, g_ring)
 
             def idle_branch():
-                return (h_last, wstash, res_store, g_sp, g_pre, g_post,
-                        loss, h_ring, g_ring)
+                return (h_last, wstash, taps_store, res_store, g_sp, g_pre,
+                        g_post, loss, h_ring, g_ring)
 
             branches = [idle_branch, fwd_branch, bwd_branch]
             if has_w:
                 branches.append(wgrad_branch)
-            (h_last2, wstash2, res_store2, g_sp2, g_pre2, g_post2, loss2,
-             tx_h, tx_g) = jax.lax.switch(opj, branches)
+            (h_last2, wstash2, taps2, res_store2, g_sp2, g_pre2, g_post2,
+             loss2, tx_h, tx_g) = jax.lax.switch(opj, branches)
 
             if d > 1:
                 tx_h = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm), tx_h)
                 tx_g = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm), tx_g)
-            return (tx_h, tx_g, stash, h_last2, wstash2, res_store2, g_sp2,
-                    g_pre2, g_post2, loss2), None
+            return (tx_h, tx_g, stash, h_last2, wstash2, taps2, res_store2,
+                    g_sp2, g_pre2, g_post2, loss2), None
 
-        carry0 = (h_ring, g_ring, stash, h_last, wstash, res_store, g_sp,
-                  g_pre, g_post, loss0)
-        (_, _, _, _, _, _, g_sp, g_pre, g_post, loss), _ = jax.lax.scan(
+        carry0 = (h_ring, g_ring, stash, h_last, wstash, taps_store,
+                  res_store, g_sp, g_pre, g_post, loss0)
+        (_, _, _, _, _, _, _, g_sp, g_pre, g_post, loss), _ = jax.lax.scan(
             cycle, carry0, xs)
 
         # --- cross-device reductions ------------------------------------

@@ -1,0 +1,83 @@
+"""Head-to-head: 1f1b vs zb-h1 (legacy stored-vjp) vs zb-h1 structural
+split, same TP-block model, cpu8 virtual mesh.
+
+The round-3 audit measured the legacy split at 1.70-1.83x 1f1b sec/step —
+both B and W execute the full stored transpose. The structural split
+(SplitBackwardStage) makes B params-constant and W contraction-only, so
+total compute returns to one backward per micro-batch; on the serialized
+single-core host the remaining gap vs 1f1b is extra cycles x machinery
+only. Prints one JSON line; committed as the honest zb-h1 cost record.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main(n_stages=4, m=8, d_model=128, d_ff=512, seq_len=32, iters=3):
+    from pipe_tpu.utils.platform import force_cpu_platform
+    force_cpu_platform(8)
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from pipe_tpu.core import microbatch as mb
+    from pipe_tpu.models.tp_lm import (TPPipelinedLM,
+                                       tp_split_backward_stage)
+    from pipe_tpu.models.transformer_lm import LMConfig
+    from pipe_tpu.parallel.mesh import make_mesh
+    from pipe_tpu.parallel.scheduled import ScheduledPipeline
+    from pipe_tpu.parallel.spmd import stack_stage_params
+
+    cfg = dataclasses.replace(
+        LMConfig().tiny(), d_model=d_model, nhead=4, d_ff=d_ff,
+        seq_len=seq_len, n_layers=n_stages, dropout=0.0, vocab=512)
+    model = TPPipelinedLM(cfg, n_stages, tp_axis=None)
+    sp, prep, postp = model.init(jax.random.key(0))
+    stacked = stack_stage_params(sp)
+    mesh = make_mesh(n_stages, 1, devices=jax.devices()[:n_stages])
+    tokens = jax.random.randint(jax.random.key(1), (4 * m, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    x, n_rows = mb.stack_scatter(
+        {"tokens": tokens, "targets": jnp.roll(tokens, -1, -1)}, m)
+    w = mb.valid_row_mask(x, n_rows)
+
+    variants = {
+        "1f1b": dict(schedule="1f1b"),
+        "zb-h1-legacy": dict(schedule="zb-h1"),
+        "zb-h1-split": dict(schedule="zb-h1",
+                            split_stage=tp_split_backward_stage(cfg)),
+    }
+    out = {"platform": "cpu8", "n_stages": n_stages, "chunks": m,
+           "d_model": d_model, "variants": {}}
+    for name, kw in variants.items():
+        pipe = ScheduledPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
+                                 post_fn=model.loss_post_fn,
+                                 checkpoint="never", **kw)
+        lg = jax.jit(lambda s, pipe=pipe: pipe.loss_and_grad(
+            s, prep, postp, x, w))
+        jax.block_until_ready(lg(stacked))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = lg(stacked)
+        jax.block_until_ready(r)
+        sec = (time.perf_counter() - t0) / iters
+        out["variants"][name] = {"sec_per_step": round(sec, 5)}
+    base = out["variants"]["1f1b"]["sec_per_step"]
+    for v in out["variants"].values():
+        v["vs_1f1b"] = round(v["sec_per_step"] / base, 4)
+    return out
+
+
+if __name__ == "__main__":
+    kw = {}
+    for a in sys.argv[1:]:
+        k, v = a.lstrip("-").split("=", 1)
+        kw[k.replace("-", "_")] = int(v)
+    print(json.dumps(main(**kw)))
