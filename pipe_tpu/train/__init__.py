@@ -3,8 +3,10 @@
 from .loop import Trainer, TrainerConfig
 from .state import (TrainState, latest_step, restore_checkpoint,
                     save_checkpoint)
+from .zero import moment_shardings, shard_moments, zero_report
 
 __all__ = [
     "Trainer", "TrainerConfig", "TrainState",
     "save_checkpoint", "restore_checkpoint", "latest_step",
+    "moment_shardings", "shard_moments", "zero_report",
 ]

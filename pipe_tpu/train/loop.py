@@ -57,6 +57,17 @@ class TrainerConfig:
     # lines (train/loss, train/ppl, train/tok_s, train/ms_batch, train/lr,
     # pipeline/bubble) plus per-epoch train/epoch_loss and eval/loss.
     tb_dir: Optional[str] = None
+    # ZeRO-1: shard Adam's moments over the data axis (each data replica
+    # owns 1/n_data of the optimizer state; the update runs sharded and the
+    # refreshed params are all-gathered — see train/zero.py). Layout-only:
+    # matches the replicated optimizer up to float reduction order.
+    zero: bool = False
+    # Ring depth for the native batch prefetcher (C++ producer thread
+    # assembling batches off the hot loop, data/native.py BatchPrefetcher);
+    # 0 = assemble inline with get_batch (identical batches either way —
+    # asserted in tests/test_prefetch.py). Falls back to inline assembly
+    # when no C++ toolchain is available.
+    prefetch_depth: int = 0
 
 
 class Trainer:
@@ -140,6 +151,10 @@ class Trainer:
             optax.clip_by_global_norm(cfg.grad_clip),
             optax.scale_by_adam(),
         )
+        # ZeRO-1 layout trees; populated by init_state (they need concrete
+        # placed params). The jitted step traces on first call, after that.
+        self._zero_shardings = None
+        self._param_shardings = None
         self._step_fn = jax.jit(self._train_step, donate_argnums=(0,))
         self._eval_fn = jax.jit(self._eval_loss)
         if cfg.tb_dir is not None:
@@ -164,6 +179,13 @@ class Trainer:
         # leaf then carries a mesh sharding — required both for checkpoint
         # restore (the template's shardings drive orbax) and for multi-chip.
         opt_state = self._replicate_unsharded(self.tx.init(params))
+        if self.cfg.zero:
+            from . import zero
+            self._zero_shardings = zero.moment_shardings(
+                self.mesh, params, opt_state)
+            self._param_shardings = jax.tree_util.tree_map(
+                lambda a: a.sharding, params)
+            opt_state = zero.shard_moments(opt_state, self._zero_shardings)
         step = self._replicate_unsharded(jnp.zeros((), jnp.int32))
         return TrainState(params=params, opt_state=opt_state, step=step)
 
@@ -239,6 +261,22 @@ class Trainer:
                                             state.params)
         updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
         params = optax.apply_updates(state.params, updates)
+        if self.cfg.zero:
+            # ZeRO-1 layout pins: new moments stay data-sharded (XLA then
+            # partitions the Adam update over the data axis), new params
+            # return to their data-replicated placement (XLA inserts the
+            # ZeRO all-gather here).
+            from . import zero
+            if self._zero_shardings is None:
+                raise RuntimeError(
+                    "TrainerConfig(zero=True) requires init_state() to run "
+                    "before the first step (it derives the ZeRO layout from "
+                    "the placed params)")
+            opt_state = zero.constrain_moments(opt_state,
+                                               self._zero_shardings)
+            params = jax.tree_util.tree_map(
+                lambda a, s: jax.lax.with_sharding_constraint(a, s),
+                params, self._param_shardings)
         return TrainState(params=params, opt_state=opt_state,
                           step=state.step + 1), loss
 
@@ -252,6 +290,35 @@ class Trainer:
         x = {"tokens": jnp.asarray(data), "targets": jnp.asarray(target)}
         stacked, n_rows = mb.stack_scatter(x, self.cfg.chunks)
         return stacked, mb.valid_row_mask(stacked, n_rows)
+
+    def _batches(self, source: np.ndarray, n: int):
+        """Yield up to ``n`` full (data, target) batches.
+
+        With ``prefetch_depth > 0`` (and a toolchain), assembly runs on the
+        native producer thread; the yielded slot views are copied before
+        handing out because jax CPU arrays may alias aligned host numpy
+        buffers, and a slot may be overwritten as soon as the iterator
+        advances past it — a small memcpy, the transpose gather stays off
+        the hot loop.
+        Otherwise: inline ``get_batch`` (the reference's walk), stopping at
+        the first short tail batch to keep shapes static.
+        """
+        cfg = self.cfg
+        if cfg.prefetch_depth > 0:
+            from ..data.native import BatchPrefetcher, prefetch_available
+            if prefetch_available():
+                with BatchPrefetcher(source, cfg.bptt,
+                                     depth=cfg.prefetch_depth) as pf:
+                    for i, (d, t) in enumerate(pf):
+                        if i >= n:
+                            break
+                        yield d.copy(), t.copy()
+                return
+        for b in range(n):
+            data, target = lm_text.get_batch(source, b * cfg.bptt, cfg.bptt)
+            if data.shape[1] < cfg.bptt:  # tail batch: keep shapes static
+                return
+            yield data, target
 
     # --- epochs ---
 
@@ -273,10 +340,7 @@ class Trainer:
         t_first = t0 = time.perf_counter()
         losses = []
         w = None
-        for b in range(n):
-            data, target = lm_text.get_batch(source, b * cfg.bptt, cfg.bptt)
-            if data.shape[1] < cfg.bptt:  # tail batch: keep shapes static
-                break
+        for b, (data, target) in enumerate(self._batches(source, n)):
             x, mask = self._make_x(data, target)
             # Row count is constant until the tail-batch break, so the valid-
             # row mask is too — build it once, not per step.
