@@ -19,29 +19,35 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["native_available", "NativeCorpus", "process_corpus"]
+__all__ = ["native_available", "NativeCorpus", "process_corpus",
+           "prefetch_available", "BatchPrefetcher"]
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "csrc", "pipetpu_io.cpp")
-_LIB = os.path.join(os.path.dirname(_SRC), "libpipetpu_io.so")
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SRC = os.path.join(_CSRC, "pipetpu_io.cpp")
+_LIB = os.path.join(_CSRC, "libpipetpu_io.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
 
-def _build() -> Optional[str]:
-    """Compile the shared library if missing or stale; None on failure."""
+def _build_lib(src: str, lib: str, *extra_flags: str) -> Optional[str]:
+    """Compile a shared library if missing or stale; None on failure."""
     try:
-        if (not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        if (not os.path.exists(lib)
+                or os.path.getmtime(lib) < os.path.getmtime(src)):
             subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
-                 "-o", _LIB],
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                 *extra_flags, src, "-o", lib],
                 check=True, capture_output=True, timeout=120)
-        return _LIB
+        return lib
     except (OSError, subprocess.SubprocessError):
         return None
+
+
+def _build() -> Optional[str]:
+    return _build_lib(_SRC, _LIB)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -164,3 +170,116 @@ def process_corpus(path: Optional[str] = None, text: Optional[str] = None
     vocab = lm_text.Vocab(map(lm_text.basic_english_tokenize, lines))
     return lm_text.data_process(lines, vocab), \
         [vocab.lookup_token(i) for i in range(len(vocab))]
+
+
+# --- native batch prefetcher (csrc/pipetpu_prefetch.cpp) ---
+
+_PF_SRC = os.path.join(_CSRC, "pipetpu_prefetch.cpp")
+_PF_LIB = os.path.join(_CSRC, "libpipetpu_prefetch.so")
+
+_pf_lib: Optional[ctypes.CDLL] = None
+_pf_build_failed = False
+
+
+def _load_prefetch() -> Optional[ctypes.CDLL]:
+    global _pf_lib, _pf_build_failed
+    with _lock:
+        if _pf_lib is not None or _pf_build_failed:
+            return _pf_lib
+        path = _build_lib(_PF_SRC, _PF_LIB, "-pthread")
+        if path is None:
+            _pf_build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.ptpf_create.restype = ctypes.c_void_p
+        lib.ptpf_create.argtypes = [i32p, ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.c_int64, ctypes.c_int64,
+                                    i32p, i32p]
+        lib.ptpf_num_batches.restype = ctypes.c_int64
+        lib.ptpf_num_batches.argtypes = [ctypes.c_void_p]
+        lib.ptpf_next.restype = ctypes.c_int64
+        lib.ptpf_next.argtypes = [ctypes.c_void_p]
+        lib.ptpf_release.restype = None
+        lib.ptpf_release.argtypes = [ctypes.c_void_p]
+        lib.ptpf_free.restype = None
+        lib.ptpf_free.argtypes = [ctypes.c_void_p]
+        _pf_lib = lib
+        return _pf_lib
+
+
+def prefetch_available() -> bool:
+    return _load_prefetch() is not None
+
+
+class BatchPrefetcher:
+    """Iterator over (data, target) LM batches assembled by a C++ thread.
+
+    Matches the trainer's ``get_batch`` walk exactly (``lm_text.get_batch``
+    slice + transpose per full batch; tail batches are never yielded — the
+    trainer breaks on them anyway), but the assembly runs on a producer
+    thread writing into a ``depth``-slot ring of pre-allocated buffers, so
+    batch prep overlaps device compute.
+
+    Double-buffer contract: the arrays yielded for batch ``b`` are views
+    into ring slot ``b % depth`` and are valid ONLY until the next
+    ``__next__`` call — advancing the iterator releases the previous slot
+    back to the producer, which may immediately start overwriting it.
+    Callers that keep references across iterations must ``.copy()``
+    (``Trainer._batches`` does).
+    """
+
+    def __init__(self, source: np.ndarray, bptt: int, depth: int = 2):
+        lib = _load_prefetch()
+        if lib is None:
+            raise RuntimeError("native prefetch library unavailable")
+        if source.ndim != 2:
+            raise ValueError(f"source must be [nbatch, bsz], got "
+                             f"{source.shape}")
+        if bptt <= 0 or depth <= 0:
+            raise ValueError("bptt and depth must be positive")
+        self._lib = lib
+        # keep the producer's input alive and contiguous for its lifetime
+        self._source = np.ascontiguousarray(source, dtype=np.int32)
+        nrows, bsz = self._source.shape
+        self._data = np.empty((depth, bsz, bptt), np.int32)
+        self._target = np.empty((depth, bsz, bptt), np.int32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        self._h = lib.ptpf_create(
+            self._source.ctypes.data_as(i32p), nrows, bsz, bptt, depth,
+            self._data.ctypes.data_as(i32p),
+            self._target.ctypes.data_as(i32p))
+        if not self._h:
+            raise MemoryError("native prefetcher creation failed")
+        self._outstanding = False
+        self.num_batches = int(lib.ptpf_num_batches(self._h))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._h is None:
+            raise StopIteration
+        if self._outstanding:
+            self._lib.ptpf_release(self._h)
+            self._outstanding = False
+        slot = int(self._lib.ptpf_next(self._h))
+        if slot < 0:
+            self.close()
+            raise StopIteration
+        self._outstanding = True
+        return self._data[slot], self._target[slot]
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ptpf_free(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        self.close()
