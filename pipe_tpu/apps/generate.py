@@ -1,0 +1,148 @@
+"""Generation driver: sample from a (trained or fresh) pipelined LM.
+
+The reference has no inference path at all (``main.py`` trains and
+evaluates loss only); this driver completes the loop: restore a
+``train/state.py`` checkpoint (or init fresh weights), then sample
+continuations with the KV-cached generator — single-device, or
+ring-pipelined over a stage mesh when ``--stages > 1`` (the weights stay
+in their stage-sharded training layout).
+
+Usage:
+    python -m pipe_tpu.apps.generate [--resume DIR] [--prompt "ids,..."]
+        [--max-new N] [--temperature T] [--top-k K] [--stages N]
+        [--tiny] [--cpu N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--resume", default=None,
+                   help="checkpoint dir (train/state.py layout); default: "
+                        "fresh random init")
+    p.add_argument("--prompt", default="1,2,3,4",
+                   help="comma-separated prompt token ids (one sequence; "
+                        "repeated to fill the batch)")
+    p.add_argument("--batch", type=int, default=None,
+                   help="batch size (default: stages, the ring group count)")
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--stages", type=int, default=1,
+                   help=">1: ring-pipelined decode over a stage mesh")
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--cpu", type=int, default=0,
+                   help="force N virtual CPU devices (testing without TPU)")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    if args.cpu:
+        from ..utils.platform import force_cpu_platform
+        force_cpu_platform(args.cpu)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..inference import GenerationConfig, Generator
+    from ..models.transformer_lm import LMConfig, PipelinedLM
+
+    model_cfg = LMConfig()
+    if args.tiny:
+        model_cfg = model_cfg.tiny()
+    n_stages = max(args.stages, 1)
+    model = PipelinedLM(model_cfg, n_stages)
+
+    # validate cheap inputs before any parameter materialization
+    ids = [int(t) for t in args.prompt.split(",") if t.strip()]
+    if not ids or any(i < 0 or i >= model_cfg.vocab for i in ids):
+        print(f"prompt ids must be in [0, {model_cfg.vocab})",
+              file=sys.stderr)
+        return 2
+    batch = args.batch if args.batch is not None else n_stages
+    if n_stages > 1 and batch % n_stages:
+        print(f"--batch {batch} must divide into --stages {n_stages} "
+              "ring groups", file=sys.stderr)
+        return 2
+    if args.resume and not os.path.isdir(args.resume):
+        print(f"--resume {args.resume}: no such directory", file=sys.stderr)
+        return 2
+
+    if args.resume:
+        from ..parallel.spmd import stack_stage_params, unstack_stage_params
+        from ..train.state import (checkpoint_params_layout,
+                                   read_params_layout, restore_params)
+        # Trainer checkpoints hold stage-STACKED params in the layout of
+        # the TRAINING stage count. Read that layout from metadata, restore
+        # only the params subtree (optimizer state is training-only) with
+        # an abstract template (no throwaway init), then regroup the flat
+        # block sequence into the SERVING stage count — train and serve
+        # partitions need not match.
+        n_saved, lps_saved = checkpoint_params_layout(args.resume)
+        if n_saved * lps_saved != model_cfg.n_layers:
+            print(f"checkpoint holds {n_saved}x{lps_saved} blocks but the "
+                  f"model has {model_cfg.n_layers} layers", file=sys.stderr)
+            return 2
+        saved_model = PipelinedLM(model_cfg, n_saved)
+
+        def template_fn(key):
+            sp, pre, post = saved_model.init(key)
+            return (stack_stage_params(sp), pre, post)
+
+        template = jax.eval_shape(template_fn, jax.random.key(0))
+        ssp, pre, post = restore_params(args.resume, template)
+        # detach from the TRAINING mesh placement the checkpoint recorded —
+        # the serving mesh may have a different device count
+        ssp, pre, post = jax.tree_util.tree_map(np.asarray,
+                                                (ssp, pre, post))
+        # flat layer order. Interleaved-schedule training stacks virtual
+        # stages device-major-permuted; the layout record written by
+        # Trainer.save tells us to invert that (the permutation convention
+        # lives with its owner: parallel/interleaved.py). Without a
+        # record, plain stage-major stacking is assumed.
+        layout = read_params_layout(args.resume) or {}
+        if layout.get("stacking") == "interleaved":
+            from ..parallel.interleaved import unstack_interleaved_params
+            d = n_saved // int(layout["interleave"])
+            per_stage = unstack_interleaved_params(ssp, d)
+        else:
+            per_stage = unstack_stage_params(ssp, n_saved)
+        flat = [blk for stage in per_stage for blk in stage]
+        lps = model_cfg.n_layers // n_stages
+        params = ([flat[s * lps:(s + 1) * lps] for s in range(n_stages)],
+                  pre, post)
+    else:
+        params = model.init(jax.random.key(args.seed))
+    prompt = jnp.asarray([ids] * batch, jnp.int32)
+    gen_cfg = GenerationConfig(max_new_tokens=args.max_new,
+                               temperature=args.temperature,
+                               top_k=args.top_k)
+    key = jax.random.key(args.seed + 1)
+
+    if n_stages > 1:
+        from ..inference.pipelined import PipelinedGenerator
+        from ..parallel.mesh import make_mesh
+        from ..parallel.spmd import stack_stage_params
+        sp, pre, post = params
+        mesh = make_mesh(n_stages, 1)
+        out = PipelinedGenerator(mesh, model, gen_cfg).generate(
+            stack_stage_params(sp), pre, post, prompt, key=key)
+    else:
+        out = Generator(model, gen_cfg).generate(params, prompt, key=key)
+
+    for row in np.asarray(out):
+        print(",".join(str(int(t)) for t in row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

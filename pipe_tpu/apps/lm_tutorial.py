@@ -63,7 +63,7 @@ def main(argv=None) -> int:
     from pipe_tpu.data import lm_text
     from pipe_tpu.models.transformer_lm import LMConfig
     from pipe_tpu.train.loop import Trainer, TrainerConfig
-    from pipe_tpu.train.state import restore_checkpoint, save_checkpoint
+    from pipe_tpu.train.state import restore_checkpoint
 
     train_lines, val_lines, _ = lm_text.load_corpus(args.corpus)
     vocab = lm_text.Vocab(map(lm_text.basic_english_tokenize, train_lines))
@@ -115,7 +115,7 @@ def main(argv=None) -> int:
         val_loss = trainer.evaluate(val_data, state, max_steps=4)
         print(f"val loss {val_loss:.3f}")
     if args.save:
-        save_checkpoint(args.save, state, int(state.step))
+        trainer.save(args.save, state)  # records the stage-stack layout
         print(f"checkpoint saved to {args.save} @ step {int(state.step)}")
     print(f"final train loss {metrics['loss']:.3f} "
           f"({metrics['sec_per_step']*1000:.1f} ms/step)")

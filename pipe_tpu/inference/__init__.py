@@ -1,0 +1,7 @@
+"""Inference: KV-cached autoregressive generation over the pipelined LMs."""
+
+from .generate import GenerationConfig, Generator, sample_logits
+from .pipelined import PipelinedGenerator
+
+__all__ = ["GenerationConfig", "Generator", "PipelinedGenerator",
+           "sample_logits"]

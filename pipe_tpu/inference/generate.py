@@ -1,0 +1,175 @@
+"""Autoregressive generation with KV caches over the pipelined LM families.
+
+The reference package is training-only — its tutorial never samples from
+the model it trains (``/root/reference/main.py`` has no generate loop). A
+complete framework needs the inference surface too, so this module supplies
+it the TPU way: one jitted program per (prompt_len, max_new_tokens) shape —
+prefill fills every layer's KV cache in a single batched pass (MXU-sized
+matmuls), then a ``lax.scan`` emits one token per step with O(1) work per
+layer (the cache turns attention from O(t^2) re-forward into O(t) reads).
+Static shapes throughout: the cache is allocated at ``prompt + max_new``
+up front, masking handles the live prefix — no dynamic shapes, so XLA
+compiles one fast program instead of recompiling per step.
+
+Sampling: greedy (``temperature=0``), temperature softmax, optional top-k
+truncation — all inside the scan, driven by an explicit PRNG key chain
+(same key => same sample, the package-wide reproducibility contract).
+
+Layer math lives with the layers (``MultiHeadAttention.decode``,
+``TransformerEncoderLayer.decode``, ``PreLNBlock.decode`` in
+``ops/layers.py``) so cached decode and training forward can never drift
+apart; ``tests/test_generate.py`` pins teacher-forced cached logits against
+the full training forward and greedy cached generation against a naive
+re-forward loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GenerationConfig", "Generator", "check_positions",
+           "head_logits", "sample_logits"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 32
+    temperature: float = 1.0   # 0 = greedy (argmax)
+    top_k: Optional[int] = None  # None = full distribution
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+
+
+def check_positions(model, prompt_len: int, max_new_tokens: int) -> None:
+    """Fail loudly when decode would run past the positional table —
+    ``embed_at``'s dynamic slice clamps at the edge, which would silently
+    reuse the last rows instead of erroring like the training path."""
+    pe = getattr(getattr(model, "posenc", None), "pe", None)
+    if pe is not None and prompt_len + max_new_tokens > pe.shape[0]:
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+            f"exceeds the positional table ({pe.shape[0]} positions)")
+
+
+def head_logits(model, post_params, h: jax.Array) -> jax.Array:
+    """The model head on hidden states (float32 logits) — ONE definition
+    shared by the single-device and ring-pipelined generators."""
+    return model.head.apply(post_params[model.post_key],
+                            h.astype(jnp.float32))
+
+
+def sample_logits(logits: jax.Array, key: jax.Array,
+                  cfg: GenerationConfig) -> jax.Array:
+    """Next-token ids ``[b]`` from ``logits [b, vocab]`` (float32 math)."""
+    logits = logits.astype(jnp.float32)
+    if cfg.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / cfg.temperature
+    if cfg.top_k is not None:
+        kth = jax.lax.top_k(logits, cfg.top_k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits,
+                           jnp.asarray(-1e30, logits.dtype))
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+class Generator:
+    """KV-cached sampling over a :class:`~.models.common.PipelinedTransformer`
+    LM factorization (``PipelinedLM`` and friends: ``embed_at`` + causal
+    ``block.decode`` + ``post_fn`` head).
+
+    ``generate`` is jitted per (batch, prompt_len) shape; params are the
+    ``(stage_params, pre_params, post_params)`` triple from ``model.init``
+    (the training layout — no weight conversion between train and serve).
+    """
+
+    def __init__(self, model, gen_cfg: GenerationConfig = GenerationConfig()):
+        if not hasattr(model, "embed_at"):
+            raise TypeError(
+                f"{type(model).__name__} has no embed_at; KV-cache "
+                "generation needs position-offset embedding")
+        self.model = model
+        self.gen_cfg = gen_cfg
+        self._jitted = jax.jit(self._generate)
+
+    # --- internals ---
+
+    def _blocks(self, stage_params):
+        """Flatten the per-stage block lists into one [block0..blockL-1]
+        list, cast to compute dtype (stage_fn's contract)."""
+        cd = self.model.cfg.compute_dtype
+        flat = [bp for stage in stage_params for bp in stage]
+        return [jax.tree_util.tree_map(lambda p: p.astype(cd), bp)
+                for bp in flat]
+
+    def _head(self, post_params, h):
+        return head_logits(self.model, post_params, h)
+
+    def _generate(self, params, prompt, key):
+        m, gen = self.model, self.gen_cfg
+        stage_params, pre_params, post_params = params
+        blocks = self._blocks(stage_params)
+        b, p = prompt.shape
+        max_len = p + gen.max_new_tokens
+        caches = [m.block.attn.make_cache(b, max_len,
+                                          dtype=m.cfg.compute_dtype)
+                  for _ in blocks]
+
+        # prefill: one batched causal pass writes rows [0, p) of every cache
+        h = m.embed_at(pre_params, prompt, 0)
+        for l, bp in enumerate(blocks):
+            h, caches[l] = m.block.decode(bp, h, caches[l], 0)
+        key, sub = jax.random.split(key)
+        tok = sample_logits(self._head(post_params, h[:, -1:, :])[:, 0, :],
+                            sub, gen)
+
+        # decode: one token per scan step, O(1) new work per layer
+        cache_stack = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *caches)
+        block_stack = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *blocks)
+
+        def layer_step(h_carry, inp):
+            bp, cache = inp
+            h_new, cache = m.block.decode(bp, h_carry[0], cache, h_carry[1])
+            return (h_new, h_carry[1]), cache
+
+        def step(carry, _):
+            caches, tok, pos, key = carry
+            h = m.embed_at(pre_params, tok[:, None], pos)
+            (h, _), caches = jax.lax.scan(
+                layer_step, (h, pos), (block_stack, caches))
+            key, sub = jax.random.split(key)
+            nxt = sample_logits(self._head(post_params, h)[:, 0, :],
+                                sub, gen)
+            return (caches, nxt, pos + 1, key), tok
+
+        (_, last, _, _), toks = jax.lax.scan(
+            step, (cache_stack, tok, jnp.int32(p), key), None,
+            length=gen.max_new_tokens - 1)
+        # toks holds the tokens *entering* each step; append the final one
+        out = jnp.moveaxis(toks, 0, 1)  # [b, max_new-1]
+        return jnp.concatenate([out, last[:, None]], axis=1)
+
+    # --- public ---
+
+    def generate(self, params, prompt: jax.Array,
+                 key: Optional[jax.Array] = None) -> jax.Array:
+        """Sample ``[b, max_new_tokens]`` continuations of ``prompt
+        [b, prompt_len]`` int32 ids."""
+        if key is None:
+            key = jax.random.key(0)
+        check_positions(self.model, prompt.shape[1],
+                        self.gen_cfg.max_new_tokens)
+        return self._jitted(params, jnp.asarray(prompt, jnp.int32), key)

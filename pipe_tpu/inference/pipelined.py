@@ -1,0 +1,249 @@
+"""Pipelined autoregressive decoding over stage-sharded parameters.
+
+Serving a model whose weights are pipeline-sharded (each device holds ONLY
+its stages' blocks — the whole point of `Pipe.shard_params`) cannot use the
+single-device :class:`~.generate.Generator`: every token must traverse all
+stages. Naively that serializes — one token in flight, n-1 stages idle.
+This module pipelines the *requests* instead: the batch is split into
+``n_stages`` groups that chase each other around the stage ring, one
+ppermute per cycle (the same ICI transport as the training executors), so
+in steady state every stage decodes a different group's token each cycle —
+aggregate throughput of one token-group per cycle, the inference analogue
+of GPipe's fill-drain (which never needs a backward, so the schedule is
+just the ring).
+
+Structure per cycle (device = stage ``s``, cycle ``c``, group
+``(c - s) mod n``): stage 0 embeds the group's current token (first
+revolution: the prefill's sampled token, afterwards the token arriving on
+the wrap edge), every stage runs its blocks through the KV caches it owns
+for that group, stage n-1 samples and sends the token around the wrap to
+stage 0 — which needs it exactly at cycle ``c+1``, when that group's next
+revolution begins. A prefill phase first walks each group's prompt through
+the ring once (q=prompt_len), filling cache rows ``[0, p)``.
+
+Static-shape discipline: invalid fill/drain cycles write their garbage
+K/V rows into a sacrificial cache region past ``p + max_new`` and their
+garbage tokens into a sentinel output column (the executors' masked-slot
+trick, ``parallel/buffers.py``) — no per-cycle ``lax.cond``, no dynamic
+shapes. Known cost: the active group's cache slab is sliced out and
+written back each cycle (same order of HBM traffic as the attention read
+itself); acceptable at decode arithmetic intensity.
+
+``tests/test_pipelined_gen.py`` pins greedy pipelined output against the
+single-device Generator token-for-token.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import STAGE_AXIS
+from .generate import (GenerationConfig, check_positions, head_logits,
+                       sample_logits)
+
+__all__ = ["PipelinedGenerator"]
+
+
+class PipelinedGenerator:
+    """Ring-pipelined KV-cache sampling over a ``stage`` mesh axis.
+
+    ``model`` is a ``PipelinedTransformer`` LM with ``embed_at`` (see
+    :class:`~.generate.Generator`); params are the training layout with
+    ``stage_params`` stacked ``[n_stages, ...]`` (``stack_stage_params``)
+    and sharded over ``stage`` — serve the weights exactly as trained.
+    The batch must divide into ``n_stages`` groups.
+    """
+
+    def __init__(self, mesh: Mesh, model,
+                 gen_cfg: GenerationConfig = GenerationConfig()):
+        if STAGE_AXIS not in mesh.axis_names:
+            raise ValueError(f"mesh must have a {STAGE_AXIS!r} axis")
+        if not hasattr(model, "embed_at"):
+            raise TypeError(
+                f"{type(model).__name__} has no embed_at; KV-cache "
+                "generation needs position-offset embedding")
+        self.mesh = mesh
+        self.model = model
+        self.gen_cfg = gen_cfg
+        self.n_stages = mesh.shape[STAGE_AXIS]
+        # jitted device programs keyed by (prompt_len, rows_per_group,
+        # param treedef): jit caches by callable identity, and shard_map +
+        # partial build fresh callables — without this cache every
+        # generate() call would retrace AND recompile
+        self._programs = {}
+
+    # --- internals ---
+
+    def _ring(self, x):
+        n = self.n_stages
+        return jax.lax.ppermute(x, STAGE_AXIS,
+                                [(i, (i + 1) % n) for i in range(n)])
+
+    def _head(self, post_params, h):
+        return head_logits(self.model, post_params, h)
+
+    def _run_blocks(self, block_stack, h, caches, grp, pos):
+        """Run this stage's blocks on ``h`` against group ``grp``'s cache
+        slab; returns (h, updated caches). ``caches``: pytree of
+        ``[lps, n_groups, rpg, cache_len, nh, hd]``."""
+        m = self.model
+        lps = jax.tree_util.tree_leaves(caches)[0].shape[0]
+
+        def slab_slice(a):
+            s = jax.lax.dynamic_slice(
+                a, (0, grp) + (0,) * (a.ndim - 2),
+                (lps, 1) + a.shape[2:])
+            return jnp.squeeze(s, axis=1)
+
+        def slab_write(a, new):
+            return jax.lax.dynamic_update_slice(
+                a, new[:, None], (0, grp) + (0,) * (a.ndim - 2))
+
+        slab = jax.tree_util.tree_map(slab_slice, caches)
+
+        def layer_step(h_c, inp):
+            bp, cache = inp
+            h_new, cache = m.block.decode(bp, h_c, cache, pos)
+            return h_new, cache
+
+        h, new_slab = jax.lax.scan(layer_step, h, (block_stack, slab))
+        caches = jax.tree_util.tree_map(slab_write, caches, new_slab)
+        return h, caches
+
+    def _device_program(self, stage_params, pre_params, post_params,
+                        prompt_g, key, *, p, rpg):
+        m, gen, n = self.model, self.gen_cfg, self.n_stages
+        max_new = gen.max_new_tokens
+        s = jax.lax.axis_index(STAGE_AXIS)
+        cd = m.cfg.compute_dtype
+        nh, hd = m.block.attn.nhead, m.block.attn.head_dim
+        # sacrificial region: p rows past the live prefix absorbs garbage
+        # writes from fill/drain cycles (prefill writes q=p rows at once)
+        cache_len = p + max_new + p
+        sac = p + max_new
+
+        blocks = [jax.tree_util.tree_map(lambda a: a[0].astype(cd), bp)
+                  for bp in stage_params]
+        block_stack = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *blocks)
+        lps = len(blocks)
+        caches = {"k": jnp.zeros((lps, n, rpg, cache_len, nh, hd), cd),
+                  "v": jnp.zeros((lps, n, rpg, cache_len, nh, hd), cd)}
+
+        def pre_key(grp):
+            return jax.random.fold_in(jax.random.fold_in(key, grp), 0)
+
+        def dec_key(grp, t):
+            return jax.random.fold_in(jax.random.fold_in(key, grp), t + 1)
+
+        # ---- prefill: each group's prompt rides the ring once (q = p)
+        def pre_cycle(carry, c):
+            h_carry, caches, init_toks = carry
+            raw = c - s
+            valid = (raw >= 0) & (raw < n)
+            grp = jnp.clip(raw, 0, n - 1)
+            pos = jnp.where(valid, 0, sac)
+            h_embed = m.embed_at(pre_params,
+                                 jnp.take(prompt_g, grp, axis=0), 0)
+            h_in = jnp.where(s == 0, h_embed, h_carry)
+            h_out, caches = self._run_blocks(block_stack, h_in, caches,
+                                             grp, pos)
+            logits = self._head(post_params, h_out[:, -1:, :])[:, 0, :]
+            tok = sample_logits(logits, pre_key(grp), gen)
+            emit = (s == n - 1) & valid
+            old = jnp.take(init_toks, grp, axis=0)
+            init_toks = jax.lax.dynamic_update_slice(
+                init_toks, jnp.where(emit, tok, old)[None], (grp, 0))
+            return (self._ring(h_out), caches, init_toks), None
+
+        h0 = jnp.zeros((rpg, p, m.cfg.d_model), cd)
+        init_toks = jnp.zeros((n, rpg), jnp.int32)
+        (_, caches, init_toks), _ = jax.lax.scan(
+            pre_cycle, (h0, caches, init_toks), jnp.arange(2 * n - 1))
+        # only stage n-1 sampled real tokens; replicate its table
+        init_toks = jax.lax.psum(
+            jnp.where(s == n - 1, init_toks, 0), STAGE_AXIS)
+
+        # ---- decode: one token-group per cycle in steady state (q = 1)
+        def dec_cycle(carry, c):
+            h_carry, tok_ring, caches, out = carry
+            raw = c - s
+            valid = (raw >= 0) & (raw < n * max_new)
+            grp = jnp.mod(raw, n)
+            t = jnp.where(valid, raw // n, 0)
+            pos = jnp.where(valid, p + t, sac)
+            tok_use = jnp.where(c < n, jnp.take(init_toks, grp, axis=0),
+                                tok_ring)
+            h_embed = m.embed_at(pre_params, tok_use[:, None], pos)
+            h_in = jnp.where(s == 0, h_embed, h_carry)
+            h_out, caches = self._run_blocks(block_stack, h_in, caches,
+                                             grp, pos)
+            logits = self._head(post_params, h_out)[:, 0, :]
+            tok_out = sample_logits(logits, dec_key(grp, t), gen)
+            emit = (s == n - 1) & valid
+            # slot t holds the token SAMPLED while processing decode index
+            # t — i.e. generated token t+1 (the assembly below prepends
+            # init_toks as generated token 0 and drops the last sample,
+            # which is never re-embedded, mirroring Generator's scan)
+            t_write = jnp.where(emit, t, max_new)
+            out = jax.lax.dynamic_update_slice(
+                out, tok_out[None, :, None], (grp, 0, t_write))
+            return (self._ring(h_out), self._ring(tok_out), caches,
+                    out), None
+
+        h0 = jnp.zeros((rpg, 1, m.cfg.d_model), cd)
+        out = jnp.zeros((n, rpg, max_new + 1), jnp.int32)
+        cycles = n * max_new + n - 1
+        (_, _, _, out), _ = jax.lax.scan(
+            dec_cycle, (h0, jnp.zeros((rpg,), jnp.int32), caches, out),
+            jnp.arange(cycles))
+        # tokens ENTERING each step are init_toks (t=0 slot) shifted by the
+        # sampled stream: out[g, :, t] holds the token sampled AT decode
+        # index t, i.e. generated token t+1; generated token 0 is
+        # init_toks[g]. Assemble [n_groups, rpg, max_new].
+        gen_toks = jnp.concatenate(
+            [init_toks[:, :, None], out[:, :, :max_new - 1]], axis=2)
+        return jax.lax.psum(jnp.where(s == n - 1, gen_toks, 0), STAGE_AXIS)
+
+    # --- public ---
+
+    def generate(self, stage_params, pre_params, post_params,
+                 prompt: jax.Array,
+                 key: Optional[jax.Array] = None) -> jax.Array:
+        """Sample ``[b, max_new_tokens]`` continuations of ``prompt
+        [b, prompt_len]``; rows ``[g*rpg:(g+1)*rpg]`` form ring group
+        ``g``."""
+        b, p = prompt.shape
+        n = self.n_stages
+        if b % n:
+            raise ValueError(f"batch {b} must divide into {n} ring groups")
+        check_positions(self.model, p, self.gen_cfg.max_new_tokens)
+        rpg = b // n
+        prompt_g = jnp.asarray(prompt, jnp.int32).reshape(n, rpg, p)
+        if key is None:
+            key = jax.random.key(0)
+
+        cache_key = (p, rpg,
+                     jax.tree_util.tree_structure((stage_params, pre_params,
+                                                   post_params)))
+        run = self._programs.get(cache_key)
+        if run is None:
+            in_specs = (
+                jax.tree_util.tree_map(lambda _: P(STAGE_AXIS),
+                                       stage_params),
+                jax.tree_util.tree_map(lambda _: P(), pre_params),
+                jax.tree_util.tree_map(lambda _: P(), post_params),
+                P(), P(),
+            )
+            run = jax.jit(jax.shard_map(
+                functools.partial(self._device_program, p=p, rpg=rpg),
+                mesh=self.mesh, in_specs=in_specs, out_specs=P(),
+                check_vma=False))
+            self._programs[cache_key] = run
+        out = run(stage_params, pre_params, post_params, prompt_g, key)
+        return out.reshape(b, self.gen_cfg.max_new_tokens)

@@ -120,6 +120,15 @@ class PipelinedLM(PipelinedTransformer):
         h = self.posenc.apply({}, h, ctx=ctx.fold(1))
         return h.astype(self.cfg.compute_dtype)
 
+    def embed_at(self, pre_params, tokens, pos):
+        """Embed tokens occupying positions ``[pos, pos+q)`` — pre_fn with
+        a position offset, for incremental decoding (inference: no
+        dropout)."""
+        h = self.embed.apply(pre_params["embed"], tokens)
+        pe = jax.lax.dynamic_slice_in_dim(
+            self.posenc.pe, pos, tokens.shape[-1], axis=0)
+        return (h + pe).astype(self.cfg.compute_dtype)
+
     def post_fn(self, post_params, h, ctx: StageCtx):
         return self.decoder.apply(post_params["decoder"],
                                   h.astype(jnp.float32), ctx=ctx)

@@ -311,6 +311,52 @@ class MultiHeadAttention(Module):
         o = o.reshape(b, s, self.d_model)
         return jnp.einsum("bsd,de->bse", o, params["wo"]) + params["bo"]
 
+    def make_cache(self, batch: int, max_len: int, dtype=None):
+        """Zeroed KV cache for incremental decoding: ``{"k","v"}`` of
+        ``[batch, max_len, nhead, head_dim]``."""
+        shape = (batch, max_len, self.nhead, self.head_dim)
+        dt = dtype if dtype is not None else self.dtype
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def decode(self, params, x, cache, pos):
+        """Incremental self-attention with a KV cache (inference only).
+
+        ``x``: the new tokens' hidden states ``[b, q, d]`` occupying
+        positions ``[pos, pos+q)`` (``q=1`` per decode step; ``q=prompt``
+        at prefill with ``pos=0``); ``cache``: :meth:`make_cache` pytree.
+        Writes the new K/V rows at ``pos`` and attends each query over
+        cache positions ``<= its own`` — exactly :meth:`apply`'s causal
+        mask restricted to the live prefix, so teacher-forced cached logits
+        match the full forward. Returns ``(out [b, q, d], new_cache)``.
+        """
+        if not self.causal:
+            raise ValueError("KV-cache decode requires causal attention")
+        b, q, _ = x.shape
+        h, hd = self.nhead, self.head_dim
+
+        def proj(w, bias):
+            return (jnp.einsum("bsd,de->bse", x, w) + bias).reshape(
+                b, q, h, hd)
+
+        qh = proj(params["wq"], params["bq"])
+        kh = proj(params["wk"], params["bk"])
+        vh = proj(params["wv"], params["bv"])
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], kh.astype(cache["k"].dtype), (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], vh.astype(cache["v"].dtype), (0, pos, 0, 0))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, ck).astype(jnp.float32)
+        logits = logits / math.sqrt(hd)
+        kpos = jnp.arange(ck.shape[1])[None, None, None, :]
+        qpos = pos + jnp.arange(q)[None, None, :, None]
+        logits = jnp.where(kpos <= qpos, logits,
+                           jnp.asarray(-1e30, logits.dtype))
+        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", weights, cv).reshape(
+            b, q, self.d_model)
+        out = jnp.einsum("bsd,de->bse", o, params["wo"]) + params["bo"]
+        return out, {"k": ck, "v": cv}
+
 
 # "gelu" is the EXACT erf form (torch.nn.TransformerEncoderLayer's
 # activation='gelu', BERT, ViT); "gelu_tanh" is the tanh approximation
@@ -395,6 +441,15 @@ class TransformerEncoderLayer(_TransformerBlockBase):
         h = self.drop.apply({}, h, ctx=ctx.fold(3))
         return self.ln2.apply(params["ln2"], x + h, ctx=ctx)
 
+    def decode(self, params, x, cache, pos):
+        """Incremental :meth:`apply` (inference: no dropout) — same math on
+        the new positions with attention served from the KV cache."""
+        a, cache = self.attn.decode(params["attn"], x, cache, pos)
+        x = self.ln1.apply(params["ln1"], x + a)
+        h = self.act(self.ff1.apply(params["ff1"], x))
+        h = self.ff2.apply(params["ff2"], h)
+        return self.ln2.apply(params["ln2"], x + h), cache
+
 
 class PreLNBlock(_TransformerBlockBase):
     """Pre-LN transformer block (GPT-2 / ViT lineage): x + attn(ln1(x)),
@@ -417,6 +472,17 @@ class PreLNBlock(_TransformerBlockBase):
             ctx=ctx))
         h = self.ff2.apply(params["ff2"], h, ctx=ctx)
         return x + self.drop.apply({}, h, ctx=ctx.fold(2))
+
+    def decode(self, params, x, cache, pos):
+        """Incremental :meth:`apply` (inference: no dropout) — same math on
+        the new positions with attention served from the KV cache."""
+        a, cache = self.attn.decode(params["attn"],
+                                    self.ln1.apply(params["ln1"], x),
+                                    cache, pos)
+        x = x + a
+        h = self.act(self.ff1.apply(params["ff1"],
+                                    self.ln2.apply(params["ln2"], x)))
+        return x + self.ff2.apply(params["ff2"], h), cache
 
 
 class PositionalEncoding(Module):

@@ -41,7 +41,8 @@ from ..core.remat import checkpoint_stop, validate_mode
 from .mesh import DATA_AXIS, STAGE_AXIS
 from ..utils.rng import make_key
 
-__all__ = ["InterleavedSpmdPipeline", "stack_interleaved_params"]
+__all__ = ["InterleavedSpmdPipeline", "stack_interleaved_params",
+           "unstack_interleaved_params"]
 
 
 def stack_interleaved_params(params_per_virtual_stage, n_devices: int):
@@ -60,6 +61,21 @@ def stack_interleaved_params(params_per_virtual_stage, n_devices: int):
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack([leaves[s] for s in order], axis=0),
         *params_per_virtual_stage)
+
+
+def unstack_interleaved_params(stacked, n_devices: int):
+    """Inverse of :func:`stack_interleaved_params`: a per-virtual-stage
+    list in TRUE virtual-stage order (virtual stage ``g·d + p`` lives at
+    stacked row ``p·v + g``). Keeps the permutation convention in this
+    module — serving consumers must not re-derive it."""
+    S = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    if S % n_devices:
+        raise ValueError(f"{S} stacked rows not divisible by "
+                         f"{n_devices} devices")
+    v = S // n_devices
+    return [jax.tree_util.tree_map(
+                lambda a: a[(vs % n_devices) * v + vs // n_devices], stacked)
+            for vs in range(S)]
 
 
 @dataclasses.dataclass

@@ -62,6 +62,12 @@ def stack_stage_params(params_per_stage):
         lambda *leaves: jnp.stack(leaves, axis=0), *params_per_stage)
 
 
+def unstack_stage_params(stacked, n_stages: int):
+    """Inverse of :func:`stack_stage_params`: back to a per-stage list."""
+    return [jax.tree_util.tree_map(lambda a: a[i], stacked)
+            for i in range(n_stages)]
+
+
 def _identity(params, x, ctx):
     return x
 

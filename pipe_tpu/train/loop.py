@@ -221,6 +221,24 @@ class Trainer:
         return sum(int(a.size) for a in jax.tree_util.tree_leaves(
             state.params))
 
+    def save(self, directory: str, state: TrainState,
+             step: Optional[int] = None) -> None:
+        """Checkpoint with the stage-stack layout recorded (so serving can
+        reconstruct layer order; interleaved schedules stack the virtual
+        stages device-major-permuted)."""
+        from .state import save_checkpoint
+
+        cfg = self.cfg
+        interleaved = cfg.schedule in ("interleaved", "interleaved-1f1b")
+        layout = {
+            "stacking": "interleaved" if interleaved else "stage",
+            "n_stages": cfg.n_stages,
+            "interleave": cfg.interleave if interleaved else 1,
+        }
+        save_checkpoint(directory, state,
+                        int(state.step) if step is None else step,
+                        layout=layout)
+
     def analytic_bubble(self) -> float:
         cfg = self.cfg
         if cfg.schedule == "interleaved":

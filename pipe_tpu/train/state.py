@@ -16,7 +16,8 @@ from typing import Any, Optional
 import jax
 
 __all__ = ["TrainState", "save_checkpoint", "restore_checkpoint",
-           "latest_step"]
+           "latest_step", "checkpoint_params_layout", "restore_params",
+           "read_params_layout"]
 
 
 @jax.tree_util.register_dataclass
@@ -39,13 +40,51 @@ def _manager(directory: str, max_to_keep: int = 3):
 
 
 def save_checkpoint(directory: str, state: TrainState, step: int,
-                    max_to_keep: int = 3) -> None:
-    """Write an atomic, sharding-aware checkpoint for ``step``."""
+                    max_to_keep: int = 3,
+                    layout: Optional[dict] = None) -> None:
+    """Write an atomic, sharding-aware checkpoint for ``step``.
+
+    ``layout`` (optional) records how ``state.params``' stage stack was
+    built — ``{"stacking": "stage"|"interleaved", "n_stages": d,
+    "interleave": v}`` — in ``params_layout.json`` next to the steps, so
+    serving consumers (``apps/generate.py``) can reconstruct the true layer
+    order (interleaved stacking permutes rows device-major;
+    ``parallel/interleaved.py``). ``Trainer.save`` passes it automatically.
+    """
     import orbax.checkpoint as ocp
 
     with _manager(directory, max_to_keep) as mngr:
         mngr.save(step, args=ocp.args.StandardSave(state))
         mngr.wait_until_finished()
+    # One writer only (multi-host saves run on every process against the
+    # same dir), through the same path abstraction orbax uses (so gs://
+    # and friends work).
+    if jax.process_index() != 0:
+        return
+    import json
+
+    from etils import epath
+
+    record = epath.Path(directory) / "params_layout.json"
+    if layout is not None:
+        record.write_text(json.dumps(layout))
+    else:
+        # a layout-less save into a dir that has a record: the record may
+        # describe a DIFFERENT stacking — stale info is worse than none
+        record.unlink(missing_ok=True)
+
+
+def read_params_layout(directory: str) -> Optional[dict]:
+    """The ``layout`` dict recorded at save time, or None (unknown —
+    assume plain stage-major stacking)."""
+    import json
+
+    from etils import epath
+
+    record = epath.Path(directory) / "params_layout.json"
+    if not record.exists():
+        return None
+    return json.loads(record.read_text())
 
 
 def restore_checkpoint(directory: str, template: TrainState,
@@ -68,3 +107,51 @@ def restore_checkpoint(directory: str, template: TrainState,
 def latest_step(directory: str) -> Optional[int]:
     with _manager(directory) as mngr:
         return mngr.latest_step()
+
+
+def checkpoint_params_layout(directory: str,
+                             step: Optional[int] = None):
+    """Read the SAVED stage layout from checkpoint metadata (no restore).
+
+    Returns ``(n_stages, blocks_per_stage)`` for a Trainer-saved state
+    (stage-stacked params: a list of ``blocks_per_stage`` block pytrees
+    whose leaves lead with the ``n_stages`` axis).
+    """
+    import pathlib
+
+    import orbax.checkpoint as ocp
+
+    with _manager(directory) as mngr:
+        if step is None:
+            step = mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {directory}")
+        path = pathlib.Path(mngr.directory) / str(step) / "default"
+    md = ocp.StandardCheckpointHandler().metadata(path).tree
+    stacked = md["params"][0]
+    lps = len(stacked)
+    leaf = jax.tree_util.tree_leaves(stacked[0])[0]
+    return int(leaf.shape[0]), lps
+
+
+def restore_params(directory: str, params_template: Any,
+                   step: Optional[int] = None) -> Any:
+    """Restore ONLY the ``params`` subtree of a saved :class:`TrainState`.
+
+    For consumers that don't know (or want) the optimizer state — e.g. the
+    generation driver serving a training checkpoint. ``params_template``
+    must match the layout the state was SAVED in (the Trainer saves
+    stage-STACKED params; see ``parallel.spmd.stack_stage_params``).
+    """
+    import orbax.checkpoint as ocp
+
+    with _manager(directory) as mngr:
+        if step is None:
+            step = mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {directory}")
+        restored = mngr.restore(
+            step,
+            args=ocp.args.PyTreeRestore(item={"params": params_template},
+                                        partial_restore=True))
+        return restored["params"]
