@@ -1,0 +1,51 @@
+"""Schedule visualization (tools/schedule_viz.py) — rendered from the SAME
+op tables the executor runs, so the picture can't drift from the program."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import schedule_viz  # noqa: E402
+
+
+@pytest.mark.parametrize("name", ["gpipe", "1f1b", "zb-h1",
+                                  "interleaved-1f1b"])
+def test_ascii_timeline_renders(name):
+    out = schedule_viz.ascii_timeline(name, m=6, n=3)
+    lines = out.splitlines()
+    assert "bubble=" in lines[0]
+    # one row per stage/device + title + cycle header
+    assert len(lines) == 3 + 2
+    if name == "gpipe":
+        # the canonical fill-drain shape: stage 0 starts F0, stage 2 two in
+        assert "F0" in lines[2] and lines[4].count(".") >= 2
+
+
+@pytest.mark.parametrize("name", ["gpipe", "1f1b", "zb-h1",
+                                  "interleaved-1f1b"])
+def test_svg_timeline_wellformed(name):
+    import xml.etree.ElementTree as ET
+
+    svg = schedule_viz.svg_timeline(name, m=4, n=2)
+    root = ET.fromstring(svg)
+    assert root.tag.endswith("svg")
+    rects = [e for e in root.iter() if e.tag.endswith("rect")]
+    assert len(rects) > 4
+
+
+def test_zb_table_shows_wgrad():
+    out = schedule_viz.ascii_timeline("zb-h1", m=4, n=2)
+    assert "W0" in out and "B0" in out and "F0" in out
+
+
+def test_cli_all_and_svg(tmp_path, capsys):
+    assert schedule_viz.main(["-m", "4", "-n", "2"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("bubble=") == 4
+    svg = tmp_path / "s.svg"
+    assert schedule_viz.main(["1f1b", "--svg", str(svg)]) == 0
+    assert svg.exists()

@@ -1,0 +1,158 @@
+"""Render pipeline schedule tables as timelines (ASCII and SVG).
+
+The ``(cycle, stage) -> (op, microbatch)`` tables in ``core/schedule.py``
+ARE the executor — this tool makes them inspectable: a per-stage timeline
+with one column per cycle, forward/backward/weight-grad slots colored and
+labeled with their micro-batch, idle slots visibly empty (the bubble), and
+the analytic bubble fraction in the title. The reference debugs its
+schedule with print statements and a pptx; here the schedule is data, so
+the picture is generated from the same arrays the compiled program runs.
+
+Usage:
+    python tools/schedule_viz.py [gpipe|1f1b|zb-h1|interleaved-1f1b]
+        [-m MICROBATCHES] [-n STAGES] [-v INTERLEAVE] [--svg out.svg]
+
+With no schedule argument, prints all of them at the default geometry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pipe_tpu.core.schedule import (BWD, FWD, IDLE, WGRAD, GPipeSchedule,
+                                    InterleavedOneFOneBSchedule,
+                                    OneFOneBSchedule, ZeroBubbleSchedule)
+
+_GLYPH = {IDLE: " . ", FWD: "F%d", BWD: "B%d", WGRAD: "W%d"}
+_COLOR = {FWD: "#4c78a8", BWD: "#e45756", WGRAD: "#f2a900"}
+_NAME = {FWD: "F", BWD: "B", WGRAD: "W"}
+
+
+def make_schedule(name: str, interleave: int = 2):
+    if name == "gpipe":
+        return GPipeSchedule()
+    if name == "1f1b":
+        return OneFOneBSchedule()
+    if name == "zb-h1":
+        return ZeroBubbleSchedule()
+    if name == "interleaved-1f1b":
+        return InterleavedOneFOneBSchedule(interleave=interleave)
+    raise ValueError(f"unknown schedule {name!r}")
+
+
+def tables(name: str, m: int, n: int, interleave: int = 2):
+    """(op, mb, grp_or_None, bubble). Interleaved tables are over DEVICES
+    and carry a third array: which interleave group the slot serves."""
+    sched = make_schedule(name, interleave)
+    out = sched.op_tables(m, n)
+    if len(out) == 3:
+        op, mb, grp = out
+    else:
+        (op, mb), grp = out, None
+    return op, mb, grp, sched.bubble(m, n)
+
+
+def _trim(op: np.ndarray) -> int:
+    """Last cycle with any work + 1 (tables may carry trailing idle)."""
+    busy = np.nonzero((op != IDLE).any(axis=1))[0]
+    return int(busy[-1]) + 1 if busy.size else 0
+
+
+def _label(op, mb, grp, t, j) -> str:
+    o = int(op[t, j])
+    if o == IDLE:
+        return "."
+    if grp is None:
+        return f"{_NAME[o]}{int(mb[t, j])}"
+    return f"{_NAME[o]}{int(grp[t, j])}.{int(mb[t, j])}"
+
+
+def ascii_timeline(name: str, m: int, n: int, interleave: int = 2) -> str:
+    op, mb, grp, bubble = tables(name, m, n, interleave)
+    T = _trim(op)
+    width = max(3, len(str(m - 1)) + (5 if grp is not None else 2))
+    row_kind = "device" if grp is not None else "stage"
+    head = f"{name}  m={m} n={n}  cycles={T}  bubble={bubble:.1%}"
+    if grp is not None:
+        head += f"  (cells: op<group>.<microbatch>, v={interleave})"
+    lines = [head,
+             " " * 9 + "".join(f"{t:^{width}}" for t in range(T))]
+    for j in range(op.shape[1]):
+        cells = [f"{_label(op, mb, grp, t, j):^{width}}" for t in range(T)]
+        lines.append(f"{row_kind} {j}|".rjust(9) + "".join(cells))
+    return "\n".join(lines)
+
+
+def svg_timeline(name: str, m: int, n: int, interleave: int = 2,
+                 cell: int = 26) -> str:
+    op, mb, grp, bubble = tables(name, m, n, interleave)
+    if grp is not None:
+        cell = max(cell, 40)  # wider cells for group.microbatch labels
+    T = _trim(op)
+    n_stages = op.shape[1]
+    pad_l, pad_t = 64, 40
+    w = pad_l + T * cell + 10
+    h = pad_t + n_stages * cell + 10
+    parts = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" height="{h}" '
+        f'font-family="monospace" font-size="11">',
+        f'<text x="8" y="18">{name}  m={m} n={n}  cycles={T}  '
+        f'bubble={bubble:.1%}</text>',
+    ]
+    for j in range(n_stages):
+        y = pad_t + j * cell
+        parts.append(f'<text x="8" y="{y + cell * 0.65:.0f}">s{j}</text>')
+        for t in range(T):
+            x = pad_l + t * cell
+            o = int(op[t, j])
+            if o == IDLE:
+                parts.append(
+                    f'<rect x="{x}" y="{y}" width="{cell - 1}" '
+                    f'height="{cell - 1}" fill="#eeeeee"/>')
+            else:
+                parts.append(
+                    f'<rect x="{x}" y="{y}" width="{cell - 1}" '
+                    f'height="{cell - 1}" fill="{_COLOR[o]}"/>'
+                    f'<text x="{x + cell // 2}" y="{y + cell * 0.65:.0f}" '
+                    f'text-anchor="middle" fill="white">'
+                    f'{_label(op, mb, grp, t, j)}</text>')
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("schedule", nargs="?", default=None,
+                   choices=["gpipe", "1f1b", "zb-h1", "interleaved-1f1b"])
+    p.add_argument("-m", type=int, default=8, help="micro-batches")
+    p.add_argument("-n", type=int, default=4, help="stages/devices")
+    p.add_argument("-v", "--interleave", type=int, default=2)
+    p.add_argument("--svg", default=None, help="write an SVG here instead")
+    args = p.parse_args(argv)
+
+    names = ([args.schedule] if args.schedule
+             else ["gpipe", "1f1b", "zb-h1", "interleaved-1f1b"])
+    if args.svg:
+        if len(names) != 1:
+            print("--svg needs an explicit schedule", file=sys.stderr)
+            return 2
+        with open(args.svg, "w") as f:
+            f.write(svg_timeline(names[0], args.m, args.n, args.interleave))
+        print(f"wrote {args.svg}")
+        return 0
+    for name in names:
+        print(ascii_timeline(name, args.m, args.n, args.interleave))
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
