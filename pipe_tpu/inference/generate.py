@@ -55,12 +55,14 @@ class GenerationConfig:
 def check_positions(model, prompt_len: int, max_new_tokens: int) -> None:
     """Fail loudly when decode would run past the positional table —
     ``embed_at``'s dynamic slice clamps at the edge, which would silently
-    reuse the last rows instead of erroring like the training path."""
-    pe = getattr(getattr(model, "posenc", None), "pe", None)
-    if pe is not None and prompt_len + max_new_tokens > pe.shape[0]:
+    reuse the last rows instead of erroring like the training path.
+    Models advertise their capacity via ``max_position()``."""
+    mp = getattr(model, "max_position", None)
+    limit = mp() if callable(mp) else None
+    if limit is not None and prompt_len + max_new_tokens > limit:
         raise ValueError(
             f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
-            f"exceeds the positional table ({pe.shape[0]} positions)")
+            f"exceeds the positional table ({limit} positions)")
 
 
 def head_logits(model, post_params, h: jax.Array) -> jax.Array:

@@ -151,3 +151,16 @@ class PipelinedGPT2(PipelinedTransformer):
         """Per-row mean token CE [mb_rows] — in-pipeline loss contract."""
         logits = self.head.apply(post_params["head"], h, ctx=ctx)
         return per_row_ce(logits, x_mb["targets"])
+
+    def embed_at(self, pre_params, tokens, pos):
+        """Embed tokens occupying positions ``[pos, pos+q)`` — for
+        incremental decoding (inference: no dropout)."""
+        p = pre_params["embed"]
+        h = jnp.take(p["wte"], tokens, axis=0)
+        pe = jax.lax.dynamic_slice_in_dim(p["wpe"], pos,
+                                          tokens.shape[-1], axis=0)
+        return (h + pe).astype(self.cfg.compute_dtype)
+
+    def max_position(self) -> int:
+        """Positional capacity (wpe rows) — inference guard contract."""
+        return self.cfg.seq_len

@@ -129,6 +129,10 @@ class PipelinedLM(PipelinedTransformer):
             self.posenc.pe, pos, tokens.shape[-1], axis=0)
         return (h + pe).astype(self.cfg.compute_dtype)
 
+    def max_position(self) -> int:
+        """Positional capacity (sinusoid table rows) — inference guard."""
+        return int(self.posenc.pe.shape[0])
+
     def post_fn(self, post_params, h, ctx: StageCtx):
         return self.decoder.apply(post_params["decoder"],
                                   h.astype(jnp.float32), ctx=ctx)
