@@ -1,0 +1,75 @@
+"""On-chip inference benchmark: KV-cached decode throughput for the 520M
+tutorial LM (single chip, the hardware this environment has).
+
+Measures, per configuration: prefill time (one batched causal pass over
+the prompt) and steady-state decode tokens/s (the scan, amortized per
+generated token per sequence, and aggregate across the batch). Greedy
+sampling so the numbers are sampling-cost-free. The cached path's whole
+point is turning O(t^2) re-forward into O(t) cache reads; the naive
+re-forward equivalent at these lengths is too slow to be worth timing
+per-run, so the comparison is architectural (see inference/generate.py).
+
+Usage: python tools/gen_bench.py [batch ...]   (default: 1 8 32)
+Prints one JSON line per batch size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pipe_tpu.inference import GenerationConfig, Generator
+from pipe_tpu.models.transformer_lm import PipelinedLM
+
+from bench import tutorial_config, with_retries
+
+PROMPT = int(os.environ.get("GEN_BENCH_PROMPT", "128"))
+MAX_NEW = int(os.environ.get("GEN_BENCH_NEW", "128"))
+
+
+def main(batches):
+    platform = jax.default_backend()
+    cfg = tutorial_config(platform)
+    model = PipelinedLM(cfg, 1)
+    params = model.init(jax.random.key(0))
+    gen = Generator(model, GenerationConfig(max_new_tokens=MAX_NEW,
+                                            temperature=0.0))
+
+    for b in batches:
+        prompt = jax.random.randint(jax.random.key(1), (b, PROMPT),
+                                    0, cfg.vocab, jnp.int32)
+
+        def run():
+            # compile + warm
+            jax.block_until_ready(gen.generate(params, prompt))
+            iters = 4
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                jax.block_until_ready(gen.generate(params, prompt))
+            return (time.perf_counter() - t0) / iters
+
+        try:
+            sec = with_retries(run)
+        except Exception as e:  # noqa: BLE001 — report per-config
+            print(json.dumps({"batch": b, "error": str(e)[:200]}),
+                  flush=True)
+            continue
+        print(json.dumps({
+            "platform": platform, "batch": b, "prompt": PROMPT,
+            "max_new": MAX_NEW,
+            "sec_per_generate": round(sec, 4),
+            "ms_per_token_per_seq": round(1000 * sec / MAX_NEW, 3),
+            "decode_tok_s_aggregate": round(b * MAX_NEW / sec, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main([int(a) for a in sys.argv[1:]] or [1, 8, 32])
