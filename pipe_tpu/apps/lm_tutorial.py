@@ -45,6 +45,9 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="jax.profiler trace dir (main.py:196-204 equivalent)")
     p.add_argument("--save", default=None, help="checkpoint dir to save into")
     p.add_argument("--resume", default=None, help="checkpoint dir to resume")
+    p.add_argument("--autosave", default=None,
+                   help="checkpoint dir for preemption-aware autosave "
+                        "(SIGTERM finishes the step, saves, exits cleanly)")
     p.add_argument("--cpu", type=int, default=0,
                    help="force N virtual CPU devices (testing without TPU)")
     return p
@@ -91,6 +94,8 @@ def main(argv=None) -> int:
     val_data = lm_text.batchify(val_ids, cfg.eval_batch_size)
 
     trainer = Trainer(model_cfg, cfg)
+    if args.autosave:
+        trainer.install_autosave(args.autosave)
     state = trainer.init_state()
     if args.resume:
         state = restore_checkpoint(args.resume, state)
@@ -108,6 +113,8 @@ def main(argv=None) -> int:
             state, metrics = trainer.train_epoch(
                 train_data, epoch=epoch, state=state,
                 max_steps=args.steps, log_every=max(args.steps // 4, 1))
+            if trainer._autosave_pending():
+                break  # preemption: checkpoint written, exit cleanly
     if args.profile:
         print(f"profiler trace written to {args.profile}")
 

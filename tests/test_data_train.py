@@ -266,3 +266,44 @@ def test_interleaved_1f1b_eval_covers_all_virtual_stages():
         postp, h, {"targets": jnp.asarray(target)}, StageCtx())
     np.testing.assert_allclose(got, float(jnp.mean(per_row)),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_autosave_on_stop_signal(tmp_path):
+    """install_autosave: the stop flag ends the epoch after the in-flight
+    step and a restorable checkpoint exists (the preemption flow)."""
+    import os
+    import signal
+
+    from pipe_tpu.train.state import latest_step, restore_checkpoint
+
+    model = LMConfig().tiny()
+    cfg = TrainerConfig(batch_size=8, bptt=16, chunks=2, n_stages=2,
+                        lr=0.05, schedule="gpipe", checkpoint="never")
+    ids = np.random.default_rng(17).integers(
+        0, model.vocab, size=4096).astype(np.int32)
+    src = lm_text.batchify(ids, cfg.batch_size)
+    tr = Trainer(model, cfg)
+    ckpt = str(tmp_path / "auto")
+    tr.install_autosave(ckpt, signals=[signal.SIGUSR1])
+    state = tr.init_state()
+
+    lines = []
+    fired = {"done": False}
+    orig_step = tr._step_fn
+
+    def step_and_signal(*a, **kw):
+        out = orig_step(*a, **kw)
+        if not fired["done"]:
+            fired["done"] = True
+            os.kill(os.getpid(), signal.SIGUSR1)  # preemption mid-epoch
+        return out
+
+    tr._step_fn = step_and_signal
+    state, stats = tr.train_epoch(src, state=state, max_steps=6,
+                                  log_every=0, log_fn=lines.append)
+    assert stats["steps"] == 1  # stopped right after the in-flight step
+    assert any("autosave" in l for l in lines)
+    step = latest_step(ckpt)
+    assert step == 1
+    restored = restore_checkpoint(ckpt, tr.init_state())
+    assert int(restored.step) == 1
