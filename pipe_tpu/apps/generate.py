@@ -34,6 +34,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy")
     p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--beams", type=int, default=1,
+                   help=">1: beam search (deterministic; single-device "
+                        "generator only)")
     p.add_argument("--stages", type=int, default=1,
                    help=">1: ring-pipelined decode over a stage mesh")
     p.add_argument("--tiny", action="store_true")
@@ -75,6 +78,10 @@ def main(argv=None) -> int:
         return 2
     if args.resume and not os.path.isdir(args.resume):
         print(f"--resume {args.resume}: no such directory", file=sys.stderr)
+        return 2
+    if args.beams > 1 and n_stages > 1:
+        print("--beams > 1 is single-device only (the ring decoder does "
+              "not reorder beams)", file=sys.stderr)
         return 2
 
     if args.resume:
@@ -125,7 +132,7 @@ def main(argv=None) -> int:
     prompt = jnp.asarray([ids] * batch, jnp.int32)
     gen_cfg = GenerationConfig(max_new_tokens=args.max_new,
                                temperature=args.temperature,
-                               top_k=args.top_k)
+                               top_k=args.top_k, num_beams=args.beams)
     key = jax.random.key(args.seed + 1)
 
     if n_stages > 1:

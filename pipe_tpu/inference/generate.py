@@ -40,6 +40,10 @@ class GenerationConfig:
     max_new_tokens: int = 32
     temperature: float = 1.0   # 0 = greedy (argmax)
     top_k: Optional[int] = None  # None = full distribution
+    # >1: beam search (deterministic, sum-of-log-probs scoring; the
+    # temperature/top_k sampling knobs are ignored). KV caches are
+    # physically reordered by parent beam each step.
+    num_beams: int = 1
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -50,6 +54,8 @@ class GenerationConfig:
                 f"temperature must be >= 0, got {self.temperature}")
         if self.top_k is not None and self.top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.num_beams < 1:
+            raise ValueError(f"num_beams must be >= 1, got {self.num_beams}")
 
 
 def check_positions(model, prompt_len: int, max_new_tokens: int) -> None:
@@ -104,6 +110,7 @@ class Generator:
         self.model = model
         self.gen_cfg = gen_cfg
         self._jitted = jax.jit(self._generate)
+        self._jitted_beam = None  # built on first beam-search call
 
     # --- internals ---
 
@@ -164,14 +171,99 @@ class Generator:
         out = jnp.moveaxis(toks, 0, 1)  # [b, max_new-1]
         return jnp.concatenate([out, last[:, None]], axis=1)
 
+    def _generate_beam(self, params, prompt):
+        """Beam search: deterministic, sum-of-log-probs scoring.
+
+        Caches are tiled to ``b*k`` rows after prefill and physically
+        re-gathered by parent beam each step (the standard KV-cache beam
+        reorder — one cache-sized gather per step). Returns
+        ``(tokens [b, max_new], scores [b])`` for the best beam.
+        """
+        m, gen = self.model, self.gen_cfg
+        k = gen.num_beams
+        stage_params, pre_params, post_params = params
+        blocks = self._blocks(stage_params)
+        b, p = prompt.shape
+        max_len = p + gen.max_new_tokens
+        caches = [m.block.attn.make_cache(b, max_len,
+                                          dtype=m.cfg.compute_dtype)
+                  for _ in blocks]
+
+        # prefill on the UNtiled batch, then branch into k beams
+        h = m.embed_at(pre_params, prompt, 0)
+        for l, bp in enumerate(blocks):
+            h, caches[l] = m.block.decode(bp, h, caches[l], 0)
+        logp = jax.nn.log_softmax(
+            self._head(post_params, h[:, -1:, :])[:, 0, :], axis=-1)
+        scores, tok = jax.lax.top_k(logp, k)          # [b, k] each
+        tok = tok.astype(jnp.int32)
+
+        cache_stack = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *caches)
+        cache_stack = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, k, axis=1), cache_stack)  # [L, b*k, ...]
+        block_stack = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *blocks)
+
+        out0 = jnp.zeros((b, k, gen.max_new_tokens), jnp.int32)
+        out0 = out0.at[:, :, 0].set(tok)
+
+        def layer_step(h_carry, inp):
+            bp, cache = inp
+            h_new, cache = m.block.decode(bp, h_carry[0], cache, h_carry[1])
+            return (h_new, h_carry[1]), cache
+
+        def step(carry, t):
+            caches, scores, tok, out = carry
+            pos = p + t
+            h = m.embed_at(pre_params, tok.reshape(b * k, 1), pos)
+            (h, _), caches = jax.lax.scan(
+                layer_step, (h, pos), (block_stack, caches))
+            logp = jax.nn.log_softmax(
+                self._head(post_params, h)[:, 0, :], axis=-1)  # [b*k, V]
+            V = logp.shape[-1]
+            total = scores[:, :, None] + logp.reshape(b, k, V)
+            scores, idx = jax.lax.top_k(total.reshape(b, k * V), k)
+            parent = idx // V                              # [b, k]
+            tok = (idx % V).astype(jnp.int32)
+            flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+            caches = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, flat_parent, axis=1), caches)
+            out = jnp.take_along_axis(out, parent[:, :, None], axis=1)
+            out = jax.lax.dynamic_update_slice(
+                out, tok[:, :, None], (0, 0, t + 1))
+            return (caches, scores, tok, out), None
+
+        (_, scores, _, out), _ = jax.lax.scan(
+            step, (cache_stack, scores, tok, out0),
+            jnp.arange(gen.max_new_tokens - 1))
+        best = jnp.argmax(scores, axis=1)
+        toks = jnp.take_along_axis(
+            out, best[:, None, None], axis=1)[:, 0, :]
+        return toks, jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0]
+
     # --- public ---
 
     def generate(self, params, prompt: jax.Array,
                  key: Optional[jax.Array] = None) -> jax.Array:
         """Sample ``[b, max_new_tokens]`` continuations of ``prompt
-        [b, prompt_len]`` int32 ids."""
-        if key is None:
-            key = jax.random.key(0)
+        [b, prompt_len]`` int32 ids. ``num_beams > 1`` runs beam search
+        (deterministic; ``key`` unused)."""
         check_positions(self.model, prompt.shape[1],
                         self.gen_cfg.max_new_tokens)
+        if self.gen_cfg.num_beams > 1:
+            return self.generate_with_scores(params, prompt)[0]
+        if key is None:
+            key = jax.random.key(0)
         return self._jitted(params, jnp.asarray(prompt, jnp.int32), key)
+
+    def generate_with_scores(self, params, prompt: jax.Array):
+        """Beam search returning ``(tokens [b, max_new], scores [b])`` —
+        the best beam's tokens and its total log-probability."""
+        if self.gen_cfg.num_beams < 2:
+            raise ValueError("generate_with_scores requires num_beams >= 2")
+        check_positions(self.model, prompt.shape[1],
+                        self.gen_cfg.max_new_tokens)
+        if self._jitted_beam is None:
+            self._jitted_beam = jax.jit(self._generate_beam)
+        return self._jitted_beam(params, jnp.asarray(prompt, jnp.int32))
