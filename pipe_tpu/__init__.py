@@ -20,6 +20,7 @@ from .ops.layers import (Decoder, Dropout, Embedding, Lambda, LayerNorm,
                          Linear, Module, MultiHeadAttention,
                          PositionalEncoding, Sequential,
                          TransformerEncoderLayer)
+from .inference import GenerationConfig, Generator, PipelinedGenerator
 from .pipe import Pipe
 
 __version__ = "0.1.0"
@@ -32,4 +33,5 @@ __all__ = [
     "Module", "Sequential", "Lambda", "Linear", "Embedding", "LayerNorm",
     "Dropout", "MultiHeadAttention", "TransformerEncoderLayer",
     "PositionalEncoding", "Decoder",
+    "GenerationConfig", "Generator", "PipelinedGenerator",
 ]
