@@ -73,8 +73,11 @@ def check_positions(model, prompt_len: int, max_new_tokens: int) -> None:
 
 def head_logits(model, post_params, h: jax.Array) -> jax.Array:
     """The model head on hidden states (float32 logits) — ONE definition
-    shared by the single-device and ring-pipelined generators."""
-    return model.head.apply(post_params[model.post_key],
+    shared by the single-device and ring-pipelined generators. Quantized
+    head weights (inference/quant.py) dequantize here, in-step."""
+    from .quant import dequant_tree
+    return model.head.apply(dequant_tree(post_params[model.post_key],
+                                         jnp.float32),
                             h.astype(jnp.float32))
 
 
@@ -116,11 +119,23 @@ class Generator:
 
     def _blocks(self, stage_params):
         """Flatten the per-stage block lists into one [block0..blockL-1]
-        list, cast to compute dtype (stage_fn's contract)."""
+        list, cast to compute dtype (stage_fn's contract). QuantLeaf
+        nodes (int8 weight-only quantization, inference/quant.py) pass
+        through untouched — they dequantize at use time via _dq."""
+        from .quant import QuantLeaf
         cd = self.model.cfg.compute_dtype
         flat = [bp for stage in stage_params for bp in stage]
-        return [jax.tree_util.tree_map(lambda p: p.astype(cd), bp)
+        return [jax.tree_util.tree_map(
+                    lambda p: p if isinstance(p, QuantLeaf)
+                    else p.astype(cd),
+                    bp, is_leaf=lambda x: isinstance(x, QuantLeaf))
                 for bp in flat]
+
+    def _dq(self, bp):
+        """Materialize block weights at use time (int8 -> compute dtype
+        inside the compiled step; identity when unquantized)."""
+        from .quant import dequant_tree
+        return dequant_tree(bp, self.model.cfg.compute_dtype)
 
     def _head(self, post_params, h):
         return head_logits(self.model, post_params, h)
@@ -138,7 +153,7 @@ class Generator:
         # prefill: one batched causal pass writes rows [0, p) of every cache
         h = m.embed_at(pre_params, prompt, 0)
         for l, bp in enumerate(blocks):
-            h, caches[l] = m.block.decode(bp, h, caches[l], 0)
+            h, caches[l] = m.block.decode(self._dq(bp), h, caches[l], 0)
         key, sub = jax.random.split(key)
         tok = sample_logits(self._head(post_params, h[:, -1:, :])[:, 0, :],
                             sub, gen)
@@ -151,7 +166,8 @@ class Generator:
 
         def layer_step(h_carry, inp):
             bp, cache = inp
-            h_new, cache = m.block.decode(bp, h_carry[0], cache, h_carry[1])
+            h_new, cache = m.block.decode(self._dq(bp), h_carry[0], cache,
+                                          h_carry[1])
             return (h_new, h_carry[1]), cache
 
         def step(carry, _):
@@ -192,7 +208,7 @@ class Generator:
         # prefill on the UNtiled batch, then branch into k beams
         h = m.embed_at(pre_params, prompt, 0)
         for l, bp in enumerate(blocks):
-            h, caches[l] = m.block.decode(bp, h, caches[l], 0)
+            h, caches[l] = m.block.decode(self._dq(bp), h, caches[l], 0)
         logp = jax.nn.log_softmax(
             self._head(post_params, h[:, -1:, :])[:, 0, :], axis=-1)
         scores, tok = jax.lax.top_k(logp, k)          # [b, k] each
@@ -210,7 +226,8 @@ class Generator:
 
         def layer_step(h_carry, inp):
             bp, cache = inp
-            h_new, cache = m.block.decode(bp, h_carry[0], cache, h_carry[1])
+            h_new, cache = m.block.decode(self._dq(bp), h_carry[0], cache,
+                                          h_carry[1])
             return (h_new, h_carry[1]), cache
 
         def step(carry, t):

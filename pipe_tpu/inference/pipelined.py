@@ -45,6 +45,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..parallel.mesh import STAGE_AXIS
 from .generate import (GenerationConfig, check_positions, head_logits,
                        sample_logits)
+from .quant import QuantLeaf, dequant_tree
 
 __all__ = ["PipelinedGenerator"]
 
@@ -92,6 +93,7 @@ class PipelinedGenerator:
         slab; returns (h, updated caches). ``caches``: pytree of
         ``[lps, n_groups, rpg, cache_len, nh, hd]``."""
         m = self.model
+        cd = m.cfg.compute_dtype
         lps = jax.tree_util.tree_leaves(caches)[0].shape[0]
 
         def slab_slice(a):
@@ -108,7 +110,8 @@ class PipelinedGenerator:
 
         def layer_step(h_c, inp):
             bp, cache = inp
-            h_new, cache = m.block.decode(bp, h_c, cache, pos)
+            h_new, cache = m.block.decode(dequant_tree(bp, cd), h_c,
+                                          cache, pos)
             return h_new, cache
 
         h, new_slab = jax.lax.scan(layer_step, h, (block_stack, slab))
@@ -127,7 +130,10 @@ class PipelinedGenerator:
         cache_len = p + max_new + p
         sac = p + max_new
 
-        blocks = [jax.tree_util.tree_map(lambda a: a[0].astype(cd), bp)
+        blocks = [jax.tree_util.tree_map(
+                      lambda a: a[0] if isinstance(a[0], QuantLeaf)
+                      else a[0].astype(cd),
+                      bp, is_leaf=lambda x: isinstance(x, QuantLeaf))
                   for bp in stage_params]
         block_stack = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *blocks)

@@ -27,6 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from pipe_tpu.inference import GenerationConfig, Generator
+from pipe_tpu.inference.quant import quantize_params
 from pipe_tpu.models.transformer_lm import PipelinedLM
 
 from bench import tutorial_config, with_retries
@@ -35,11 +36,18 @@ PROMPT = int(os.environ.get("GEN_BENCH_PROMPT", "128"))
 MAX_NEW = int(os.environ.get("GEN_BENCH_NEW", "128"))
 
 
-def main(batches):
+def main(batches, int8=False):
     platform = jax.default_backend()
     cfg = tutorial_config(platform)
     model = PipelinedLM(cfg, 1)
-    params = model.init(jax.random.key(0))
+    sp, pre, post = model.init(jax.random.key(0))
+    if int8:
+        # Block weights only. Quantizing the vocab head was measured
+        # COUNTERPRODUCTIVE (b=1: 33.5 ms/token vs 2.1 block-only): XLA
+        # materializes the dequantized [d_model, vocab] f32 matrix every
+        # step instead of fusing the dequant into the projection read.
+        sp = quantize_params(sp)
+    params = (sp, pre, post)
     gen = Generator(model, GenerationConfig(max_new_tokens=MAX_NEW,
                                             temperature=0.0))
 
@@ -63,7 +71,8 @@ def main(batches):
                   flush=True)
             continue
         print(json.dumps({
-            "platform": platform, "batch": b, "prompt": PROMPT,
+            "platform": platform, "weights": "int8" if int8 else "native",
+            "batch": b, "prompt": PROMPT,
             "max_new": MAX_NEW,
             "sec_per_generate": round(sec, 4),
             "ms_per_token_per_seq": round(1000 * sec / MAX_NEW, 3),
@@ -72,4 +81,7 @@ def main(batches):
 
 
 if __name__ == "__main__":
-    main([int(a) for a in sys.argv[1:]] or [1, 8, 32])
+    args = sys.argv[1:]
+    int8 = "--int8" in args
+    args = [a for a in args if a != "--int8"]
+    main([int(a) for a in args] or [1, 8, 32], int8=int8)
