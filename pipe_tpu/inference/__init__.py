@@ -1,7 +1,10 @@
 """Inference: KV-cached autoregressive generation over the pipelined LMs."""
 
 from .generate import GenerationConfig, Generator, sample_logits
+from .long_context import ContextShardedGenerator
 from .pipelined import PipelinedGenerator
+from .quant import QuantLeaf, dequant_tree, quantize_params
 
 __all__ = ["GenerationConfig", "Generator", "PipelinedGenerator",
-           "sample_logits"]
+           "ContextShardedGenerator", "QuantLeaf", "quantize_params",
+           "dequant_tree", "sample_logits"]
