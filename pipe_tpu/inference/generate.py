@@ -31,6 +31,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .quant import QuantLeaf, dequant_tree
+
 __all__ = ["GenerationConfig", "Generator", "check_positions",
            "head_logits", "sample_logits"]
 
@@ -134,26 +136,38 @@ class Generator:
     def _dq(self, bp):
         """Materialize block weights at use time (int8 -> compute dtype
         inside the compiled step; identity when unquantized)."""
-        from .quant import dequant_tree
         return dequant_tree(bp, self.model.cfg.compute_dtype)
 
     def _head(self, post_params, h):
         return head_logits(self.model, post_params, h)
+
+    def _prefill(self, blocks, pre_params, prompt, max_len):
+        """One batched causal pass: embeds the prompt, writes rows
+        [0, prompt_len) of every layer's cache. Returns (h, caches)."""
+        m = self.model
+        b = prompt.shape[0]
+        caches = [m.block.attn.make_cache(b, max_len,
+                                          dtype=m.cfg.compute_dtype)
+                  for _ in blocks]
+        h = m.embed_at(pre_params, prompt, 0)
+        for l, bp in enumerate(blocks):
+            h, caches[l] = m.block.decode(self._dq(bp), h, caches[l], 0)
+        return h, caches
+
+    def _layer_step(self, h_carry, inp):
+        """Scan body over the stacked layers: one cached decode step."""
+        bp, cache = inp
+        h_new, cache = self.model.block.decode(self._dq(bp), h_carry[0],
+                                               cache, h_carry[1])
+        return (h_new, h_carry[1]), cache
 
     def _generate(self, params, prompt, key):
         m, gen = self.model, self.gen_cfg
         stage_params, pre_params, post_params = params
         blocks = self._blocks(stage_params)
         b, p = prompt.shape
-        max_len = p + gen.max_new_tokens
-        caches = [m.block.attn.make_cache(b, max_len,
-                                          dtype=m.cfg.compute_dtype)
-                  for _ in blocks]
-
-        # prefill: one batched causal pass writes rows [0, p) of every cache
-        h = m.embed_at(pre_params, prompt, 0)
-        for l, bp in enumerate(blocks):
-            h, caches[l] = m.block.decode(self._dq(bp), h, caches[l], 0)
+        h, caches = self._prefill(blocks, pre_params, prompt,
+                                  p + gen.max_new_tokens)
         key, sub = jax.random.split(key)
         tok = sample_logits(self._head(post_params, h[:, -1:, :])[:, 0, :],
                             sub, gen)
@@ -164,17 +178,11 @@ class Generator:
         block_stack = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *blocks)
 
-        def layer_step(h_carry, inp):
-            bp, cache = inp
-            h_new, cache = m.block.decode(self._dq(bp), h_carry[0], cache,
-                                          h_carry[1])
-            return (h_new, h_carry[1]), cache
-
         def step(carry, _):
             caches, tok, pos, key = carry
             h = m.embed_at(pre_params, tok[:, None], pos)
             (h, _), caches = jax.lax.scan(
-                layer_step, (h, pos), (block_stack, caches))
+                self._layer_step, (h, pos), (block_stack, caches))
             key, sub = jax.random.split(key)
             nxt = sample_logits(self._head(post_params, h)[:, 0, :],
                                 sub, gen)
@@ -200,15 +208,9 @@ class Generator:
         stage_params, pre_params, post_params = params
         blocks = self._blocks(stage_params)
         b, p = prompt.shape
-        max_len = p + gen.max_new_tokens
-        caches = [m.block.attn.make_cache(b, max_len,
-                                          dtype=m.cfg.compute_dtype)
-                  for _ in blocks]
-
         # prefill on the UNtiled batch, then branch into k beams
-        h = m.embed_at(pre_params, prompt, 0)
-        for l, bp in enumerate(blocks):
-            h, caches[l] = m.block.decode(self._dq(bp), h, caches[l], 0)
+        h, caches = self._prefill(blocks, pre_params, prompt,
+                                  p + gen.max_new_tokens)
         logp = jax.nn.log_softmax(
             self._head(post_params, h[:, -1:, :])[:, 0, :], axis=-1)
         scores, tok = jax.lax.top_k(logp, k)          # [b, k] each
@@ -224,18 +226,12 @@ class Generator:
         out0 = jnp.zeros((b, k, gen.max_new_tokens), jnp.int32)
         out0 = out0.at[:, :, 0].set(tok)
 
-        def layer_step(h_carry, inp):
-            bp, cache = inp
-            h_new, cache = m.block.decode(self._dq(bp), h_carry[0], cache,
-                                          h_carry[1])
-            return (h_new, h_carry[1]), cache
-
         def step(carry, t):
             caches, scores, tok, out = carry
             pos = p + t
             h = m.embed_at(pre_params, tok.reshape(b * k, 1), pos)
             (h, _), caches = jax.lax.scan(
-                layer_step, (h, pos), (block_stack, caches))
+                self._layer_step, (h, pos), (block_stack, caches))
             logp = jax.nn.log_softmax(
                 self._head(post_params, h)[:, 0, :], axis=-1)  # [b*k, V]
             V = logp.shape[-1]

@@ -57,21 +57,13 @@ def _quantize_leaf(w: jax.Array) -> QuantLeaf:
     return QuantLeaf(q=q, scale=scale)
 
 
-def _quantizable(path, leaf) -> bool:
-    if not isinstance(leaf, (jax.Array, jnp.ndarray)) or leaf.ndim < 2:
-        return False
-    # LayerNorm params are 1-D; embeddings are lookup tables (gathered,
-    # not matmul'd — quantizing them saves bytes only on the gathered
-    # rows, and they sit in pre/post params anyway). Everything 2-D in
-    # the block trees is a projection weight.
-    return True
-
-
 def quantize_params(stage_params) -> Any:
     """Quantize every >=2-D weight leaf of the (per-stage) block trees.
 
     Input is the ``stage_params`` list from ``model.init`` (or any block
-    pytree); biases/LN vectors stay float. The returned tree has the same
+    pytree); 1-D leaves (biases, LayerNorm params) stay float — and
+    embeddings sit in pre/post params, untouched, since they are gathered
+    rather than matmul'd. The returned tree has the same
     structure with weights replaced by :class:`QuantLeaf` nodes — feed it
     to the generators in place of the original stage params.
     """
