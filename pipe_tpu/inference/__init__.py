@@ -4,7 +4,8 @@ from .generate import GenerationConfig, Generator, sample_logits
 from .long_context import ContextShardedGenerator
 from .pipelined import PipelinedGenerator
 from .quant import QuantLeaf, dequant_tree, quantize_params
+from .tp import TPShardedGenerator
 
 __all__ = ["GenerationConfig", "Generator", "PipelinedGenerator",
-           "ContextShardedGenerator", "QuantLeaf", "quantize_params",
-           "dequant_tree", "sample_logits"]
+           "ContextShardedGenerator", "TPShardedGenerator", "QuantLeaf",
+           "quantize_params", "dequant_tree", "sample_logits"]

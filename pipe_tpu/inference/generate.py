@@ -141,14 +141,19 @@ class Generator:
     def _head(self, post_params, h):
         return head_logits(self.model, post_params, h)
 
+    def _make_caches(self, blocks, batch, max_len):
+        """One KV cache per layer (hook: the TP generator overrides this
+        to size caches by the LOCAL head shard)."""
+        m = self.model
+        return [m.block.attn.make_cache(batch, max_len,
+                                        dtype=m.cfg.compute_dtype)
+                for _ in blocks]
+
     def _prefill(self, blocks, pre_params, prompt, max_len):
         """One batched causal pass: embeds the prompt, writes rows
         [0, prompt_len) of every layer's cache. Returns (h, caches)."""
         m = self.model
-        b = prompt.shape[0]
-        caches = [m.block.attn.make_cache(b, max_len,
-                                          dtype=m.cfg.compute_dtype)
-                  for _ in blocks]
+        caches = self._make_caches(blocks, prompt.shape[0], max_len)
         h = m.embed_at(pre_params, prompt, 0)
         for l, bp in enumerate(blocks):
             h, caches[l] = m.block.decode(self._dq(bp), h, caches[l], 0)

@@ -1,0 +1,111 @@
+"""Tensor-parallel decoding: serve Megatron-sharded weights as trained.
+
+A model whose blocks shard over the ``model`` mesh axis (heads + FFN
+columns, ``ops/tp_layers.py``) decodes with the SAME split: each device
+projects q/k/v for its local heads, keeps a head-sharded KV cache (cache
+memory divides by tp like the weights), attends locally, and the block's
+two psums (attention output projection, FFN second matmul) are the only
+per-layer communication — identical structure to the training forward,
+so serving needs no weight conversion and no resharding.
+
+Implementation: :class:`TPShardedGenerator` subclasses the single-device
+:class:`~.generate.Generator` — the inherited prefill/decode program runs
+unchanged as the shard_map device program (``tp_block_decode`` binds the
+model axis inside); only cache creation (local head count) and the jit
+wrapping (per-leaf PartitionSpecs from ``tp_block_specs``) differ.
+
+``tests/test_tp_gen.py`` pins greedy tp=2 output token-for-token against
+the unsharded (``tp_axis=None``) model on the same weights.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.tp_lm import TPPipelinedLM
+from ..ops.tp_layers import tp_block_specs
+from ..parallel.mesh import MODEL_AXIS
+from .generate import GenerationConfig, Generator, check_positions
+
+__all__ = ["TPShardedGenerator"]
+
+
+class TPShardedGenerator(Generator):
+    """KV-cached decoding over tensor-parallel (model-axis-sharded) weights.
+
+    ``model`` must be a :class:`TPPipelinedLM` with ``tp_axis=MODEL_AXIS``
+    (the default); params are ``model.init``'s full trees — the per-leaf
+    specs shard them on entry. Beam search is single-device only.
+    """
+
+    def __init__(self, mesh: Mesh, model: TPPipelinedLM,
+                 gen_cfg: GenerationConfig = GenerationConfig()):
+        if MODEL_AXIS not in mesh.axis_names:
+            raise ValueError(f"mesh must have a {MODEL_AXIS!r} axis")
+        if getattr(model.block, "tp_axis", None) != MODEL_AXIS:
+            raise ValueError(
+                "TPShardedGenerator needs a model built with "
+                f"tp_axis={MODEL_AXIS!r} (got "
+                f"{getattr(model.block, 'tp_axis', None)!r})")
+        if gen_cfg.num_beams > 1:
+            raise ValueError("beam search is single-device only")
+        super().__init__(model, gen_cfg)
+        self.mesh = mesh
+        self.tp = mesh.shape[MODEL_AXIS]
+        if model.cfg.nhead % self.tp:
+            raise ValueError(f"nhead={model.cfg.nhead} must divide over "
+                             f"tp={self.tp}")
+        self._programs = {}
+
+    def _make_caches(self, blocks, batch, max_len):
+        """Caches sized by the LOCAL head shard (blocks arrive inside
+        shard_map with their model-axis slices)."""
+        cd = self.model.cfg.compute_dtype
+        caches = []
+        for bp in blocks:
+            h_local, hd = bp["wqkv"].shape[2], bp["wqkv"].shape[3]
+            shape = (batch, max_len, h_local, hd)
+            caches.append({"k": jnp.zeros(shape, cd),
+                           "v": jnp.zeros(shape, cd)})
+        return caches
+
+    def generate(self, params, prompt: jax.Array,
+                 key: Optional[jax.Array] = None) -> jax.Array:
+        """Sample ``[b, max_new_tokens]`` continuations with the weights
+        sharded over the model axis."""
+        stage_params, pre_params, post_params = params
+        check_positions(self.model, prompt.shape[1],
+                        self.gen_cfg.max_new_tokens)
+        if key is None:
+            key = jax.random.key(0)
+
+        cache_key = (prompt.shape,
+                     jax.tree_util.tree_structure(params))
+        run = self._programs.get(cache_key)
+        if run is None:
+            stage_specs = [
+                [tp_block_specs() for _ in stage] for stage in stage_params]
+            in_specs = (
+                stage_specs,
+                jax.tree_util.tree_map(lambda _: P(), pre_params),
+                jax.tree_util.tree_map(lambda _: P(), post_params),
+                P(), P(),
+            )
+            run = jax.jit(jax.shard_map(
+                lambda sp, pre, post, pr, k: self._generate(
+                    (sp, pre, post), pr, k),
+                mesh=self.mesh, in_specs=in_specs, out_specs=P(),
+                check_vma=False))
+            self._programs[cache_key] = run
+        return run(stage_params, pre_params, post_params,
+                   jnp.asarray(prompt, jnp.int32), key)
+
+    def generate_with_scores(self, params, prompt):
+        raise NotImplementedError(
+            "beam search over TP-sharded weights is not supported; "
+            "use the single-device Generator (tp_axis=None)")

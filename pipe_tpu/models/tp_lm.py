@@ -60,12 +60,27 @@ def tp_split_backward_stage(cfg: LMConfig):
                               zs_fn=zs_fn)
 
 
+class _TPCacheShim:
+    """make_cache provider (the ``block.attn`` surface the generators
+    expect); ``nhead`` here is the FULL head count — the TP generator
+    overrides cache creation with the local shard count."""
+
+    def __init__(self, cfg: LMConfig):
+        self.nhead = cfg.nhead
+        self.head_dim = cfg.d_model // cfg.nhead
+
+    def make_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        shape = (batch, max_len, self.nhead, self.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
 class _TPBlock:
     """Module shim over the functional TP block (init/apply contract)."""
 
     def __init__(self, cfg: LMConfig, tp_axis):
         self.cfg = cfg
         self.tp_axis = tp_axis
+        self.attn = _TPCacheShim(cfg)
 
     def init(self, key, h_spec):
         del h_spec
@@ -75,6 +90,13 @@ class _TPBlock:
     def apply(self, p, h, ctx: StageCtx = StageCtx()):
         return tp_block_apply(p, h, ctx, dropout=self.cfg.dropout,
                               causal=self.cfg.causal, tp_axis=self.tp_axis)
+
+    def decode(self, p, h, cache, pos):
+        """Incremental apply with a KV cache (inference; heads local)."""
+        from ..ops.tp_layers import tp_block_decode
+        if not self.cfg.causal:
+            raise ValueError("KV-cache decode requires causal attention")
+        return tp_block_decode(p, h, cache, pos, tp_axis=self.tp_axis)
 
 
 class TPPipelinedLM(PipelinedLM):

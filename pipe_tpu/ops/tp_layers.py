@@ -48,8 +48,9 @@ from jax.sharding import PartitionSpec as P
 from ..core.partition import StageCtx
 from ..parallel.mesh import MODEL_AXIS
 
-__all__ = ["tp_block_init", "tp_block_apply", "tp_block_specs", "tp_enter",
-           "tp_allreduce", "tp_attention_sublayer", "tp_attention_init"]
+__all__ = ["tp_block_init", "tp_block_apply", "tp_block_decode",
+           "tp_block_specs", "tp_enter", "tp_allreduce",
+           "tp_attention_sublayer", "tp_attention_init"]
 
 
 def tp_attention_init(key: jax.Array, d_model: int, nhead: int,
@@ -220,6 +221,45 @@ def tp_attention_sublayer(p: Dict[str, Any], h: jax.Array, *,
     # tp_enter grad contract (no model-axis grad reduction anywhere).
     out = psum(jnp.einsum("bshk,hkd->bsd", attn, p["wo"])) + p["bo"]
     return h + _dropout(out, dropout, key)
+
+
+def tp_block_decode(p: Dict[str, Any], h: jax.Array, cache, pos,
+                    *, tp_axis: Optional[str] = MODEL_AXIS):
+    """Incremental :func:`tp_block_apply` with a KV cache (inference).
+
+    ``h``: the new tokens' hidden states ``[b, q, d]``, replicated over
+    the model axis; ``cache``: ``{"k","v"}`` of ``[b, max_len, H_local,
+    hd]`` — the cache shards BY HEADS with the attention weights, so KV
+    memory also divides by tp. Same two psums per block as the training
+    forward; causal by construction (each query attends cache rows
+    ``<= its own position``).
+    """
+    psum, _ = _ops_for(tp_axis)
+    b, q, d = h.shape
+
+    hn = _layernorm(h, p["ln1"])
+    qkv = jnp.einsum("bsd,dthk->btshk", hn, p["wqkv"]) + p["bqkv"][:, None]
+    qh, kh, vh = qkv[:, 0], qkv[:, 1], qkv[:, 2]     # [b, q, Hl, hd]
+    hd = qh.shape[-1]
+    ck = jax.lax.dynamic_update_slice(
+        cache["k"], kh.astype(cache["k"].dtype), (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cache["v"], vh.astype(cache["v"].dtype), (0, pos, 0, 0))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, ck).astype(
+        jnp.float32) / jnp.sqrt(jnp.float32(hd))
+    kpos = jnp.arange(ck.shape[1])[None, None, None, :]
+    qpos = pos + jnp.arange(q)[None, None, :, None]
+    logits = jnp.where(kpos <= qpos, logits,
+                       jnp.asarray(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)  # [b, q, Hl, hd]
+    out = psum(jnp.einsum("bshk,hkd->bsd", attn, p["wo"])) + p["bo"]
+    h = h + out
+
+    hn2 = _layernorm(h, p["ln2"])
+    inner = jax.nn.gelu(hn2 @ p["w1"] + p["b1"])
+    ff = psum(inner @ p["w2"]) + p["b2"]
+    return h + ff, {"k": ck, "v": cv}
 
 
 def tp_block_tapped(p: Dict[str, Any], h: jax.Array, ctx: StageCtx, zs,
