@@ -14,8 +14,11 @@ unchanged as the shard_map device program (``tp_block_decode`` binds the
 model axis inside); only cache creation (local head count) and the jit
 wrapping (per-leaf PartitionSpecs from ``tp_block_specs``) differ.
 
-``tests/test_tp_gen.py`` pins greedy tp=2 output token-for-token against
-the unsharded (``tp_axis=None``) model on the same weights.
+``tests/test_tp_gen.py`` pins greedy tp=2/tp=4 output token-for-token
+against the unsharded (``tp_axis=None``) model on the same weights;
+``tests/test_moe_gen.py`` does the same for the MoE family (experts +
+heads sharded — ``moe_block_decode`` routes per-token, so the dense
+dispatch works unchanged at q=1).
 """
 
 from __future__ import annotations
@@ -27,8 +30,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.tp_lm import TPPipelinedLM
-from ..ops.tp_layers import tp_block_specs
 from ..parallel.mesh import MODEL_AXIS
 from .generate import GenerationConfig, Generator, check_positions
 
@@ -36,14 +37,17 @@ __all__ = ["TPShardedGenerator"]
 
 
 class TPShardedGenerator(Generator):
-    """KV-cached decoding over tensor-parallel (model-axis-sharded) weights.
+    """KV-cached decoding over model-axis-sharded weights.
 
-    ``model`` must be a :class:`TPPipelinedLM` with ``tp_axis=MODEL_AXIS``
-    (the default); params are ``model.init``'s full trees — the per-leaf
-    specs shard them on entry. Beam search is single-device only.
+    Works for any LM whose block exposes ``tp_axis=MODEL_AXIS``, a
+    cache-aware ``decode``, and whose model provides ``stage_param_specs``
+    (per-leaf PartitionSpecs) — :class:`TPPipelinedLM` (Megatron split)
+    and :class:`~..models.moe_lm.MoEPipelinedLM` (experts + heads
+    sharded). Params are ``model.init``'s full trees — the per-leaf specs
+    shard them on entry. Beam search is single-device only.
     """
 
-    def __init__(self, mesh: Mesh, model: TPPipelinedLM,
+    def __init__(self, mesh: Mesh, model,
                  gen_cfg: GenerationConfig = GenerationConfig()):
         if MODEL_AXIS not in mesh.axis_names:
             raise ValueError(f"mesh must have a {MODEL_AXIS!r} axis")
@@ -88,8 +92,8 @@ class TPShardedGenerator(Generator):
                      jax.tree_util.tree_structure(params))
         run = self._programs.get(cache_key)
         if run is None:
-            stage_specs = [
-                [tp_block_specs() for _ in stage] for stage in stage_params]
+            stage_specs = [self.model.stage_param_specs()
+                           for _ in stage_params]
             in_specs = (
                 stage_specs,
                 jax.tree_util.tree_map(lambda _: P(), pre_params),

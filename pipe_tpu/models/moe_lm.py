@@ -37,6 +37,12 @@ class _MoEBlock:
     def __init__(self, cfg: MoELMConfig, ep_axis):
         self.cfg = cfg
         self.ep_axis = ep_axis
+        # the generators' sharding/cache contract names (heads shard over
+        # the same axis as the experts; full-head cache shim for the
+        # unsharded path)
+        self.tp_axis = ep_axis
+        from .tp_lm import _TPCacheShim
+        self.attn = _TPCacheShim(cfg)
 
     def init(self, key, h_spec):
         del h_spec
@@ -51,6 +57,16 @@ class _MoEBlock:
             capacity_factor=cfg.capacity_factor, dropout=cfg.dropout,
             causal=cfg.causal, ep_axis=self.ep_axis)
         return out
+
+    def decode(self, p, h, cache, pos):
+        """Incremental apply with a KV cache (inference; aux discarded)."""
+        from ..ops.moe import moe_block_decode
+        cfg = self.cfg
+        if not cfg.causal:
+            raise ValueError("KV-cache decode requires causal attention")
+        return moe_block_decode(
+            p, h, cache, pos, n_experts=cfg.n_experts, k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor, ep_axis=self.ep_axis)
 
 
 class MoEPipelinedLM(PipelinedLM):

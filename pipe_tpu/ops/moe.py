@@ -41,7 +41,8 @@ from .tp_layers import (tp_allreduce, tp_attention_init,
                         _layernorm)
 
 __all__ = ["moe_ffn_init", "moe_ffn_apply", "moe_ffn_specs", "moe_capacity",
-           "moe_block_init", "moe_block_apply", "moe_block_specs"]
+           "moe_block_init", "moe_block_apply", "moe_block_decode",
+           "moe_block_specs"]
 
 
 def moe_ffn_init(key: jax.Array, d_model: int, d_ff: int, n_experts: int,
@@ -197,6 +198,30 @@ def moe_block_specs() -> Dict[str, Any]:
         "wo": t["wo"], "bo": t["bo"], "ln2": t["ln2"],
         "moe": moe_ffn_specs(),
     }
+
+
+def moe_block_decode(p: Dict[str, Any], h: jax.Array, cache, pos, *,
+                     n_experts: int, k: int = 2,
+                     capacity_factor: float = 1.25,
+                     ep_axis: Optional[str] = MODEL_AXIS):
+    """Incremental :func:`moe_block_apply` with a KV cache (inference):
+    cached TP attention (heads sharded over the same axis as the
+    experts), then the MoE FFN on the new positions — routing is
+    per-token, so the dense dispatch works unchanged at q=1; the aux loss
+    is discarded (inference). NOTE: GShard capacity is computed from the
+    CURRENT call's token count, so at tiny decode batches use a generous
+    ``capacity_factor`` if parity with a full-sequence forward matters
+    (over-capacity tokens fall through on the residual, in both paths).
+    Returns ``(h, new_cache)``."""
+    from .tp_layers import tp_attention_decode
+
+    h, cache = tp_attention_decode(p, h, cache, pos, tp_axis=ep_axis)
+    hn = _layernorm(h, p["ln2"])
+    ff, _aux = moe_ffn_apply(p["moe"], hn, StageCtx(), k=k,
+                             n_experts=n_experts,
+                             capacity_factor=capacity_factor,
+                             ep_axis=ep_axis)
+    return h + ff, cache
 
 
 def moe_block_apply(p: Dict[str, Any], h: jax.Array, ctx: StageCtx, *,

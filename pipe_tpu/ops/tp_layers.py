@@ -48,7 +48,8 @@ from jax.sharding import PartitionSpec as P
 from ..core.partition import StageCtx
 from ..parallel.mesh import MODEL_AXIS
 
-__all__ = ["tp_block_init", "tp_block_apply", "tp_block_decode",
+__all__ = ["tp_block_init", "tp_block_apply", "tp_attention_decode",
+           "tp_block_decode",
            "tp_block_specs", "tp_enter", "tp_allreduce",
            "tp_attention_sublayer", "tp_attention_init"]
 
@@ -223,16 +224,17 @@ def tp_attention_sublayer(p: Dict[str, Any], h: jax.Array, *,
     return h + _dropout(out, dropout, key)
 
 
-def tp_block_decode(p: Dict[str, Any], h: jax.Array, cache, pos,
-                    *, tp_axis: Optional[str] = MODEL_AXIS):
-    """Incremental :func:`tp_block_apply` with a KV cache (inference).
+def tp_attention_decode(p: Dict[str, Any], h: jax.Array, cache, pos,
+                        *, tp_axis: Optional[str] = MODEL_AXIS):
+    """Incremental :func:`tp_attention_sublayer` with a KV cache
+    (inference), including the residual add.
 
     ``h``: the new tokens' hidden states ``[b, q, d]``, replicated over
     the model axis; ``cache``: ``{"k","v"}`` of ``[b, max_len, H_local,
     hd]`` — the cache shards BY HEADS with the attention weights, so KV
-    memory also divides by tp. Same two psums per block as the training
-    forward; causal by construction (each query attends cache rows
-    ``<= its own position``).
+    memory also divides by tp. One psum (the row-parallel output
+    projection); causal by construction (each query attends cache rows
+    ``<= its own position``). Returns ``(h, new_cache)``.
     """
     psum, _ = _ops_for(tp_axis)
     b, q, d = h.shape
@@ -254,12 +256,20 @@ def tp_block_decode(p: Dict[str, Any], h: jax.Array, cache, pos,
     probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)  # [b, q, Hl, hd]
     out = psum(jnp.einsum("bshk,hkd->bsd", attn, p["wo"])) + p["bo"]
-    h = h + out
+    return h + out, {"k": ck, "v": cv}
 
+
+def tp_block_decode(p: Dict[str, Any], h: jax.Array, cache, pos,
+                    *, tp_axis: Optional[str] = MODEL_AXIS):
+    """Incremental :func:`tp_block_apply` with a KV cache (inference):
+    cached TP attention, then the column/row FFN (the block's second
+    psum). Returns ``(h, new_cache)``."""
+    psum, _ = _ops_for(tp_axis)
+    h, cache = tp_attention_decode(p, h, cache, pos, tp_axis=tp_axis)
     hn2 = _layernorm(h, p["ln2"])
     inner = jax.nn.gelu(hn2 @ p["w1"] + p["b1"])
     ff = psum(inner @ p["w2"]) + p["b2"]
-    return h + ff, {"k": ck, "v": cv}
+    return h + ff, cache
 
 
 def tp_block_tapped(p: Dict[str, Any], h: jax.Array, ctx: StageCtx, zs,
