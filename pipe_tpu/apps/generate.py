@@ -37,6 +37,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--beams", type=int, default=1,
                    help=">1: beam search (deterministic; single-device "
                         "generator only)")
+    p.add_argument("--int8", action="store_true",
+                   help="int8 weight-only quantized block weights "
+                        "(inference/quant.py)")
     p.add_argument("--stages", type=int, default=1,
                    help=">1: ring-pipelined decode over a stage mesh")
     p.add_argument("--tiny", action="store_true")
@@ -129,6 +132,10 @@ def main(argv=None) -> int:
                   pre, post)
     else:
         params = model.init(jax.random.key(args.seed))
+    if args.int8:
+        from ..inference.quant import quantize_params
+        sp_q, pre_q, post_q = params
+        params = (quantize_params(sp_q), pre_q, post_q)
     prompt = jnp.asarray([ids] * batch, jnp.int32)
     gen_cfg = GenerationConfig(max_new_tokens=args.max_new,
                                temperature=args.temperature,

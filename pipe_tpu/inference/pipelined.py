@@ -130,10 +130,16 @@ class PipelinedGenerator:
         cache_len = p + max_new + p
         sac = p + max_new
 
+        def local_slice(a):
+            # this device's stage slice (leading dim n/n_devices == 1);
+            # QuantLeaf nodes slice through their children, stay quantized
+            if isinstance(a, QuantLeaf):
+                return QuantLeaf(q=a.q[0], scale=a.scale[0])
+            return a[0].astype(cd)
+
         blocks = [jax.tree_util.tree_map(
-                      lambda a: a[0] if isinstance(a[0], QuantLeaf)
-                      else a[0].astype(cd),
-                      bp, is_leaf=lambda x: isinstance(x, QuantLeaf))
+                      local_slice, bp,
+                      is_leaf=lambda x: isinstance(x, QuantLeaf))
                   for bp in stage_params]
         block_stack = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *blocks)

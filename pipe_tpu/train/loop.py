@@ -255,6 +255,30 @@ class Trainer:
         log_fn(f"| autosave: step {int(state.step)} checkpointed to "
                f"{self._autosave_dir} (stop requested)")
 
+    def generate(self, state: TrainState, prompt, *,
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 top_k: Optional[int] = None, num_beams: int = 1,
+                 key: Optional[jax.Array] = None):
+        """Sample continuations from the trained weights — the train-state
+        params (stage-stacked, mesh-placed) unstack straight into the
+        KV-cached generator; no conversion, no checkpoint round-trip."""
+        from ..inference import GenerationConfig, Generator
+
+        sp, pre, post = jax.tree_util.tree_map(np.asarray, state.params)
+        if self.cfg.schedule in ("interleaved", "interleaved-1f1b"):
+            from ..parallel.interleaved import unstack_interleaved_params
+            per_stage = unstack_interleaved_params(sp, self.cfg.n_stages)
+        else:
+            from ..parallel.spmd import unstack_stage_params
+            per_stage = unstack_stage_params(sp, self.n_virtual)
+        gen = Generator(self.model,
+                        GenerationConfig(max_new_tokens=max_new_tokens,
+                                         temperature=temperature,
+                                         top_k=top_k, num_beams=num_beams))
+        # the generator flattens blocks itself; hand it one "stage" per
+        # virtual stage in true layer order
+        return gen.generate((per_stage, pre, post), prompt, key=key)
+
     def save(self, directory: str, state: TrainState,
              step: Optional[int] = None) -> None:
         """Checkpoint with the stage-stack layout recorded (so serving can
