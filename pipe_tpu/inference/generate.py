@@ -105,15 +105,25 @@ class Generator:
     ``generate`` is jitted per (batch, prompt_len) shape; params are the
     ``(stage_params, pre_params, post_params)`` triple from ``model.init``
     (the training layout — no weight conversion between train and serve).
+
+    ``layer_scan=False`` unrolls the per-layer loop inside the decode
+    step and carries the KV caches as two stacked arrays in the OUTER
+    scan, updated in place per layer — avoiding the inner ``lax.scan``'s
+    xs->ys round-trip of the full cache every token (measured 1.16x at
+    the 520M scale, batch 32, where decode is cache-traffic-bound). Same
+    math; float reduction order differs, so greedy ties can resolve
+    differently on near-flat (e.g. untrained) logits.
     """
 
-    def __init__(self, model, gen_cfg: GenerationConfig = GenerationConfig()):
+    def __init__(self, model, gen_cfg: GenerationConfig = GenerationConfig(),
+                 *, layer_scan: bool = True):
         if not hasattr(model, "embed_at"):
             raise TypeError(
                 f"{type(model).__name__} has no embed_at; KV-cache "
                 "generation needs position-offset embedding")
         self.model = model
         self.gen_cfg = gen_cfg
+        self.layer_scan = layer_scan
         self._jitted = jax.jit(self._generate)
         self._jitted_beam = None  # built on first beam-search call
 
@@ -180,14 +190,29 @@ class Generator:
         # decode: one token per scan step, O(1) new work per layer
         cache_stack = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *caches)
-        block_stack = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *blocks)
+        if self.layer_scan:
+            block_stack = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *blocks)
+
+            def run_layers(h, pos, caches):
+                (h, _), caches = jax.lax.scan(
+                    self._layer_step, (h, pos), (block_stack, caches))
+                return h, caches
+        else:
+            # unrolled: per-layer in-place row writes on the OUTER carry —
+            # no inner-scan xs->ys round-trip of the full cache per token
+            def run_layers(h, pos, caches):
+                for l, bp in enumerate(blocks):
+                    c_l = jax.tree_util.tree_map(lambda a: a[l], caches)
+                    h, c_l = m.block.decode(self._dq(bp), h, c_l, pos)
+                    caches = jax.tree_util.tree_map(
+                        lambda a, n: a.at[l].set(n), caches, c_l)
+                return h, caches
 
         def step(carry, _):
             caches, tok, pos, key = carry
             h = m.embed_at(pre_params, tok[:, None], pos)
-            (h, _), caches = jax.lax.scan(
-                self._layer_step, (h, pos), (block_stack, caches))
+            h, caches = run_layers(h, pos, caches)
             key, sub = jax.random.split(key)
             nxt = sample_logits(self._head(post_params, h)[:, 0, :],
                                 sub, gen)

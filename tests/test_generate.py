@@ -284,3 +284,16 @@ def test_beam_max_new_one_equals_greedy():
     b, _ = Generator(model, GenerationConfig(max_new_tokens=1, num_beams=3)
                      ).generate_with_scores(params, prompt)
     np.testing.assert_array_equal(np.asarray(b), np.asarray(g))
+
+
+def test_layer_scan_false_matches_default():
+    """The unrolled-layer decode path (outer-carry caches, in-place row
+    writes) is the same math as the inner-scan path."""
+    model, params = _model_and_params()
+    prompt = jax.random.randint(jax.random.key(30), (2, 8), 0, CFG.vocab,
+                                jnp.int32)
+    cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    a = np.asarray(Generator(model, cfg).generate(params, prompt))
+    b = np.asarray(Generator(model, cfg, layer_scan=False).generate(
+        params, prompt))
+    np.testing.assert_array_equal(a, b)

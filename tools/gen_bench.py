@@ -36,7 +36,7 @@ PROMPT = int(os.environ.get("GEN_BENCH_PROMPT", "128"))
 MAX_NEW = int(os.environ.get("GEN_BENCH_NEW", "128"))
 
 
-def main(batches, int8=False):
+def main(batches, int8=False, unroll=False):
     platform = jax.default_backend()
     cfg = tutorial_config(platform)
     model = PipelinedLM(cfg, 1)
@@ -49,7 +49,8 @@ def main(batches, int8=False):
         sp = quantize_params(sp)
     params = (sp, pre, post)
     gen = Generator(model, GenerationConfig(max_new_tokens=MAX_NEW,
-                                            temperature=0.0))
+                                            temperature=0.0),
+                    layer_scan=not unroll)
 
     for b in batches:
         prompt = jax.random.randint(jax.random.key(1), (b, PROMPT),
@@ -72,6 +73,7 @@ def main(batches, int8=False):
             continue
         print(json.dumps({
             "platform": platform, "weights": "int8" if int8 else "native",
+            "layers": "unrolled" if unroll else "scan",
             "batch": b, "prompt": PROMPT,
             "max_new": MAX_NEW,
             "sec_per_generate": round(sec, 4),
@@ -83,5 +85,6 @@ def main(batches, int8=False):
 if __name__ == "__main__":
     args = sys.argv[1:]
     int8 = "--int8" in args
-    args = [a for a in args if a != "--int8"]
-    main([int(a) for a in args] or [1, 8, 32], int8=int8)
+    unroll = "--unroll" in args
+    args = [a for a in args if not a.startswith("--")]
+    main([int(a) for a in args] or [1, 8, 32], int8=int8, unroll=unroll)
