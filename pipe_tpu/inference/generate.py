@@ -121,6 +121,11 @@ class Generator:
             raise TypeError(
                 f"{type(model).__name__} has no embed_at; KV-cache "
                 "generation needs position-offset embedding")
+        if not layer_scan and gen_cfg.num_beams > 1:
+            raise ValueError(
+                "layer_scan=False is not implemented for beam search "
+                "(the beam path's cache-gather dominates its traffic; "
+                "use the default scan path)")
         self.model = model
         self.gen_cfg = gen_cfg
         self.layer_scan = layer_scan

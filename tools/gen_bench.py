@@ -86,5 +86,9 @@ if __name__ == "__main__":
     args = sys.argv[1:]
     int8 = "--int8" in args
     unroll = "--unroll" in args
+    unknown = [a for a in args
+               if a.startswith("--") and a not in ("--int8", "--unroll")]
+    if unknown:
+        sys.exit(f"unknown flags: {unknown} (valid: --int8 --unroll)")
     args = [a for a in args if not a.startswith("--")]
     main([int(a) for a in args] or [1, 8, 32], int8=int8, unroll=unroll)
