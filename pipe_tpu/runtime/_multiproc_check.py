@@ -23,6 +23,7 @@ plane must be a pure layout choice.
 
 from __future__ import annotations
 
+import functools
 import sys
 
 
@@ -66,8 +67,47 @@ def _build(mesh):
     return pipe, stacked, xs, w
 
 
-def single_process_loss(devices=None) -> float:
-    """Reference: the same step on a single-process 4-device mesh."""
+def _zero_step(mesh, pipe, stacked, xs, w):
+    """One train step with ZeRO-1 moments sharded over the DATA axis of
+    ``mesh`` — on the 2-process topology that axis SPANS the processes,
+    so the partitioned Adam update and the param re-gather cross the DCN
+    analogue. Returns ``(loss, checksum-of-updated-params)`` (both
+    replicated scalars; layout must never change the math)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..train import zero as zero_mod
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tx = optax.adam(1e-2)
+    shardings = zero_mod.moment_shardings(
+        mesh, stacked, jax.eval_shape(tx.init, stacked))
+    repl = NamedSharding(mesh, P())
+
+    # outputs must be FULLY REPLICATED so float() works on the multihost
+    # topology (a process can only fetch addressable values)
+    # xs/w/params must enter as jit ARGUMENTS: on the 2-process topology
+    # they span both processes, and closed-over constants cannot
+    @functools.partial(jax.jit, out_shardings=(repl, repl))
+    def step(params, xs, w):
+        opt_state = zero_mod.constrain_moments(tx.init(params), shardings)
+        loss, grads = pipe.loss_and_grad(params, {}, {}, xs, w)
+        updates, opt_state = tx.update(grads[0], opt_state, params)
+        new = optax.apply_updates(params, updates)
+        zero_mod.constrain_moments(opt_state, shardings)
+        checksum = sum(jnp.sum(jnp.abs(a.astype(jnp.float32)))
+                       for a in jax.tree_util.tree_leaves(new))
+        return loss, checksum
+
+    loss, checksum = step(stacked, xs, w)
+    return float(loss), float(checksum)
+
+
+def single_process_loss(devices=None):
+    """Reference: the same step on a single-process 4-device mesh.
+    Returns ``(loss, zero_checksum)``."""
     import jax
 
     from ..parallel.mesh import make_mesh
@@ -76,7 +116,8 @@ def single_process_loss(devices=None) -> float:
     mesh = make_mesh(N_STAGES, N_DATA, devices=devices)
     pipe, stacked, xs, w = _build(mesh)
     loss, _ = jax.jit(pipe.loss_and_grad)(stacked, {}, {}, xs, w)
-    return float(loss)
+    _, checksum = _zero_step(mesh, pipe, stacked, xs, w)
+    return float(loss), checksum
 
 
 def worker(process_id: int, num_processes: int, port: int,
@@ -111,14 +152,18 @@ def worker(process_id: int, num_processes: int, port: int,
 
     loss, grads = jax.jit(pipe.loss_and_grad)(stacked, {}, {}, xs, w)
     jax.block_until_ready(grads)
+    # ZeRO-1 across the process-spanning data axis: the sharded update's
+    # collectives ride the DCN analogue
+    _, checksum = _zero_step(mesh, pipe, stacked, xs, w)
     if process_id == 0:
         with open(out_file, "w") as f:
-            f.write(repr(float(loss)))
+            f.write(f"{float(loss)!r} {checksum!r}")
 
 
 def launch_two_process_check(out_file: str, *, timeout: float = 600.0,
-                             repo_root: str = None) -> float:
-    """Spawn the two workers as REAL processes and return process 0's loss.
+                             repo_root: str = None):
+    """Spawn the two workers as REAL processes; returns process 0's
+    ``(loss, zero_checksum)``.
 
     Shared by the gated test and the dryrun. Raises
     ``subprocess.TimeoutExpired``/``OSError`` when the environment cannot
@@ -162,7 +207,8 @@ def launch_two_process_check(out_file: str, *, timeout: float = 600.0,
             "\n".join(t.decode(errors="replace")[-3000:] for t in texts))
     try:
         with open(out_file) as f:
-            return float(f.read())
+            loss_s, ck_s = f.read().split()
+            return float(loss_s), float(ck_s)
     except (OSError, ValueError) as e:
         raise RuntimeError(
             f"workers exited 0 but the loss file contract broke: {e}")
