@@ -40,6 +40,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--int8", action="store_true",
                    help="int8 weight-only quantized block weights "
                         "(inference/quant.py)")
+    p.add_argument("--family", choices=["lm", "gpt2"], default="lm",
+                   help="model family: the tutorial-parity LM (sinusoid "
+                        "positions, post-LN) or GPT-2 (learned positions, "
+                        "pre-LN)")
     p.add_argument("--stages", type=int, default=1,
                    help=">1: ring-pipelined decode over a stage mesh")
     p.add_argument("--tiny", action="store_true")
@@ -60,13 +64,19 @@ def main(argv=None) -> int:
     import numpy as np
 
     from ..inference import GenerationConfig, Generator
-    from ..models.transformer_lm import LMConfig, PipelinedLM
 
-    model_cfg = LMConfig()
+    if args.family == "gpt2":
+        from ..models.gpt2 import GPT2Config as _Cfg
+        from ..models.gpt2 import PipelinedGPT2 as _Model
+    else:
+        from ..models.transformer_lm import LMConfig as _Cfg
+        from ..models.transformer_lm import PipelinedLM as _Model
+
+    model_cfg = _Cfg()
     if args.tiny:
         model_cfg = model_cfg.tiny()
     n_stages = max(args.stages, 1)
-    model = PipelinedLM(model_cfg, n_stages)
+    model = _Model(model_cfg, n_stages)
 
     # validate cheap inputs before any parameter materialization
     ids = [int(t) for t in args.prompt.split(",") if t.strip()]
@@ -102,7 +112,7 @@ def main(argv=None) -> int:
             print(f"checkpoint holds {n_saved}x{lps_saved} blocks but the "
                   f"model has {model_cfg.n_layers} layers", file=sys.stderr)
             return 2
-        saved_model = PipelinedLM(model_cfg, n_saved)
+        saved_model = _Model(model_cfg, n_saved)
 
         def template_fn(key):
             sp, pre, post = saved_model.init(key)

@@ -146,3 +146,16 @@ def test_generator_position_table_guard():
     g = Generator(model, GenerationConfig(max_new_tokens=10_000))
     with pt.raises(ValueError, match="positional table"):
         g.generate(params, jnp.zeros((1, 4), jnp.int32))
+
+
+def test_generate_cli_gpt2_family(capsys):
+    from pipe_tpu.apps import generate
+
+    args = ["--tiny", "--family", "gpt2", "--max-new", "5",
+            "--prompt", "3,4,5"]
+    assert generate.main(args) == 0
+    single = capsys.readouterr().out.strip().splitlines()
+    assert len(single) == 1 and len(single[0].split(",")) == 5
+    assert generate.main(args + ["--stages", "2"]) == 0
+    piped = capsys.readouterr().out.strip().splitlines()
+    assert piped == [single[0], single[0]]
