@@ -46,6 +46,10 @@ def build_argparser() -> argparse.ArgumentParser:
                         "pre-LN)")
     p.add_argument("--stages", type=int, default=1,
                    help=">1: ring-pipelined decode over a stage mesh")
+    p.add_argument("--context-shards", type=int, default=1,
+                   help=">1: context-sharded decode — the prompt KV cache "
+                        "shards over a context axis (LM family only; "
+                        "prompt length must divide)")
     p.add_argument("--tiny", action="store_true")
     p.add_argument("--cpu", type=int, default=0,
                    help="force N virtual CPU devices (testing without TPU)")
@@ -96,6 +100,16 @@ def main(argv=None) -> int:
         print("--beams > 1 is single-device only (the ring decoder does "
               "not reorder beams)", file=sys.stderr)
         return 2
+    n_ctx = max(args.context_shards, 1)
+    if n_ctx > 1:
+        if n_stages > 1 or args.beams > 1 or args.int8                 or args.family != "lm":
+            print("--context-shards composes only with the plain LM "
+                  "single-stage float path", file=sys.stderr)
+            return 2
+        if len(ids) % n_ctx:
+            print(f"prompt length {len(ids)} must divide over "
+                  f"{n_ctx} context shards", file=sys.stderr)
+            return 2
 
     if args.resume:
         from ..parallel.spmd import stack_stage_params, unstack_stage_params
@@ -152,7 +166,15 @@ def main(argv=None) -> int:
                                top_k=args.top_k, num_beams=args.beams)
     key = jax.random.key(args.seed + 1)
 
-    if n_stages > 1:
+    if n_ctx > 1:
+        from ..inference.long_context import ContextShardedGenerator
+        from ..models.long_context_lm import ContextParallelLM
+        from ..parallel.mesh import make_mesh
+        cp = ContextParallelLM(model_cfg, n_stages)
+        out = ContextShardedGenerator(
+            make_mesh(1, 1, n_context=n_ctx), cp, gen_cfg).generate(
+            params, prompt, key=key)
+    elif n_stages > 1:
         from ..inference.pipelined import PipelinedGenerator
         from ..parallel.mesh import make_mesh
         from ..parallel.spmd import stack_stage_params

@@ -159,3 +159,17 @@ def test_generate_cli_gpt2_family(capsys):
     assert generate.main(args + ["--stages", "2"]) == 0
     piped = capsys.readouterr().out.strip().splitlines()
     assert piped == [single[0], single[0]]
+
+
+def test_generate_cli_context_shards(capsys):
+    from pipe_tpu.apps import generate
+
+    base = ["--tiny", "--max-new", "5", "--prompt", "3,4,5,6,1,2,3,4"]
+    assert generate.main(base) == 0
+    single = capsys.readouterr().out.strip().splitlines()
+    assert generate.main(base + ["--context-shards", "4"]) == 0
+    ctx = capsys.readouterr().out.strip().splitlines()
+    assert ctx == single  # sharded prompt cache, same tokens
+    # indivisible prompt rejected cleanly
+    assert generate.main(["--tiny", "--prompt", "1,2,3",
+                          "--context-shards", "4"]) == 2
