@@ -80,10 +80,20 @@ def main(argv=None) -> int:
     if args.tiny:
         model_cfg = model_cfg.tiny()
     n_stages = max(args.stages, 1)
-    model = _Model(model_cfg, n_stages)
 
-    # validate cheap inputs before any parameter materialization
-    ids = [int(t) for t in args.prompt.split(",") if t.strip()]
+    # validate cheap inputs before any model/parameter materialization —
+    # every bad argument exits via the clean rc=2 stderr path, never a
+    # raw constructor traceback
+    if model_cfg.n_layers % n_stages:
+        print(f"--stages {n_stages} must divide the model's "
+              f"{model_cfg.n_layers} layers", file=sys.stderr)
+        return 2
+    try:
+        ids = [int(t) for t in args.prompt.split(",") if t.strip()]
+    except ValueError:
+        print("prompt must be comma-separated integer token ids",
+              file=sys.stderr)
+        return 2
     if not ids or any(i < 0 or i >= model_cfg.vocab for i in ids):
         print(f"prompt ids must be in [0, {model_cfg.vocab})",
               file=sys.stderr)
@@ -102,7 +112,8 @@ def main(argv=None) -> int:
         return 2
     n_ctx = max(args.context_shards, 1)
     if n_ctx > 1:
-        if n_stages > 1 or args.beams > 1 or args.int8                 or args.family != "lm":
+        if (n_stages > 1 or args.beams > 1 or args.int8
+                or args.family != "lm"):
             print("--context-shards composes only with the plain LM "
                   "single-stage float path", file=sys.stderr)
             return 2
@@ -110,6 +121,8 @@ def main(argv=None) -> int:
             print(f"prompt length {len(ids)} must divide over "
                   f"{n_ctx} context shards", file=sys.stderr)
             return 2
+
+    model = _Model(model_cfg, n_stages)
 
     if args.resume:
         from ..parallel.spmd import stack_stage_params, unstack_stage_params

@@ -38,7 +38,8 @@ __all__ = ["QuantLeaf", "quantize_params", "dequant_tree"]
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class QuantLeaf:
-    """int8 codes + per-output-channel float32 scales for one weight."""
+    """int8 codes + grouped float32 scales for one weight (see
+    :func:`_quantize_leaf` for the exact grouping per rank)."""
 
     q: jax.Array        # int8, original shape
     scale: jax.Array    # f32, shape [..., 1] broadcastable over axis -2
@@ -48,8 +49,17 @@ class QuantLeaf:
 
 
 def _quantize_leaf(w: jax.Array) -> QuantLeaf:
-    """Symmetric absmax int8 over the INPUT axis (-2): one scale per
-    output channel."""
+    """Symmetric absmax int8, one scale per axis(-2) group.
+
+    For the 2-D ``[d_in, d_out]`` weights of the standard model families
+    axis -2 IS the contraction axis, so this is exact per-output-channel
+    absmax and the ~0.4% relative-error argument in the module docstring
+    applies. For higher-rank leaves (e.g. TP's ``wqkv [d, 3, heads, hd]``,
+    where axis -2 is the *head* axis) the grouping is whatever axis -2
+    happens to be — dequantization is exact regardless (the scale is
+    stored and multiplied back), but the per-channel accuracy bound does
+    NOT transfer to those layouts; measure before serving a quantized
+    >2-D-weight model."""
     w32 = w.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
     scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)

@@ -103,6 +103,15 @@ class ContextParallelLM:
         """Shared sublayer instances (built eagerly in __init__)."""
         return self._layers_cache
 
+    def max_position(self) -> int:
+        """Positional capacity (sinusoid table rows) — inference guard.
+
+        Without this, ``check_positions`` is inert and prompts/decodes past
+        the table silently clamp inside ``_posenc``'s dynamic_slice — the
+        exact silent-reuse failure the guard exists to prevent.
+        """
+        return int(self._layers["posenc"].pe.shape[0])
+
     def _posenc(self, h, seq_offset):
         """PositionalEncoding's precomputed table, sliced at the shard offset."""
         pe = self._layers["posenc"].pe  # [max_len, d]

@@ -96,9 +96,16 @@ def _zero_step(mesh, pipe, stacked, xs, w):
         loss, grads = pipe.loss_and_grad(params, {}, {}, xs, w)
         updates, opt_state = tx.update(grads[0], opt_state, params)
         new = optax.apply_updates(params, updates)
-        zero_mod.constrain_moments(opt_state, shardings)
+        # Fold the constrained post-update moments into the checksum:
+        # an unused constrain_moments result would be dead-code-eliminated
+        # by XLA and the "partitioned update rides the DCN" claim this
+        # check documents would not actually be enforced.
+        opt_state = zero_mod.constrain_moments(opt_state, shardings)
         checksum = sum(jnp.sum(jnp.abs(a.astype(jnp.float32)))
                        for a in jax.tree_util.tree_leaves(new))
+        checksum = checksum + sum(
+            jnp.sum(jnp.abs(a.astype(jnp.float32)))
+            for a in jax.tree_util.tree_leaves(opt_state))
         return loss, checksum
 
     loss, checksum = step(stacked, xs, w)
