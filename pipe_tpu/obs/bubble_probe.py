@@ -103,11 +103,21 @@ def main(n_stages: int = 4, chunks: int = 8,
         x, n_rows = make_batch(m)
         w = mb.valid_row_mask(x, n_rows)
         scheds = {}
-        for name in ("1f1b", "zb-h1"):
+        # "1f1b+policy" is the HEADLINE training program (BENCH_r03:
+        # except_last + dots_saveable) running on the real multi-device
+        # stage axis — the configuration the single-chip bench reports,
+        # proven here to execute on the very topology it is sold for.
+        configs = [
+            ("1f1b", dict(checkpoint="never", schedule="1f1b")),
+            ("1f1b+policy", dict(checkpoint="except_last", schedule="1f1b",
+                                 remat_policy=jax.checkpoint_policies
+                                 .dots_saveable)),
+            ("zb-h1", dict(checkpoint="never", schedule="zb-h1")),
+        ]
+        for name, kw_s in configs:
             pipe = ScheduledPipeline(
                 mesh, model.stage_fn, pre_fn=model.pre_fn,
-                post_fn=model.loss_post_fn, checkpoint="never",
-                schedule=name)
+                post_fn=model.loss_post_fn, **kw_s)
 
             lg = jax.jit(lambda sp, pipe=pipe: pipe.loss_and_grad(
                 sp, prep, postp, x, w))

@@ -58,9 +58,10 @@ class HeteroScheduledPipeline:
     """Training executor lowering Pipe partitions onto schedule tables."""
 
     def __init__(self, mesh, partitions, skip_layout, chunks: int,
-                 checkpoint: str, schedule):
+                 checkpoint: str, schedule, remat_policy=None):
         self.mesh = mesh
         self.d = mesh.shape[STAGE_AXIS]
+        self.remat_policy = remat_policy
         self.schedule: Schedule = (get_schedule(schedule)
                                    if isinstance(schedule, str) else schedule)
         self.v = self.schedule.v
@@ -117,7 +118,8 @@ class HeteroScheduledPipeline:
     def memory_plan(self, m: Optional[int] = None) -> dict:
         sp = ScheduledPipeline(self.mesh, stage_fn=None, pre_fn=None,
                                post_fn=None, checkpoint=self.checkpoint,
-                               schedule=self.schedule)
+                               schedule=self.schedule,
+                               remat_policy=self.remat_policy)
         return sp.memory_plan(m if m is not None else self.chunks)
 
     # -- the training step -------------------------------------------------
@@ -275,7 +277,8 @@ class HeteroScheduledPipeline:
 
         sp = ScheduledPipeline(self.mesh, stage_fn, pre_fn=pre_fn,
                                post_fn=post_fn, checkpoint=self.checkpoint,
-                               schedule=self.schedule)
+                               schedule=self.schedule,
+                               remat_policy=self.remat_policy)
         # stage-sharded packed rows ARE the stacked stage params; () for
         # pre/post (packing has no weights; the loss is pure)
         loss, (g_packed, _, _) = sp.loss_and_grad(params, (), (), x, w,

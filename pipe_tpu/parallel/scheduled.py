@@ -139,6 +139,26 @@ def _index(tree, i):
         lambda l: jax.lax.dynamic_index_in_dim(l, i, 0, keepdims=False), tree)
 
 
+def _store_vjp(store, vjp_fn, specs, slot):
+    """Flatten ``vjp_fn`` and scatter its leaves into ``store`` at ``slot``.
+    One writer for BOTH residual stores (full and policy-shaped) so slot
+    layout and the structure-drift assert cannot diverge between them."""
+    leaves = jax.tree_util.tree_leaves(vjp_fn)
+    assert [(l.shape, l.dtype) for l in leaves] == \
+        [(sp_.shape, sp_.dtype) for sp_ in specs], \
+        "vjp residual structure drifted from abstract spec"
+    return [jax.lax.dynamic_update_index_in_dim(st, l, slot, 0)
+            for st, l in zip(store, leaves)]
+
+
+def _load_vjp(store, treedef, slot):
+    """Gather ``slot``'s leaves from ``store`` and rebuild the vjp callable
+    — the read twin of :func:`_store_vjp`."""
+    leaves = [jax.lax.dynamic_index_in_dim(st, slot, 0, keepdims=False)
+              for st in store]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 @dataclasses.dataclass
 class ScheduledPipeline:
     """Training executor: ``loss_and_grad`` on a ``(stage[, data])`` mesh.
@@ -199,9 +219,14 @@ class ScheduledPipeline:
     # elementwise remainder — the FLOPs-vs-HBM dial the reference's
     # all-or-nothing Checkpoint lacks. The per-micro-batch mode semantics
     # are unchanged: SAVED micro-batches (never: all; except_last: m-1)
-    # still store full residuals. d=1 static path only (the policy-saved
-    # residual structure differs from the full set, and the dynamic scan's
-    # slot store needs one uniform structure); the dynamic path raises.
+    # still store full residuals. Works on the d=1 static program AND the
+    # d>1 dynamic scan: the policy-saved residual pytree differs from the
+    # full set, so the dynamic path carries TWO slot stores — the full
+    # store (saved micro-batches) and a policy-shaped store (recompute
+    # micro-batches) — each internally uniform, with cond-gated
+    # writes/reads selecting between them per micro-batch. Inert (a
+    # warning) under checkpoint='never', where every micro-batch stores
+    # full residuals anyway.
     remat_policy: Optional[Any] = None
 
     def __post_init__(self):
@@ -237,6 +262,12 @@ class ScheduledPipeline:
                     "split_stage already defines its storage (full "
                     "residuals + taps); remat_policy would be silently "
                     "inert — drop one of the two")
+        if self.remat_policy is not None and self.checkpoint == "never":
+            warnings.warn(
+                "remat_policy is inert under checkpoint='never': every "
+                "micro-batch stores its full residual set and nothing is "
+                "recomputed. Use 'always' or 'except_last' to engage the "
+                "policy.", stacklevel=2)
         if (getattr(self.schedule, "splits_backward", False)
                 and self.checkpoint != "never"):
             warnings.warn(
@@ -269,8 +300,14 @@ class ScheduledPipeline:
               if self.checkpoint == "never" else 0)
         R = {"always": 0, "except_last": v,
              "never": v * Sg}[self.checkpoint]
+        # Policy-shaped residual slots (dynamic path): recompute
+        # micro-batches park their policy-saved subset here, one FIFO slot
+        # per (virtual stage, stash window) — same lifetime as the stash.
+        Rp = (v * Sg if self.remat_policy is not None
+              and self.checkpoint != "never" else 0)
         return {"cycles": self._cycles(m), "stash_slots": v * Sg,
                 "stash_slots_per_virtual_stage": Sg, "residual_slots": R,
+                "policy_residual_slots": Rp,
                 "h_last_slots": Sg, "wstash_slots": v * Wg,
                 "taps_slots": (v * Sg if self.split_stage is not None
                                else 0),
@@ -652,12 +689,6 @@ class ScheduledPipeline:
         if d == 1 and self._use_static(m):
             return self._device_program_static(
                 stage_params, pre_params, post_params, x, w, wsum, key, m=m)
-        if self.remat_policy is not None:
-            raise NotImplementedError(
-                "remat_policy needs the d=1 static program: policy-saved "
-                "residuals have a different pytree structure than the full "
-                "set, and the dynamic scan's slot store requires one "
-                "uniform residual structure across micro-batches")
         j = jax.lax.axis_index(STAGE_AXIS)
         # This device's shard: [v, ...] — its interleave groups in order.
         params_dev = stage_params
@@ -685,6 +716,21 @@ class ScheduledPipeline:
                 self._vjp_wrt, params_g_spec, pre_params, h_spec,
                 x_mb_spec, key_spec, i32)
         res_specs, res_treedef = jax.tree_util.tree_flatten(vjp_fn_spec)
+        # Policy-selective remat: the policy vjp's residual pytree (what
+        # jax.checkpoint's policy saves) differs from the full set, so the
+        # recompute micro-batches get their OWN uniform slot store. At
+        # 'never' every micro-batch is saved-full and the policy is inert
+        # (warned at init); guard on mode so no dead store rides the carry.
+        use_policy = (self.remat_policy is not None
+                      and self.checkpoint != "never")
+        if use_policy:
+            _, pvjp_fn_spec = jax.eval_shape(
+                self._vjp_wrt_policy, params_g_spec, pre_params, h_spec,
+                x_mb_spec, key_spec, i32)
+            pres_specs, pres_treedef = jax.tree_util.tree_flatten(
+                pvjp_fn_spec)
+        else:
+            pres_specs, pres_treedef = [], None
         inv_wsum = 1.0 / wsum
 
         # --- schedule tables (static data → scan xs) ---------------------
@@ -746,6 +792,10 @@ class ScheduledPipeline:
         n_res = self.memory_plan(m)["residual_slots"]
         res_store = ([exact_slots_of(s_, n_res) for s_ in res_specs]
                      if mode != "always" else [])
+        # Recompute micro-batches' policy-saved residuals: FWD -> BWD FIFO,
+        # same window as the stash (slot g*Sg + i % Sg).
+        pres_store = ([exact_slots_of(s_, v * Sg) for s_ in pres_specs]
+                      if use_policy else [])
         g_sp = jax.tree_util.tree_map(jnp.zeros_like, params_dev)
         g_pre = jax.tree_util.tree_map(jnp.zeros_like, pre_params)
         g_post = jax.tree_util.tree_map(jnp.zeros_like, post_params)
@@ -767,7 +817,7 @@ class ScheduledPipeline:
 
         def cycle(carry, row):
             (h_ring, g_ring, stash, h_last, wstash, taps_store, res_store,
-             g_sp, g_pre, g_post, loss) = carry
+             pres_store, g_sp, g_pre, g_post, loss) = carry
             op_r, mb_r, grp_r, rx_r = row
             opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
             i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
@@ -796,26 +846,26 @@ class ScheduledPipeline:
                 checkpoint policy — shared by the B and W branches so slot
                 layout and policy gating cannot drift between them."""
                 def apply_stored():
-                    slot = res_slot_for(i, g)
-                    leaves = [
-                        jax.lax.dynamic_index_in_dim(st, slot, 0,
-                                                     keepdims=False)
-                        for st in res_store]
-                    vjp_fn = jax.tree_util.tree_unflatten(res_treedef, leaves)
-                    return vjp_fn(seed_h)
+                    return _load_vjp(res_store, res_treedef,
+                                     res_slot_for(i, g))(seed_h)
 
                 def apply_recomputed():
                     _, vjp_fn = self._vjp_wrt(
                         params_g, pre_params, h_in, x_mb, kis, s)
                     return vjp_fn(seed_h)
 
+                def apply_policy_stored():
+                    return _load_vjp(pres_store, pres_treedef,
+                                     g * Sg + i % Sg)(seed_h)
+
                 if mode == "never":
                     return apply_stored()
+                recompute = (apply_policy_stored if use_policy
+                             else apply_recomputed)
                 if mode == "always":
-                    return apply_recomputed()
+                    return recompute()
                 # except_last: stored for m-1, recomputed otherwise
-                return jax.lax.cond(i == m - 1, apply_stored,
-                                    apply_recomputed)
+                return jax.lax.cond(i == m - 1, apply_stored, recompute)
 
             def scatter_gp(G, gp):
                 """Accumulate group g's param grads into its row of G."""
@@ -832,47 +882,54 @@ class ScheduledPipeline:
                 def vjp_and_store():
                     h1, vjp_fn = self._vjp_wrt(
                         params_g, pre_params, h_in, x_mb, kis, s)
-                    leaves = jax.tree_util.tree_leaves(vjp_fn)
-                    assert [(l.shape, l.dtype) for l in leaves] == \
-                        [(sp_.shape, sp_.dtype) for sp_ in res_specs], \
-                        "vjp residual structure drifted from abstract spec"
-                    slot = res_slot_for(i, g)
-                    return h1, [
-                        jax.lax.dynamic_update_index_in_dim(st, l, slot, 0)
-                        for st, l in zip(res_store, leaves)], taps_store
+                    return h1, _store_vjp(res_store, vjp_fn, res_specs,
+                                          res_slot_for(i, g)), \
+                        pres_store, taps_store
 
                 def split_vjp_and_store():
                     # structural split: params-constant vjp + taps store
                     h1, vjp_fn, taps = self._vjp_wrt_split(
                         params_g, pre_params, h_in, x_mb, kis, s)
-                    leaves = jax.tree_util.tree_leaves(vjp_fn)
-                    slot = res_slot_for(i, g)
-                    new_res = [
-                        jax.lax.dynamic_update_index_in_dim(st, l, slot, 0)
-                        for st, l in zip(res_store, leaves)]
+                    new_res = _store_vjp(res_store, vjp_fn, res_specs,
+                                         res_slot_for(i, g))
                     new_taps = jax.tree_util.tree_map(
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, g * Sg + i % Sg, 0), taps_store, taps)
-                    return h1, new_res, new_taps
+                    return h1, new_res, pres_store, new_taps
+
+                def policy_vjp_and_store():
+                    # selective remat: forward stores the policy-saved
+                    # residual subset (its own uniform slot structure);
+                    # backward recomputes only the cheap remainder
+                    h1, vjp_fn = self._vjp_wrt_policy(
+                        params_g, pre_params, h_in, x_mb, kis, s)
+                    return h1, res_store, \
+                        _store_vjp(pres_store, vjp_fn, pres_specs,
+                                   g * Sg + i % Sg), taps_store
 
                 def body_only():
                     return (self._f_body(params_g, pre_params, h_in, x_mb,
-                                         kis, s), res_store, taps_store)
+                                         kis, s), res_store, pres_store,
+                            taps_store)
 
+                recompute_fwd = (policy_vjp_and_store if use_policy
+                                 else body_only)
                 if self.split_stage is not None:   # never mode guaranteed
-                    h1, new_res, new_taps = split_vjp_and_store()
+                    h1, new_res, new_pres, new_taps = split_vjp_and_store()
                 elif mode == "always":
-                    h1, new_res, new_taps = body_only()
+                    h1, new_res, new_pres, new_taps = recompute_fwd()
                 elif mode == "never":
-                    h1, new_res, new_taps = vjp_and_store()
+                    h1, new_res, new_pres, new_taps = vjp_and_store()
                 else:
                     # except_last: ONLY micro-batch m-1 pays the residual
                     # capture and store; the rest run the plain body (they
-                    # recompute at BWD). Without the gate every forward
-                    # would stream a full residual set into a sentinel slot
-                    # — wasted HBM traffic and a doubled store.
-                    h1, new_res, new_taps = jax.lax.cond(
-                        i == m - 1, vjp_and_store, body_only)
+                    # recompute at BWD) or, under remat_policy, store just
+                    # the policy-saved subset. Without the gate every
+                    # forward would stream a full residual set into a
+                    # sentinel slot — wasted HBM traffic and a doubled
+                    # store.
+                    h1, new_res, new_pres, new_taps = jax.lax.cond(
+                        i == m - 1, vjp_and_store, recompute_fwd)
                 is_last = s == S - 1
                 # loss contribution: forward value only (its vjp is rebuilt
                 # at BWD time from the parked h1 — never stored)
@@ -887,8 +944,8 @@ class ScheduledPipeline:
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, i % Sg, 0), h_last, h1),
                     lambda: h_last)
-                return (new_h_last, wstash, new_taps, new_res, g_sp, g_pre,
-                        g_post, loss + contrib, h1, g_ring)
+                return (new_h_last, wstash, new_taps, new_res, new_pres,
+                        g_sp, g_pre, g_post, loss + contrib, h1, g_ring)
 
             def bwd_branch():
                 is_last = s == S - 1
@@ -921,20 +978,14 @@ class ScheduledPipeline:
                     # in it by construction); per-op output cotangents
                     # park for W, pre grads accumulate here (edge-stage
                     # embed path only).
-                    slot = res_slot_for(i, g)
-                    leaves = [
-                        jax.lax.dynamic_index_in_dim(st, slot, 0,
-                                                     keepdims=False)
-                        for st in res_store]
-                    vjp_fn = jax.tree_util.tree_unflatten(res_treedef,
-                                                          leaves)
-                    gpre, gh, gzs = vjp_fn(seed_h)
+                    gpre, gh, gzs = _load_vjp(res_store, res_treedef,
+                                              res_slot_for(i, g))(seed_h)
                     new_wstash = jax.tree_util.tree_map(
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, g * Wg + i % Wg, 0), wstash, gzs)
                     return (h_last, new_wstash, taps_store, res_store,
-                            g_sp, add(g_pre, gpre), add(g_post, gpost),
-                            loss, h_ring, gh)
+                            pres_store, g_sp, add(g_pre, gpre),
+                            add(g_post, gpost), loss, h_ring, gh)
 
                 gp, gpre, gh = apply_vjp(seed_h)
                 if split_dce:
@@ -946,13 +997,13 @@ class ScheduledPipeline:
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, g * Wg + i % Wg, 0), wstash, seed_h)
                     return (h_last, new_wstash, taps_store, res_store,
-                            g_sp, g_pre, add(g_post, gpost), loss,
-                            h_ring, gh)
+                            pres_store, g_sp, g_pre, add(g_post, gpost),
+                            loss, h_ring, gh)
                 # combined backward (non-split tables), or a split table
                 # under a recompute mode — the vjp was just built from the
                 # single forward recompute, so weight grads accumulate here
                 # and the table's W slot (if any) is a no-op.
-                return (h_last, wstash, taps_store, res_store,
+                return (h_last, wstash, taps_store, res_store, pres_store,
                         scatter_gp(g_sp, gp), add(g_pre, gpre),
                         add(g_post, gpost), loss, h_ring, gh)
 
@@ -970,8 +1021,8 @@ class ScheduledPipeline:
                             st, g * Wg + i % Wg, 0, keepdims=False), wstash)
                     gp = self.split_stage.wgrad_fn(taps, gzs)
                     return (h_last, wstash, taps_store, res_store,
-                            scatter_gp(g_sp, gp), g_pre, g_post, loss,
-                            h_ring, g_ring)
+                            pres_store, scatter_gp(g_sp, gp), g_pre,
+                            g_post, loss, h_ring, g_ring)
                 if not split_dce:
                     # recompute modes: full backward already ran at B.
                     return idle_branch()
@@ -979,19 +1030,20 @@ class ScheduledPipeline:
                     lambda st: jax.lax.dynamic_index_in_dim(
                         st, g * Wg + i % Wg, 0, keepdims=False), wstash)
                 gp, gpre, _ = apply_vjp(seed_h)
-                return (h_last, wstash, taps_store, res_store,
+                return (h_last, wstash, taps_store, res_store, pres_store,
                         scatter_gp(g_sp, gp), add(g_pre, gpre), g_post,
                         loss, h_ring, g_ring)
 
             def idle_branch():
-                return (h_last, wstash, taps_store, res_store, g_sp, g_pre,
-                        g_post, loss, h_ring, g_ring)
+                return (h_last, wstash, taps_store, res_store, pres_store,
+                        g_sp, g_pre, g_post, loss, h_ring, g_ring)
 
             branches = [idle_branch, fwd_branch, bwd_branch]
             if has_w:
                 branches.append(wgrad_branch)
-            (h_last2, wstash2, taps2, res_store2, g_sp2, g_pre2, g_post2,
-             loss2, tx_h, tx_g) = jax.lax.switch(opj, branches)
+            (h_last2, wstash2, taps2, res_store2, pres_store2, g_sp2,
+             g_pre2, g_post2, loss2, tx_h, tx_g) = jax.lax.switch(
+                opj, branches)
 
             if d > 1:
                 tx_h = jax.tree_util.tree_map(
@@ -999,12 +1051,12 @@ class ScheduledPipeline:
                 tx_g = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm), tx_g)
             return (tx_h, tx_g, stash, h_last2, wstash2, taps2, res_store2,
-                    g_sp2, g_pre2, g_post2, loss2), None
+                    pres_store2, g_sp2, g_pre2, g_post2, loss2), None
 
         carry0 = (h_ring, g_ring, stash, h_last, wstash, taps_store,
-                  res_store, g_sp, g_pre, g_post, loss0)
-        (_, _, _, _, _, _, _, g_sp, g_pre, g_post, loss), _ = jax.lax.scan(
-            cycle, carry0, xs)
+                  res_store, pres_store, g_sp, g_pre, g_post, loss0)
+        (_, _, _, _, _, _, _, _, g_sp, g_pre, g_post, loss), _ = \
+            jax.lax.scan(cycle, carry0, xs)
 
         # --- cross-device reductions ------------------------------------
         # stage grads: per-device shards stay put; replicas over other axes

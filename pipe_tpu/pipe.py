@@ -67,7 +67,8 @@ class Pipe:
                  n_stages: Optional[int] = None,
                  balance: Optional[Sequence[int]] = None,
                  schedule: str = "gpipe",
-                 deferred_batch_norm: bool = False):
+                 deferred_batch_norm: bool = False,
+                 remat_policy=None):
         # --- fail-fast validation (reference pipe.py:324-345) ---
         if not isinstance(chunks, int) or isinstance(chunks, bool):
             raise TypeError("chunks must be an integer")
@@ -85,6 +86,10 @@ class Pipe:
         self.chunks = chunks
         self.checkpoint = checkpoint
         self.module = module
+        # Selective remat policy (e.g. jax.checkpoint_policies.dots_saveable)
+        # for the RECOMPUTE micro-batches — flows to the training executor;
+        # the forward path takes it per-call (and falls back to this).
+        self.remat_policy = remat_policy
 
         if deferred_batch_norm:
             from .extras.norm import convert_deferred_batch_norm
@@ -177,7 +182,7 @@ class Pipe:
                 from .parallel.hetero_scheduled import HeteroScheduledPipeline
                 self._train_executor = HeteroScheduledPipeline(
                     mesh, self.partitions, self.skip_layout, chunks,
-                    checkpoint, sched_obj)
+                    checkpoint, sched_obj, remat_policy=remat_policy)
 
     # --- container protocol (reference pipe.py:358-386) ---
 
@@ -309,6 +314,8 @@ class Pipe:
                  remat_policy=None):
         from .extras.norm import DeferredBatchNorm, commit_batchnorm_stats
 
+        if remat_policy is None:
+            remat_policy = self.remat_policy
         if self._executor is not None:
             res = self._executor(params, *inputs, key=key, train=train,
                                  remat_policy=remat_policy)

@@ -461,19 +461,76 @@ def test_remat_policy_transparency(checkpoint):
                                    rtol=1e-5, atol=1e-7)
 
 
-def test_remat_policy_rejected_on_dynamic_path():
-    stage_fn, params = make_stage(2, jax.random.key(0))
-    mesh = make_mesh(2, 1, devices=jax.devices()[:2])
-    xs, _ = mb.stack_scatter(jax.random.normal(jax.random.key(1),
-                                               (8, WIDTH)), 4)
+@pytest.mark.parametrize("checkpoint", ["always", "except_last"])
+@pytest.mark.parametrize("d", [2, 4])
+def test_remat_policy_transparency_dynamic(checkpoint, d):
+    """Selective remat on the d>1 DYNAMIC scan (the multi-device stage
+    axis): identical loss and grads to the full-recompute path. The
+    recompute micro-batches park their policy-saved residual subset in a
+    second, policy-shaped slot store; saved micro-batches still use the
+    full store — the cond-gated selection must never change the math."""
+    m = 4
+    stage_fn, params = make_stage(d, jax.random.key(0))
+    mesh = make_mesh(d, 1, devices=jax.devices()[:d])
+    x = jax.random.normal(jax.random.key(1), (2 * m, WIDTH))
+    xs, _ = mb.stack_scatter(x, m)
     w = jnp.ones(xs.shape[:2], jnp.float32)
-    pipe = ScheduledPipeline(
-        mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
-        checkpoint="except_last", schedule="1f1b",
-        remat_policy=jax.checkpoint_policies.dots_saveable)
-    with pytest.raises(NotImplementedError, match="static"):
-        jax.jit(pipe.loss_and_grad)(stack_stage_params(params), {}, {},
-                                    xs, w)
+    stacked = stack_stage_params(params)
+
+    results = []
+    for policy in (None, jax.checkpoint_policies.dots_saveable):
+        pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn,
+                                 post_fn=post_fn, checkpoint=checkpoint,
+                                 schedule="1f1b", remat_policy=policy)
+        loss, (gsp, _, _) = jax.jit(pipe.loss_and_grad)(
+            stacked, {}, {}, xs, w, key=jax.random.key(9))
+        results.append((float(loss), gsp))
+    (l_full, g_full), (l_pol, g_pol) = results
+    assert l_full == pytest.approx(l_pol, rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g_full),
+                    jax.tree_util.tree_leaves(g_pol)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_remat_policy_interleaved_dynamic():
+    """Policy + interleaved-1f1b (v=2) + data axis on the dynamic scan."""
+    from pipe_tpu.core.schedule import InterleavedOneFOneBSchedule
+    from pipe_tpu.parallel.interleaved import stack_interleaved_params
+    m, d, v = 4, 2, 2
+    stage_fn, params = make_stage(v * d, jax.random.key(0))
+    mesh = make_mesh(d, 2, devices=jax.devices()[:2 * d])
+    x = jax.random.normal(jax.random.key(1), (4 * m, WIDTH))
+    xs, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    stacked = stack_interleaved_params(params, d)
+
+    results = []
+    for policy in (None, jax.checkpoint_policies.dots_saveable):
+        pipe = ScheduledPipeline(
+            mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
+            checkpoint="except_last",
+            schedule=InterleavedOneFOneBSchedule(interleave=v),
+            remat_policy=policy)
+        loss, (gsp, _, _) = jax.jit(pipe.loss_and_grad)(
+            stacked, {}, {}, xs, w, key=jax.random.key(9))
+        results.append((float(loss), gsp))
+    (l_full, g_full), (l_pol, g_pol) = results
+    assert l_full == pytest.approx(l_pol, rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g_full),
+                    jax.tree_util.tree_leaves(g_pol)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_remat_policy_inert_at_never_warns():
+    stage_fn, _ = make_stage(2, jax.random.key(0))
+    mesh = make_mesh(2, 1, devices=jax.devices()[:2])
+    with pytest.warns(UserWarning, match="inert"):
+        ScheduledPipeline(
+            mesh, stage_fn, pre_fn=pre_fn, post_fn=post_fn,
+            checkpoint="never", schedule="1f1b",
+            remat_policy=jax.checkpoint_policies.dots_saveable)
 
 
 @pytest.mark.parametrize("schedule", ["1f1b", "zb-h1"])
