@@ -71,10 +71,20 @@ class HeteroScheduledPipeline:
                 f"{len(partitions)} partitions for schedule "
                 f"{self.schedule.name!r} on a {self.d}-device stage axis "
                 f"(needs v*d = {self.S})")
-        if skip_layout is not None and skip_layout.num_skips > 0:
+        self.layout = skip_layout
+        # stable lane order for cross-stage skips (matches hetero.py)
+        self.lane_keys: List[Tuple[Any, str, int, int]] = []
+        if skip_layout is not None:
+            for (src, dst), names in skip_layout.by_src_dst:
+                if src != dst:
+                    for ns, name in names:
+                        self.lane_keys.append((ns, name, src, dst))
+        self.lane_pairs = tuple((src, dst)
+                                for _, _, src, dst in self.lane_keys)
+        if self.lane_keys and self.v > 1:
             raise NotImplementedError(
-                "@skippable stashes are not routed through the 1F1B/zb "
-                "table executor yet; use schedule='gpipe' for skip models")
+                "@skippable models cannot use interleaved schedules (skip "
+                "lanes need v == 1); use schedule='gpipe' or '1f1b'")
         self.partitions = list(partitions)
         self.chunks = chunks
         self.checkpoint = checkpoint
@@ -116,11 +126,25 @@ class HeteroScheduledPipeline:
         return [rows[self.row_of(s)] for s in range(self.S)]
 
     def memory_plan(self, m: Optional[int] = None) -> dict:
+        from .scheduled import SkipLanes
+        # lane specs are per-call (they depend on input shapes), but the
+        # plan only reads the PAIRS — pass them so the skip park counts
+        # the executor will actually allocate appear in the plan
         sp = ScheduledPipeline(self.mesh, stage_fn=None, pre_fn=None,
                                post_fn=None, checkpoint=self.checkpoint,
                                schedule=self.schedule,
-                               remat_policy=self.remat_policy)
+                               remat_policy=self._train_remat_policy(),
+                               skip_lanes=(SkipLanes(self.lane_pairs, ())
+                                           if self.lane_pairs else None))
         return sp.memory_plan(m if m is not None else self.chunks)
+
+    def _train_remat_policy(self):
+        """The policy as the TRAINING executor sees it: at 'never' every
+        micro-batch stores full residuals, so the policy is inert there —
+        don't forward it (Pipe.remat_policy legitimately serves the
+        forward path under 'never'; forwarding would fire the executor's
+        inert-policy warning at a user who configured it for forward)."""
+        return self.remat_policy if self.checkpoint != "never" else None
 
     # -- the training step -------------------------------------------------
     def loss_and_grad(self, params, *inputs,
@@ -205,16 +229,28 @@ class HeteroScheduledPipeline:
         x_plan_specs = [s for p, s in enumerate(in_specs) if p not in closed]
         plans.append(PackPlan([jax.ShapeDtypeStruct(s.shape, s.dtype)
                                for s in x_plan_specs]))
+        # Spec-mode tracker: skip-carrying partitions stash/pop during the
+        # boundary walk (shapes only), and its store afterwards holds each
+        # lane's local value spec (same device as hetero.py's lane sizing).
+        from ..extras.skip import SkipTracker, use_skip_tracker
+        spec_tracker = SkipTracker(self.layout, spec_mode=True)
         specs = in_specs
         boundaries = [in_specs]
-        for s_idx, part in enumerate(self.partitions):
-            out = part.out_spec(pack.abstract_tree(self.row_of(s_idx)),
-                                *specs)
-            specs = list(out) if isinstance(out, (tuple, list)) else [out]
-            boundaries.append(specs)
-            plans.append(PackPlan(
-                [jax.ShapeDtypeStruct(jnp.shape(sp_), jnp.result_type(sp_))
-                 for sp_ in specs]))
+        with use_skip_tracker(spec_tracker):
+            for s_idx, part in enumerate(self.partitions):
+                out = part.out_spec(pack.abstract_tree(self.row_of(s_idx)),
+                                    *specs)
+                specs = (list(out) if isinstance(out, (tuple, list))
+                         else [out])
+                boundaries.append(specs)
+                plans.append(PackPlan(
+                    [jax.ShapeDtypeStruct(jnp.shape(sp_),
+                                          jnp.result_type(sp_))
+                     for sp_ in specs]))
+        lane_specs = tuple(spec_tracker._store[(0, ns, name)]
+                           for ns, name, _, _ in self.lane_keys)
+        lane_pairs = tuple((src, dst)
+                           for _, _, src, dst in self.lane_keys)
         capacities: Dict[str, int] = {}
         for plan in plans:
             for dt, sz in plan.per_dtype.items():
@@ -228,10 +264,21 @@ class HeteroScheduledPipeline:
             vals = [x_mb["in"][str(p)] for p in dyn_pos]
             return plans[0].pack(vals, capacities)
 
+        has_lanes = bool(self.lane_keys)
+        # per-branch lane bookkeeping: which lanes this stage pops/stashes
+        branch_pops = [
+            [(l, ns, name) for l, (ns, name, src, dst)
+             in enumerate(self.lane_keys) if dst == s_idx]
+            for s_idx in range(self.S)]
+        branch_stashes = [
+            [(l, ns, name) for l, (ns, name, src, dst)
+             in enumerate(self.lane_keys) if src == s_idx]
+            for s_idx in range(self.S)]
+
         def make_branch(s_idx):
             part = self.partitions[s_idx]
 
-            def branch(params_g, carrier, ctx):
+            def branch(params_g, carrier, ctx, pops=None):
                 packed_vals = plans[s_idx].unpack(carrier)
                 vals: List[Any] = []
                 it = iter(packed_vals)
@@ -241,21 +288,39 @@ class HeteroScheduledPipeline:
                     else:
                         vals.append(next(it))
                 p_tree = pack.unpack_stage(params_g, self.row_of(s_idx))
-                out = part.apply(p_tree, *vals, ctx=ctx)
+                if not has_lanes:
+                    out = part.apply(p_tree, *vals, ctx=ctx)
+                    out_vals = (list(out) if isinstance(out, (tuple, list))
+                                else [out])
+                    return plans[s_idx + 1].pack(out_vals, capacities)
+                # seed the popped lane values, run under a local tracker,
+                # then export this stage's stashes (zeros of the lane spec
+                # for lanes it does not own — uniform switch structure)
+                local = SkipTracker(self.layout)
+                for l, ns, name in branch_pops[s_idx]:
+                    local.save(0, ns, name, pops[l])
+                with local.scope(0, s_idx):
+                    out = part.apply(p_tree, *vals, ctx=ctx)
                 out_vals = (list(out) if isinstance(out, (tuple, list))
                             else [out])
-                return plans[s_idx + 1].pack(out_vals, capacities)
+                stashes = [jnp.zeros(sp_.shape, sp_.dtype)
+                           for sp_ in lane_specs]
+                for l, ns, name in branch_stashes[s_idx]:
+                    stashes[l] = local.load(0, ns, name)
+                return (plans[s_idx + 1].pack(out_vals, capacities),
+                        tuple(stashes))
 
             return branch
 
         branches = [make_branch(s_idx) for s_idx in range(self.S)]
 
-        def stage_fn(params_g, h, ctx):
+        def stage_fn(params_g, h, ctx, pops=None):
             s = ctx.stage
             if isinstance(s, int):          # d == 1 static specialization
-                return branches[s](params_g, h, ctx)
+                return branches[s](params_g, h, ctx, pops)
             return jax.lax.switch(
-                s, [lambda pg=params_g, hh=h, c=ctx, b=b: b(pg, hh, c)
+                s, [lambda pg=params_g, hh=h, c=ctx, pp=pops, b=b:
+                    b(pg, hh, c, pp)
                     for b in branches])
 
         def post_fn(postp, h, x_mb, ctx):
@@ -275,10 +340,13 @@ class HeteroScheduledPipeline:
         if tgt_stacked is not None:
             x["tgt"] = tgt_stacked
 
+        from .scheduled import SkipLanes
         sp = ScheduledPipeline(self.mesh, stage_fn, pre_fn=pre_fn,
                                post_fn=post_fn, checkpoint=self.checkpoint,
                                schedule=self.schedule,
-                               remat_policy=self.remat_policy)
+                               remat_policy=self._train_remat_policy(),
+                               skip_lanes=(SkipLanes(lane_pairs, lane_specs)
+                                           if has_lanes else None))
         # stage-sharded packed rows ARE the stacked stage params; () for
         # pre/post (packing has no weights; the loss is pure)
         loss, (g_packed, _, _) = sp.loss_and_grad(params, (), (), x, w,

@@ -92,7 +92,46 @@ from ..core.schedule import (BWD, FWD, WGRAD, GPipeSchedule,
 from .mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from ..utils.rng import make_key
 
-__all__ = ["ScheduledPipeline", "SplitBackwardStage"]
+__all__ = ["ScheduledPipeline", "SplitBackwardStage", "SkipLanes"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SkipLanes:
+    """Cross-stage ``@skippable`` carries through the table executor.
+
+    The wavefront executor's skip lanes (``hetero.py``) need no parking:
+    device ``j`` computes micro-batch ``i`` at cycle ``i+j``, so a value
+    emitted at the source is consumed the cycle it arrives. Table
+    schedules (1F1B) interleave B ops, so arrival and consumption
+    decouple — the compiled analogue of the reference's portals riding
+    copy streams inside the training fence (``pipeline.py:136-138``).
+    Mechanism, all static at trace time:
+
+    * forward: the stash value rides a per-lane ring register one hop per
+      cycle (``dst - src`` hops), is captured into a FIFO park at the
+      destination at its host-computed arrival cycle, and is read at
+      FWD(i, dst) — and re-read at BWD(i, dst) under recompute modes,
+      exactly like the activation stash;
+    * backward: BWD(i, dst)'s vjp yields the pop cotangent, which rides a
+      reverse ring to the source and seeds the stash output of
+      BWD(i, src)'s vjp — the compiled ``PortalOrange``/``PortalBlue``
+      pair;
+    * park sizes are the smallest FIFO depths with no live-window
+      collision, computed from the op tables per lane.
+
+    With lanes configured the stage contract becomes
+    ``stage_fn(params_g, h, ctx, pops) -> (h, stashes)`` where ``pops``/
+    ``stashes`` are tuples over lanes — a stage reads only the lanes it
+    pops and must return zeros (of the lane spec) for lanes it does not
+    stash. Requires ``v == 1`` (skips + interleaved placements stay
+    unsupported) and a non-split-backward schedule.
+
+    ``pairs[l] = (src, dst)`` virtual stage indices (``src < dst``);
+    ``specs[l]`` is the lane's value pytree of ShapeDtypeStructs.
+    """
+
+    pairs: tuple
+    specs: tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -228,6 +267,9 @@ class ScheduledPipeline:
     # warning) under checkpoint='never', where every micro-batch stores
     # full residuals anyway.
     remat_policy: Optional[Any] = None
+    # Cross-stage @skippable carries — see :class:`SkipLanes`. Changes the
+    # stage_fn contract to (params_g, h, ctx, pops) -> (h, stashes).
+    skip_lanes: Optional[SkipLanes] = None
 
     def __post_init__(self):
         validate_mode(self.checkpoint)
@@ -262,6 +304,32 @@ class ScheduledPipeline:
                     "split_stage already defines its storage (full "
                     "residuals + taps); remat_policy would be silently "
                     "inert — drop one of the two")
+        if self.skip_lanes is not None and not self.skip_lanes.pairs:
+            self.skip_lanes = None          # empty lanes = no skips
+        if self.skip_lanes is not None:
+            if self.schedule.v != 1:
+                raise NotImplementedError(
+                    "skip lanes require v == 1 (interleaved placements "
+                    "wrap the device ring, so a transiting skip value can "
+                    "collide with a fresh stash at its source device)")
+            if getattr(self.schedule, "splits_backward", False):
+                raise NotImplementedError(
+                    "skip lanes do not compose with split-backward "
+                    "schedules (zb-h1): the W op's params-only grads "
+                    "cannot seed the reverse skip ring")
+            if self.split_stage is not None:
+                raise ValueError(
+                    "split_stage's tapped/wgrad fns have no pop/stash "
+                    "arguments; skip models use plain stage bodies")
+            if self.n_stages < 2:
+                raise ValueError(
+                    "cross-stage skip lanes need a >=2-device stage axis")
+            S = self.schedule.v * self.n_stages
+            for (src, dst) in self.skip_lanes.pairs:
+                if not (0 <= src < dst < S):
+                    raise ValueError(
+                        f"skip lane ({src}, {dst}) out of range for "
+                        f"{S} stages (need 0 <= src < dst < {S})")
         if self.remat_policy is not None and self.checkpoint == "never":
             warnings.warn(
                 "remat_policy is inert under checkpoint='never': every "
@@ -305,13 +373,20 @@ class ScheduledPipeline:
         # per (virtual stage, stash window) — same lifetime as the stash.
         Rp = (v * Sg if self.remat_policy is not None
               and self.checkpoint != "never" else 0)
-        return {"cycles": self._cycles(m), "stash_slots": v * Sg,
+        plan = {"cycles": self._cycles(m), "stash_slots": v * Sg,
                 "stash_slots_per_virtual_stage": Sg, "residual_slots": R,
                 "policy_residual_slots": Rp,
                 "h_last_slots": Sg, "wstash_slots": v * Wg,
                 "taps_slots": (v * Sg if self.split_stage is not None
                                else 0),
                 "virtual_stages_per_device": v}
+        if self.skip_lanes is not None:
+            tables = self.schedule.op_tables(m, d)
+            _, _, Kf, Kg = self._skip_tables(m, tables[0], tables[1])
+            plan["skip_lanes"] = len(self.skip_lanes.pairs)
+            plan["skip_fwd_park_slots"] = sum(Kf)
+            plan["skip_bwd_park_slots"] = sum(Kg)
+        return plan
 
     def _cycles(self, m: int) -> int:
         tables = self.schedule.op_tables(m, self.n_stages)
@@ -399,13 +474,16 @@ class ScheduledPipeline:
                      if a not in (STAGE_AXIS, MODEL_AXIS))
 
     # -----------------------------------------------------------------
-    def _f_body(self, params_g, prep, h_in, x_mb, kis, s):
+    def _f_body(self, params_g, prep, h_in, x_mb, kis, s, pops=None):
         """The per-(cycle, device) forward for virtual stage ``s``: pre
         (stage 0 only) → stage body. Everything the backward needs to
         differentiate is an explicit argument — no closure over device state
         (in particular no collective-derived values like the global weight
         sum, which would change the vjp residual structure under shard_map) —
         so the residual structure is derivable abstractly.
+
+        With :class:`SkipLanes`, ``pops`` is the per-lane tuple of popped
+        values and the return is ``(h_out, stashes)``.
 
         The post (decode/loss) is deliberately NOT part of this function:
         its vjp residuals are vocab-scale ([rows, seq, vocab] logits plus a
@@ -428,10 +506,11 @@ class ScheduledPipeline:
         # ctx.stage carries the VIRTUAL stage index (traced on the d>1 path,
         # a Python int on the d=1 static path) so heterogeneous adapters can
         # switch their per-stage bodies on it (parallel.hetero_scheduled).
-        return self.stage_fn(params_g, h0,
-                             StageCtx(key=jax.random.fold_in(kis, 1),
-                                      train=train, stage=s,
-                                      data_axis=self.bn_axis))
+        ctx = StageCtx(key=jax.random.fold_in(kis, 1),
+                       train=train, stage=s, data_axis=self.bn_axis)
+        if self.skip_lanes is not None:
+            return self.stage_fn(params_g, h0, ctx, pops)
+        return self.stage_fn(params_g, h0, ctx)
 
     def _post_contrib(self, postp, h1, x_mb, w_mb, kis):
         """UNNORMALIZED loss contribution ``sum(w * per_row)`` of one
@@ -444,8 +523,16 @@ class ScheduledPipeline:
                                          data_axis=self.bn_axis))
         ).astype(jnp.float32)
 
-    def _vjp_wrt(self, params_g, prep, h_in, x_mb, kis, s):
-        """vjp of :meth:`_f_body` w.r.t. (group params, pre, h_in)."""
+    def _vjp_wrt(self, params_g, prep, h_in, x_mb, kis, s, pops=None):
+        """vjp of :meth:`_f_body` w.r.t. (group params, pre, h_in[, pops]).
+
+        With skip lanes the primal out is ``(h, stashes)``, the seed is
+        ``(g_h, g_stashes)`` and the cotangents gain ``g_pops``."""
+        if self.skip_lanes is not None:
+            return jax.vjp(
+                lambda a, b, dd, pp: self._f_body(a, b, dd, x_mb, kis, s,
+                                                  pops=pp),
+                params_g, prep, h_in, pops)
         return jax.vjp(
             lambda a, b, dd: self._f_body(a, b, dd, x_mb, kis, s),
             params_g, prep, h_in)
@@ -475,9 +562,16 @@ class ScheduledPipeline:
                 params_g, b, dd, x_mb, kis, s, zz),
             prep, h_in, zs, has_aux=True)
 
-    def _vjp_wrt_policy(self, params_g, prep, h_in, x_mb, kis, s):
+    def _vjp_wrt_policy(self, params_g, prep, h_in, x_mb, kis, s,
+                        pops=None):
         """Policy-selective vjp: residuals are only what ``remat_policy``
         saves (the backward recomputes the rest in place)."""
+        if self.skip_lanes is not None:
+            wrapped = jax.checkpoint(
+                lambda a, b, dd, pp: self._f_body(a, b, dd, x_mb, kis, s,
+                                                  pops=pp),
+                policy=self.remat_policy)
+            return jax.vjp(wrapped, params_g, prep, h_in, pops)
         wrapped = jax.checkpoint(
             lambda a, b, dd: self._f_body(a, b, dd, x_mb, kis, s),
             policy=self.remat_policy)
@@ -515,6 +609,84 @@ class ScheduledPipeline:
                 g2 = (s_up + 1) // d
                 rxslot_np[t, p] = g2 * Sg + (mb_np[t - 1, q] % Sg)
         return (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel
+
+    def _skip_tables(self, m, op_np, mb_np):
+        """Host-side skip-lane plan from the op tables (v == 1 only).
+
+        Per lane ``l = (src, dst)``:
+
+        * ``capf[t, l, p]``: FIFO slot at device ``p`` parking the value
+          arriving on the forward lane ring at cycle ``t`` (sentinel
+          ``Kf[l]`` when nothing real arrives). Arrival is deterministic:
+          the stash emitted at FWD(i, src) travels one hop per cycle, so
+          it reaches ``dst`` at cycle ``fwd(i, src) + (dst - src)``.
+        * ``capg[t, l, p]``: same for the pop cotangent riding the reverse
+          ring from BWD(i, dst) to ``src``.
+        * ``Kf[l]`` / ``Kg[l]``: smallest FIFO depths such that slot
+          ``i % K`` never collides across overlapping live windows. The
+          forward live window extends to BWD(i, dst) under recompute
+          modes (the re-run needs the pops again), mirroring the
+          activation stash.
+        """
+        d = self.n_stages
+        T = op_np.shape[0]
+        pairs = self.skip_lanes.pairs
+        fwd_c = np.full((m, d), -1, np.int64)
+        bwd_c = np.full((m, d), -1, np.int64)
+        for t in range(T):
+            for p in range(d):
+                if op_np[t, p] == FWD:
+                    fwd_c[mb_np[t, p], p] = t
+                elif op_np[t, p] == BWD:
+                    bwd_c[mb_np[t, p], p] = t
+
+        def fifo_depth(windows):
+            for K in range(1, m + 1):
+                ok = all(
+                    windows[i][1] < windows[i2][0]
+                    for i in range(m) for i2 in range(i + K, m, K))
+                if ok:
+                    return K
+            return m
+
+        Kf, Kg = [], []
+        f_events, g_events = [], []   # (t, lane, device, slot)
+        for lidx, (src, dst) in enumerate(pairs):
+            hops = dst - src
+            wf, wg = [], []
+            for i in range(m):
+                arr_f = fwd_c[i, src] + hops
+                use_f = fwd_c[i, dst]
+                assert 0 <= fwd_c[i, src] and arr_f <= use_f, \
+                    (f"skip lane ({src},{dst}): stash for micro-batch {i} "
+                     f"arrives at cycle {arr_f} after its FWD {use_f}")
+                reread = (self.remat_policy is None
+                          and (self.checkpoint == "always"
+                               or (self.checkpoint == "except_last"
+                                   and i != m - 1)))
+                wf.append((arr_f, bwd_c[i, dst] if reread else use_f))
+                arr_g = bwd_c[i, dst] + hops
+                use_g = bwd_c[i, src]
+                assert 0 <= bwd_c[i, dst] and arr_g <= use_g, \
+                    (f"skip lane ({src},{dst}): cotangent for micro-batch "
+                     f"{i} arrives at cycle {arr_g} after its BWD {use_g}")
+                wg.append((arr_g, use_g))
+            kf, kg = fifo_depth(wf), fifo_depth(wg)
+            Kf.append(kf)
+            Kg.append(kg)
+            for i in range(m):
+                f_events.append((wf[i][0], lidx, dst, i % kf))
+                g_events.append((wg[i][0], lidx, src, i % kg))
+        capf = np.zeros((T, len(pairs), d), np.int32)
+        capg = np.zeros((T, len(pairs), d), np.int32)
+        for lidx in range(len(pairs)):
+            capf[:, lidx, :] = Kf[lidx]      # sentinel
+            capg[:, lidx, :] = Kg[lidx]
+        for (t, lidx, p, slot) in f_events:
+            capf[t, lidx, p] = slot
+        for (t, lidx, p, slot) in g_events:
+            capg[t, lidx, p] = slot
+        return capf, capg, Kf, Kg
 
     def _use_static(self, m: int) -> bool:
         if self.static_unroll is not None:
@@ -704,6 +876,8 @@ class ScheduledPipeline:
         # Canonical vjp structure (abstract — no tracers leak in):
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         key_spec = jax.eval_shape(lambda: jax.random.key(0))
+        lanes = self.skip_lanes
+        pops_spec = lanes.specs if lanes is not None else None
         if self.split_stage is not None:
             zs_spec = jax.eval_shape(self.split_stage.zs_fn,
                                      params_g_spec, h_spec)
@@ -714,7 +888,7 @@ class ScheduledPipeline:
             zs_spec = taps_spec = None
             _, vjp_fn_spec = jax.eval_shape(
                 self._vjp_wrt, params_g_spec, pre_params, h_spec,
-                x_mb_spec, key_spec, i32)
+                x_mb_spec, key_spec, i32, pops_spec)
         res_specs, res_treedef = jax.tree_util.tree_flatten(vjp_fn_spec)
         # Policy-selective remat: the policy vjp's residual pytree (what
         # jax.checkpoint's policy saves) differs from the full set, so the
@@ -726,7 +900,7 @@ class ScheduledPipeline:
         if use_policy:
             _, pvjp_fn_spec = jax.eval_shape(
                 self._vjp_wrt_policy, params_g_spec, pre_params, h_spec,
-                x_mb_spec, key_spec, i32)
+                x_mb_spec, key_spec, i32, pops_spec)
             pres_specs, pres_treedef = jax.tree_util.tree_flatten(
                 pvjp_fn_spec)
         else:
@@ -736,8 +910,15 @@ class ScheduledPipeline:
         # --- schedule tables (static data → scan xs) ---------------------
         (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel = \
             self._host_tables(m)
-        xs = (jnp.asarray(op_np), jnp.asarray(mb_np), jnp.asarray(grp_np),
-              jnp.asarray(rxslot_np))
+        if lanes is not None:
+            capf_np, capg_np, Kf, Kg = self._skip_tables(m, op_np, mb_np)
+            xs = (jnp.asarray(op_np), jnp.asarray(mb_np),
+                  jnp.asarray(grp_np), jnp.asarray(rxslot_np),
+                  jnp.asarray(capf_np), jnp.asarray(capg_np))
+        else:
+            Kf = Kg = ()
+            xs = (jnp.asarray(op_np), jnp.asarray(mb_np),
+                  jnp.asarray(grp_np), jnp.asarray(rxslot_np))
         # Split-backward (zero-bubble) tables carry WGRAD ops: B computes
         # the input grad only (and parks its cotangent); W consumes the
         # parked cotangent for the weight grads. Static: shapes the carry
@@ -796,6 +977,24 @@ class ScheduledPipeline:
         # same window as the stash (slot g*Sg + i % Sg).
         pres_store = ([exact_slots_of(s_, v * Sg) for s_ in pres_specs]
                       if use_policy else [])
+        # Skip lanes: one forward + one reverse ring register per lane and
+        # a sentinel-slotted FIFO park at each end (capture writes use the
+        # host-computed slot tables, so the sentinel form applies).
+        if lanes is not None:
+            sk_ring = tuple(jax.tree_util.tree_map(zeros_of, sp_)
+                            for sp_ in lanes.specs)
+            gk_ring = tuple(jax.tree_util.tree_map(zeros_of, sp_)
+                            for sp_ in lanes.specs)
+            sk_park = tuple(
+                jax.tree_util.tree_map(
+                    lambda s_, k=k: slots_of(s_, k), sp_)
+                for sp_, k in zip(lanes.specs, Kf))
+            gk_park = tuple(
+                jax.tree_util.tree_map(
+                    lambda s_, k=k: slots_of(s_, k), sp_)
+                for sp_, k in zip(lanes.specs, Kg))
+        else:
+            sk_ring = gk_ring = sk_park = gk_park = ()
         g_sp = jax.tree_util.tree_map(jnp.zeros_like, params_dev)
         g_pre = jax.tree_util.tree_map(jnp.zeros_like, pre_params)
         g_post = jax.tree_util.tree_map(jnp.zeros_like, post_params)
@@ -817,8 +1016,12 @@ class ScheduledPipeline:
 
         def cycle(carry, row):
             (h_ring, g_ring, stash, h_last, wstash, taps_store, res_store,
-             pres_store, g_sp, g_pre, g_post, loss) = carry
-            op_r, mb_r, grp_r, rx_r = row
+             pres_store, sk_ring, gk_ring, sk_park, gk_park,
+             g_sp, g_pre, g_post, loss) = carry
+            if lanes is not None:
+                op_r, mb_r, grp_r, rx_r, capf_r, capg_r = row
+            else:
+                op_r, mb_r, grp_r, rx_r = row
             opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
             i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
             g = jax.lax.dynamic_index_in_dim(grp_r, j, 0, keepdims=False)
@@ -829,6 +1032,27 @@ class ScheduledPipeline:
             stash = jax.tree_util.tree_map(
                 lambda st, hr: jax.lax.dynamic_update_index_in_dim(
                     st, hr, rslot, 0), stash, h_ring)
+            # 1b) park arriving skip values / pop cotangents (host tables
+            # mark the exact arrival cycles; sentinel slot otherwise)
+            if lanes is not None:
+                fslots = [jax.lax.dynamic_index_in_dim(
+                    capf_r[l], j, 0, keepdims=False)
+                    for l in range(len(lanes.pairs))]
+                gslots = [jax.lax.dynamic_index_in_dim(
+                    capg_r[l], j, 0, keepdims=False)
+                    for l in range(len(lanes.pairs))]
+                sk_park = tuple(
+                    jax.tree_util.tree_map(
+                        lambda st, reg, sl=sl:
+                        jax.lax.dynamic_update_index_in_dim(st, reg, sl, 0),
+                        pk, rg)
+                    for pk, rg, sl in zip(sk_park, sk_ring, fslots))
+                gk_park = tuple(
+                    jax.tree_util.tree_map(
+                        lambda st, reg, sl=sl:
+                        jax.lax.dynamic_update_index_in_dim(st, reg, sl, 0),
+                        pk, rg)
+                    for pk, rg, sl in zip(gk_park, gk_ring, gslots))
 
             kis = jax.random.fold_in(jax.random.fold_in(key, i), s)
             x_mb = _index(x, i)
@@ -840,23 +1064,35 @@ class ScheduledPipeline:
             h_in = jax.tree_util.tree_map(
                 lambda st: jax.lax.dynamic_index_in_dim(
                     st, g * Sg + i % Sg, 0, keepdims=False), stash)
+            # Popped skip values for this (i, s): FIFO slot i % Kf per lane.
+            # Every stage reads them (uniform code); only the lane's dst
+            # stage body uses them. Recompute modes re-read at BWD, exactly
+            # like h_in.
+            pops = (tuple(
+                jax.tree_util.tree_map(
+                    lambda st, k=k: jax.lax.dynamic_index_in_dim(
+                        st, i % k, 0, keepdims=False), pk)
+                for pk, k in zip(sk_park, Kf))
+                if lanes is not None else None)
 
-            def apply_vjp(seed_h):
-                """(gp, gpre, gh) from the stored or recomputed vjp per the
+            def apply_vjp(seed):
+                """Cotangents from the stored or recomputed vjp per the
                 checkpoint policy — shared by the B and W branches so slot
-                layout and policy gating cannot drift between them."""
+                layout and policy gating cannot drift between them. ``seed``
+                is ``g_h`` (or ``(g_h, g_stashes)`` with skip lanes); the
+                result gains ``g_pops`` with lanes."""
                 def apply_stored():
                     return _load_vjp(res_store, res_treedef,
-                                     res_slot_for(i, g))(seed_h)
+                                     res_slot_for(i, g))(seed)
 
                 def apply_recomputed():
                     _, vjp_fn = self._vjp_wrt(
-                        params_g, pre_params, h_in, x_mb, kis, s)
-                    return vjp_fn(seed_h)
+                        params_g, pre_params, h_in, x_mb, kis, s, pops)
+                    return vjp_fn(seed)
 
                 def apply_policy_stored():
                     return _load_vjp(pres_store, pres_treedef,
-                                     g * Sg + i % Sg)(seed_h)
+                                     g * Sg + i % Sg)(seed)
 
                 if mode == "never":
                     return apply_stored()
@@ -880,46 +1116,46 @@ class ScheduledPipeline:
 
             def fwd_branch():
                 def vjp_and_store():
-                    h1, vjp_fn = self._vjp_wrt(
-                        params_g, pre_params, h_in, x_mb, kis, s)
-                    return h1, _store_vjp(res_store, vjp_fn, res_specs,
-                                          res_slot_for(i, g)), \
+                    out, vjp_fn = self._vjp_wrt(
+                        params_g, pre_params, h_in, x_mb, kis, s, pops)
+                    return out, _store_vjp(res_store, vjp_fn, res_specs,
+                                           res_slot_for(i, g)), \
                         pres_store, taps_store
 
                 def split_vjp_and_store():
                     # structural split: params-constant vjp + taps store
-                    h1, vjp_fn, taps = self._vjp_wrt_split(
+                    out, vjp_fn, taps = self._vjp_wrt_split(
                         params_g, pre_params, h_in, x_mb, kis, s)
                     new_res = _store_vjp(res_store, vjp_fn, res_specs,
                                          res_slot_for(i, g))
                     new_taps = jax.tree_util.tree_map(
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, g * Sg + i % Sg, 0), taps_store, taps)
-                    return h1, new_res, pres_store, new_taps
+                    return out, new_res, pres_store, new_taps
 
                 def policy_vjp_and_store():
                     # selective remat: forward stores the policy-saved
                     # residual subset (its own uniform slot structure);
                     # backward recomputes only the cheap remainder
-                    h1, vjp_fn = self._vjp_wrt_policy(
-                        params_g, pre_params, h_in, x_mb, kis, s)
-                    return h1, res_store, \
+                    out, vjp_fn = self._vjp_wrt_policy(
+                        params_g, pre_params, h_in, x_mb, kis, s, pops)
+                    return out, res_store, \
                         _store_vjp(pres_store, vjp_fn, pres_specs,
                                    g * Sg + i % Sg), taps_store
 
                 def body_only():
                     return (self._f_body(params_g, pre_params, h_in, x_mb,
-                                         kis, s), res_store, pres_store,
-                            taps_store)
+                                         kis, s, pops), res_store,
+                            pres_store, taps_store)
 
                 recompute_fwd = (policy_vjp_and_store if use_policy
                                  else body_only)
                 if self.split_stage is not None:   # never mode guaranteed
-                    h1, new_res, new_pres, new_taps = split_vjp_and_store()
+                    out, new_res, new_pres, new_taps = split_vjp_and_store()
                 elif mode == "always":
-                    h1, new_res, new_pres, new_taps = recompute_fwd()
+                    out, new_res, new_pres, new_taps = recompute_fwd()
                 elif mode == "never":
-                    h1, new_res, new_pres, new_taps = vjp_and_store()
+                    out, new_res, new_pres, new_taps = vjp_and_store()
                 else:
                     # except_last: ONLY micro-batch m-1 pays the residual
                     # capture and store; the rest run the plain body (they
@@ -928,8 +1164,21 @@ class ScheduledPipeline:
                     # forward would stream a full residual set into a
                     # sentinel slot — wasted HBM traffic and a doubled
                     # store.
-                    h1, new_res, new_pres, new_taps = jax.lax.cond(
+                    out, new_res, new_pres, new_taps = jax.lax.cond(
                         i == m - 1, vjp_and_store, recompute_fwd)
+                if lanes is not None:
+                    h1, stashes = out
+                    # inject this stage's fresh stashes into their lanes;
+                    # pass the arriving value onward everywhere else
+                    tx_sk = tuple(
+                        jax.tree_util.tree_map(
+                            lambda sv, reg, src=src: jnp.where(
+                                jnp.asarray(s == src), sv, reg), svv, rg)
+                        for (src, _), svv, rg in zip(lanes.pairs, stashes,
+                                                     sk_ring))
+                else:
+                    h1 = out
+                    tx_sk = sk_ring
                 is_last = s == S - 1
                 # loss contribution: forward value only (its vjp is rebuilt
                 # at BWD time from the parked h1 — never stored)
@@ -945,7 +1194,8 @@ class ScheduledPipeline:
                             st, l, i % Sg, 0), h_last, h1),
                     lambda: h_last)
                 return (new_h_last, wstash, new_taps, new_res, new_pres,
-                        g_sp, g_pre, g_post, loss + contrib, h1, g_ring)
+                        g_sp, g_pre, g_post, loss + contrib, h1, g_ring,
+                        tx_sk, gk_ring)
 
             def bwd_branch():
                 is_last = s == S - 1
@@ -972,6 +1222,25 @@ class ScheduledPipeline:
                 gpost, seed_h = jax.lax.cond(is_last, post_seed, ring_seed)
                 add = functools.partial(jax.tree_util.tree_map, jnp.add)
 
+                if lanes is not None:
+                    # stash-output seeds: the pop cotangent that rode the
+                    # reverse ring from BWD(i, dst), parked at this source
+                    # device; zeros for lanes this stage does not stash
+                    # (their stash outputs are constants anyway)
+                    seed_sk = tuple(
+                        jax.tree_util.tree_map(
+                            lambda st, k=k, src=src: jnp.where(
+                                jnp.asarray(s == src),
+                                jax.lax.dynamic_index_in_dim(
+                                    st, i % k, 0, keepdims=False),
+                                jnp.zeros(st.shape[1:], st.dtype)),
+                            pk)
+                        for pk, k, (src, _) in zip(gk_park, Kg,
+                                                   lanes.pairs))
+                    seed = (seed_h, seed_sk)
+                else:
+                    seed = seed_h
+
                 if self.split_stage is not None:
                     # structural split: the stored params-constant vjp IS
                     # the input-grad chain (zero weight-grad contractions
@@ -985,9 +1254,22 @@ class ScheduledPipeline:
                             st, l, g * Wg + i % Wg, 0), wstash, gzs)
                     return (h_last, new_wstash, taps_store, res_store,
                             pres_store, g_sp, add(g_pre, gpre),
-                            add(g_post, gpost), loss, h_ring, gh)
+                            add(g_post, gpost), loss, h_ring, gh,
+                            sk_ring, gk_ring)
 
-                gp, gpre, gh = apply_vjp(seed_h)
+                if lanes is not None:
+                    gp, gpre, gh, g_pops = apply_vjp(seed)
+                    # pop cotangents board the reverse ring at their dst
+                    # stage; everyone else forwards the arriving value
+                    tx_gk = tuple(
+                        jax.tree_util.tree_map(
+                            lambda gv, reg, dst=dst: jnp.where(
+                                jnp.asarray(s == dst), gv, reg), gvv, rg)
+                        for (_, dst), gvv, rg in zip(lanes.pairs, g_pops,
+                                                     gk_ring))
+                else:
+                    gp, gpre, gh = apply_vjp(seed)
+                    tx_gk = gk_ring
                 if split_dce:
                     # split backward, stored residuals: B emits only the
                     # input grad (XLA DCE prunes the unused weight-grad
@@ -998,14 +1280,15 @@ class ScheduledPipeline:
                             st, l, g * Wg + i % Wg, 0), wstash, seed_h)
                     return (h_last, new_wstash, taps_store, res_store,
                             pres_store, g_sp, g_pre, add(g_post, gpost),
-                            loss, h_ring, gh)
+                            loss, h_ring, gh, sk_ring, tx_gk)
                 # combined backward (non-split tables), or a split table
                 # under a recompute mode — the vjp was just built from the
                 # single forward recompute, so weight grads accumulate here
                 # and the table's W slot (if any) is a no-op.
                 return (h_last, wstash, taps_store, res_store, pres_store,
                         scatter_gp(g_sp, gp), add(g_pre, gpre),
-                        add(g_post, gpost), loss, h_ring, gh)
+                        add(g_post, gpost), loss, h_ring, gh,
+                        sk_ring, tx_gk)
 
             def wgrad_branch():
                 add = functools.partial(jax.tree_util.tree_map, jnp.add)
@@ -1022,7 +1305,7 @@ class ScheduledPipeline:
                     gp = self.split_stage.wgrad_fn(taps, gzs)
                     return (h_last, wstash, taps_store, res_store,
                             pres_store, scatter_gp(g_sp, gp), g_pre,
-                            g_post, loss, h_ring, g_ring)
+                            g_post, loss, h_ring, g_ring, sk_ring, gk_ring)
                 if not split_dce:
                     # recompute modes: full backward already ran at B.
                     return idle_branch()
@@ -1032,31 +1315,40 @@ class ScheduledPipeline:
                 gp, gpre, _ = apply_vjp(seed_h)
                 return (h_last, wstash, taps_store, res_store, pres_store,
                         scatter_gp(g_sp, gp), add(g_pre, gpre), g_post,
-                        loss, h_ring, g_ring)
+                        loss, h_ring, g_ring, sk_ring, gk_ring)
 
             def idle_branch():
                 return (h_last, wstash, taps_store, res_store, pres_store,
-                        g_sp, g_pre, g_post, loss, h_ring, g_ring)
+                        g_sp, g_pre, g_post, loss, h_ring, g_ring,
+                        sk_ring, gk_ring)
 
             branches = [idle_branch, fwd_branch, bwd_branch]
             if has_w:
                 branches.append(wgrad_branch)
             (h_last2, wstash2, taps2, res_store2, pres_store2, g_sp2,
-             g_pre2, g_post2, loss2, tx_h, tx_g) = jax.lax.switch(
-                opj, branches)
+             g_pre2, g_post2, loss2, tx_h, tx_g, tx_sk, tx_gk) = \
+                jax.lax.switch(opj, branches)
 
             if d > 1:
                 tx_h = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm), tx_h)
                 tx_g = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm), tx_g)
+                tx_sk = jax.tree_util.tree_map(
+                    lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm),
+                    tx_sk)
+                tx_gk = jax.tree_util.tree_map(
+                    lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm),
+                    tx_gk)
             return (tx_h, tx_g, stash, h_last2, wstash2, taps2, res_store2,
-                    pres_store2, g_sp2, g_pre2, g_post2, loss2), None
+                    pres_store2, tx_sk, tx_gk, sk_park, gk_park,
+                    g_sp2, g_pre2, g_post2, loss2), None
 
         carry0 = (h_ring, g_ring, stash, h_last, wstash, taps_store,
-                  res_store, pres_store, g_sp, g_pre, g_post, loss0)
-        (_, _, _, _, _, _, _, _, g_sp, g_pre, g_post, loss), _ = \
-            jax.lax.scan(cycle, carry0, xs)
+                  res_store, pres_store, sk_ring, gk_ring, sk_park, gk_park,
+                  g_sp, g_pre, g_post, loss0)
+        (_, _, _, _, _, _, _, _, _, _, _, _, g_sp, g_pre, g_post, loss), \
+            _ = jax.lax.scan(cycle, carry0, xs)
 
         # --- cross-device reductions ------------------------------------
         # stage grads: per-device shards stay put; replicas over other axes

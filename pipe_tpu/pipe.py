@@ -167,18 +167,19 @@ class Pipe:
         self._train_executor = None
         if mesh is not None:
             if sched_obj.v > 1 and self.skip_layout.num_skips > 0:
-                # would construct with NO usable execution path: v>1 has no
-                # forward executor and skips cannot ride the table executor
+                # skip lanes need v == 1: interleaved placements wrap the
+                # device ring, so a transiting skip value can collide with
+                # a fresh stash at its source device
                 raise NotImplementedError(
                     "@skippable models cannot use interleaved schedules on "
-                    "a mesh (no executor supports both); use "
+                    "a mesh (skip lanes need v == 1); use "
                     "schedule='gpipe' or '1f1b'")
             if sched_obj.v == 1:
                 from .parallel.hetero import HeteroSpmdPipeline
                 self._executor = HeteroSpmdPipeline(
                     mesh, self.partitions, self.skip_layout, chunks,
                     checkpoint)
-            if self.skip_layout.num_skips == 0 and not deferred_batch_norm:
+            if not deferred_batch_norm:
                 from .parallel.hetero_scheduled import HeteroScheduledPipeline
                 self._train_executor = HeteroScheduledPipeline(
                     mesh, self.partitions, self.skip_layout, chunks,
@@ -292,9 +293,9 @@ class Pipe:
             if self.mesh is None:
                 raise ValueError("loss_and_grad requires Pipe(mesh=...)")
             raise NotImplementedError(
-                "loss_and_grad is unavailable for this Pipe: @skippable "
-                "stashes / deferred BatchNorm are not routed through the "
-                "schedule-table executor (use the forward path + jax.grad)")
+                "loss_and_grad is unavailable for this Pipe: deferred "
+                "BatchNorm is not routed through the schedule-table "
+                "executor (use the forward path + jax.grad)")
         return self._train_executor.loss_and_grad(
             params, *inputs, targets=targets, loss_fn=loss_fn, key=key)
 

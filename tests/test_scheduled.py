@@ -523,6 +523,62 @@ def test_remat_policy_interleaved_dynamic():
                                    rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.parametrize("checkpoint", ["never", "except_last"])
+def test_skip_lanes_raw_executor(checkpoint):
+    """SkipLanes on the raw table executor: a 0 -> 3 skip rides the
+    forward lane ring + FIFO park, and its pop cotangent returns on the
+    reverse ring — loss and grads equal the plain chained model."""
+    from pipe_tpu.parallel.scheduled import SkipLanes
+    d, m = 4, 4
+    key = jax.random.key(0)
+    params = [{"w": jax.random.normal(jax.random.fold_in(key, jj),
+                                      (WIDTH, WIDTH)) * 0.3,
+               "b": jnp.zeros((WIDTH,))} for jj in range(d)]
+    lanes = SkipLanes(pairs=((0, 3),),
+                      specs=(jax.ShapeDtypeStruct((2, WIDTH),
+                                                  jnp.float32),))
+
+    def stage_fn(p, h, ctx, pops):
+        h1 = jnp.tanh(h @ p["w"] + p["b"])
+        out = jnp.where(jnp.asarray(ctx.stage == 3), h1 + pops[0], h1)
+        sk = jnp.where(jnp.asarray(ctx.stage == 0), h1,
+                       jnp.zeros_like(h1))
+        return out, (sk,)
+
+    def plain(ps, x):
+        h = x
+        saved = None
+        for jj, p in enumerate(ps):
+            h1 = jnp.tanh(h @ p["w"] + p["b"])
+            if jj == 0:
+                saved = h1
+            h = h1 + saved if jj == 3 else h1
+        return jnp.mean(jnp.sum((h - 1.0) ** 2, axis=-1))
+
+    mesh = make_mesh(d, 1, devices=jax.devices()[:d])
+    x = jax.random.normal(jax.random.key(1), (2 * m, WIDTH))
+    xs, _ = mb.stack_scatter(x, m)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    pipe = ScheduledPipeline(mesh, stage_fn, pre_fn=pre_fn,
+                             post_fn=post_fn, checkpoint=checkpoint,
+                             schedule="1f1b", skip_lanes=lanes)
+    loss, (gsp, _, _) = jax.jit(pipe.loss_and_grad)(
+        stack_stage_params(params), {}, {}, xs, w)
+    exp_loss = plain(params, x)
+    exp_g = jax.grad(plain)(params, x)
+    assert float(loss) == pytest.approx(float(exp_loss), rel=1e-5)
+    for jj in range(d):
+        got_j = jax.tree_util.tree_map(lambda a: a[jj], gsp)
+        for a, b in zip(jax.tree_util.tree_leaves(got_j),
+                        jax.tree_util.tree_leaves(exp_g[jj])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+    plan = pipe.memory_plan(m)
+    assert plan["skip_lanes"] == 1
+    assert plan["skip_fwd_park_slots"] >= 1
+    assert plan["skip_bwd_park_slots"] >= 1
+
+
 def test_remat_policy_inert_at_never_warns():
     stage_fn, _ = make_stage(2, jax.random.key(0))
     mesh = make_mesh(2, 1, devices=jax.devices()[:2])
