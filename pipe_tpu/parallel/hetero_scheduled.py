@@ -91,6 +91,13 @@ class HeteroScheduledPipeline:
         self.has_data = DATA_AXIS in mesh.axis_names
         self.n_data = mesh.shape[DATA_AXIS] if self.has_data else 1
         self.param_pack: Optional[StageParamPack] = None
+        # Deferred-BN stat lanes through the op tables (reference
+        # batchnorm.py capability, pipe.py:341-342) — mirrors hetero.py
+        from ..extras.norm import BatchNorm, DeferredBatchNorm
+        self.has_bn = any(isinstance(l, DeferredBatchNorm)
+                          for part in self.partitions for l in part)
+        self.has_batch_stats = any(isinstance(l, BatchNorm)
+                                   for part in self.partitions for l in part)
 
     # -- param layout ------------------------------------------------------
     def row_of(self, s: int) -> int:
@@ -251,6 +258,39 @@ class HeteroScheduledPipeline:
                            for ns, name, _, _ in self.lane_keys)
         lane_pairs = tuple((src, dst)
                            for _, _, src, dst in self.lane_keys)
+
+        # Deferred-BN stat lanes: a train-mode spec pass per partition
+        # discovers each stage's accumulator keys/shapes (mirrors
+        # hetero.py); same tracker so skip stash specs resolve.
+        collect_stats = self.has_bn
+        stat_keys: List[list] = [[] for _ in range(self.S)]
+        stat_specs_st: List[list] = [[] for _ in range(self.S)]
+        if self.has_batch_stats and true_rows % (m * self.n_data):
+            raise ValueError(
+                f"BatchNorm needs the batch ({true_rows} rows) to divide "
+                f"evenly into chunks*data ({m}*{self.n_data}): padded rows "
+                "would contaminate the batch statistics")
+        if collect_stats:
+            import functools as _ft
+
+            def _apply_train(part_, p_, *xs_):
+                return part_.apply(p_, *xs_,
+                                   ctx=StageCtx(train=True))
+
+            with use_skip_tracker(spec_tracker):
+                for s_idx, part in enumerate(self.partitions):
+                    seen = set(spec_tracker.accum)
+                    jax.eval_shape(
+                        _ft.partial(_apply_train, part),
+                        pack.abstract_tree(self.row_of(s_idx)),
+                        *boundaries[s_idx])
+                    for k_ in spec_tracker.accum:
+                        if k_ not in seen:
+                            stat_keys[s_idx].append(k_)
+                            stat_specs_st[s_idx].append(
+                                spec_tracker.accum[k_])
+        stat_spec = (tuple(tuple(sp_) for sp_ in stat_specs_st)
+                     if collect_stats else None)
         capacities: Dict[str, int] = {}
         for plan in plans:
             for dt, sz in plan.per_dtype.items():
@@ -288,14 +328,15 @@ class HeteroScheduledPipeline:
                     else:
                         vals.append(next(it))
                 p_tree = pack.unpack_stage(params_g, self.row_of(s_idx))
-                if not has_lanes:
+                if not has_lanes and not collect_stats:
                     out = part.apply(p_tree, *vals, ctx=ctx)
                     out_vals = (list(out) if isinstance(out, (tuple, list))
                                 else [out])
                     return plans[s_idx + 1].pack(out_vals, capacities)
-                # seed the popped lane values, run under a local tracker,
-                # then export this stage's stashes (zeros of the lane spec
-                # for lanes it does not own — uniform switch structure)
+                # seed the popped lane values, run under a local tracker
+                # (which also captures BN stat accumulations), then export
+                # this stage's stashes/stats — zeros for lanes/slots it
+                # does not own, so every switch branch is structure-uniform
                 local = SkipTracker(self.layout)
                 for l, ns, name in branch_pops[s_idx]:
                     local.save(0, ns, name, pops[l])
@@ -303,12 +344,27 @@ class HeteroScheduledPipeline:
                     out = part.apply(p_tree, *vals, ctx=ctx)
                 out_vals = (list(out) if isinstance(out, (tuple, list))
                             else [out])
-                stashes = [jnp.zeros(sp_.shape, sp_.dtype)
-                           for sp_ in lane_specs]
-                for l, ns, name in branch_stashes[s_idx]:
-                    stashes[l] = local.load(0, ns, name)
-                return (plans[s_idx + 1].pack(out_vals, capacities),
-                        tuple(stashes))
+                ret: List[Any] = [plans[s_idx + 1].pack(out_vals,
+                                                        capacities)]
+                if has_lanes:
+                    stashes = [jnp.zeros(sp_.shape, sp_.dtype)
+                               for sp_ in lane_specs]
+                    for l, ns, name in branch_stashes[s_idx]:
+                        stashes[l] = local.load(0, ns, name)
+                    ret.append(tuple(stashes))
+                if collect_stats:
+                    def zeros_of(spec):
+                        return jax.tree_util.tree_map(
+                            lambda sp_: jnp.zeros(sp_.shape, sp_.dtype),
+                            spec)
+                    ret.append(tuple(
+                        tuple((local.accum[k_]
+                               if s2 == s_idx and k_ in local.accum
+                               else zeros_of(spec))
+                              for k_, spec in zip(stat_keys[s2],
+                                                  stat_specs_st[s2]))
+                        for s2 in range(self.S)))
+                return tuple(ret)
 
             return branch
 
@@ -346,9 +402,18 @@ class HeteroScheduledPipeline:
                                schedule=self.schedule,
                                remat_policy=self._train_remat_policy(),
                                skip_lanes=(SkipLanes(lane_pairs, lane_specs)
-                                           if has_lanes else None))
+                                           if has_lanes else None),
+                               stat_spec=stat_spec)
         # stage-sharded packed rows ARE the stacked stage params; () for
         # pre/post (packing has no weights; the loss is pure)
+        if collect_stats:
+            loss, (g_packed, _, _), stats_t = sp.loss_and_grad(
+                params, (), (), x, w, key=key)
+            stats = {}
+            for s_idx in range(self.S):
+                for k_, stv in zip(stat_keys[s_idx], stats_t[s_idx]):
+                    stats[k_] = stv
+            return loss, g_packed, stats
         loss, (g_packed, _, _) = sp.loss_and_grad(params, (), (), x, w,
                                                   key=key)
         return loss, g_packed

@@ -270,6 +270,15 @@ class ScheduledPipeline:
     # Cross-stage @skippable carries — see :class:`SkipLanes`. Changes the
     # stage_fn contract to (params_g, h, ctx, pops) -> (h, stashes).
     skip_lanes: Optional[SkipLanes] = None
+    # Per-step stat lanes (deferred BatchNorm, reference batchnorm.py via
+    # pipe.py:341-342): a pytree spec of per-step accumulators, uniform
+    # across stages (each stage fills only its own slots, zeros elsewhere;
+    # values must be stop_gradient'd at source). The stage contract
+    # appends a stats output — (h[, stashes], stats) — and loss_and_grad
+    # returns ``(loss, grads, stats)``; stats accumulate over FWD ops ONLY
+    # (a BWD recompute re-computes and discards them, so recompute modes
+    # cannot double-count) and are summed over the stage/data axes.
+    stat_spec: Optional[Any] = None
 
     def __post_init__(self):
         validate_mode(self.checkpoint)
@@ -330,6 +339,15 @@ class ScheduledPipeline:
                     raise ValueError(
                         f"skip lane ({src}, {dst}) out of range for "
                         f"{S} stages (need 0 <= src < dst < {S})")
+        if self.stat_spec is not None:
+            if self.split_stage is not None:
+                raise ValueError(
+                    "split_stage's tapped/wgrad fns have no stats output; "
+                    "stat lanes need plain stage bodies")
+            if getattr(self.schedule, "splits_backward", False):
+                raise NotImplementedError(
+                    "stat lanes do not compose with split-backward "
+                    "schedules (zb-h1): the W op's seed has no stats slot")
         if self.remat_policy is not None and self.checkpoint == "never":
             warnings.warn(
                 "remat_policy is inert under checkpoint='never': every "
@@ -441,6 +459,9 @@ class ScheduledPipeline:
              jax.tree_util.tree_map(lambda _: P(), pre_params),
              jax.tree_util.tree_map(lambda _: P(), post_params)),
         )
+        if self.stat_spec is not None:    # stats: psum'd in-program
+            out_specs = out_specs + (
+                jax.tree_util.tree_map(lambda _: P(), self.stat_spec),)
         run = jax.shard_map(
             functools.partial(self._device_program, m=m),
             mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
@@ -511,6 +532,36 @@ class ScheduledPipeline:
         if self.skip_lanes is not None:
             return self.stage_fn(params_g, h0, ctx, pops)
         return self.stage_fn(params_g, h0, ctx)
+
+    def _split_out(self, out):
+        """Destructure a stage output into ``(h, stashes, stats)`` per the
+        configured extras (None for the absent ones) — the single decoder
+        for every (skip_lanes x stat_spec) combination."""
+        if self.skip_lanes is not None and self.stat_spec is not None:
+            h, sk, st = out
+            return h, sk, st
+        if self.skip_lanes is not None:
+            h, sk = out
+            return h, sk, None
+        if self.stat_spec is not None:
+            h, st = out
+            return h, None, st
+        return out, None, None
+
+    def _zero_seed_like(self, spec_tree):
+        return jax.tree_util.tree_map(
+            lambda sp_: jnp.zeros(sp_.shape, sp_.dtype), spec_tree)
+
+    def _make_seed(self, seed_h, seed_sk):
+        """Assemble the vjp seed matching the stage output structure:
+        stats always get zero cotangents (stop_gradient'd at source)."""
+        if self.skip_lanes is not None and self.stat_spec is not None:
+            return (seed_h, seed_sk, self._zero_seed_like(self.stat_spec))
+        if self.skip_lanes is not None:
+            return (seed_h, seed_sk)
+        if self.stat_spec is not None:
+            return (seed_h, self._zero_seed_like(self.stat_spec))
+        return seed_h
 
     def _post_contrib(self, postp, h1, x_mb, w_mb, kis):
         """UNNORMALIZED loss contribution ``sum(w * per_row)`` of one
@@ -733,6 +784,7 @@ class ScheduledPipeline:
         g_pre = jax.tree_util.tree_map(jnp.zeros_like, pre_params)
         g_post = jax.tree_util.tree_map(jnp.zeros_like, post_params)
         loss = jnp.zeros((), jnp.float32)
+        stats_acc = None   # lazily set from the first FWD's stats output
         add = functools.partial(jax.tree_util.tree_map, jnp.add)
 
         for t in range(op_np.shape[0]):
@@ -764,18 +816,24 @@ class ScheduledPipeline:
                     res[(i, g)] = vjp_fn
                     tapsd[(i, g)] = taps
                 elif save:
-                    h1, vjp_fn = self._vjp_wrt(
+                    out, vjp_fn = self._vjp_wrt(
                         params_g, pre_params, h_in, x_mb, kis, s)
+                    h1, _, stats_t = self._split_out(out)
                     res[(i, g)] = vjp_fn
                 elif self.remat_policy is not None:
                     # selective remat: store the policy-saved residual
                     # subset now; backward recomputes only the remainder
-                    h1, vjp_fn = self._vjp_wrt_policy(
+                    out, vjp_fn = self._vjp_wrt_policy(
                         params_g, pre_params, h_in, x_mb, kis, s)
+                    h1, _, stats_t = self._split_out(out)
                     res[(i, g)] = vjp_fn
                 else:
-                    h1 = self._f_body(params_g, pre_params, h_in, x_mb,
-                                      kis, s)
+                    out = self._f_body(params_g, pre_params, h_in, x_mb,
+                                       kis, s)
+                    h1, _, stats_t = self._split_out(out)
+                if self.stat_spec is not None:
+                    stats_acc = (add(stats_acc, stats_t)
+                                 if stats_acc is not None else stats_t)
                 if s == S - 1:
                     loss = loss + self._post_contrib(post_params, h1, x_mb,
                                                      w_mb, kis)
@@ -805,7 +863,7 @@ class ScheduledPipeline:
                 if vjp_fn is None:
                     _, vjp_fn = self._vjp_wrt(
                         params_g, pre_params, h_in, x_mb, kis, s)
-                gp, gpre, gh = vjp_fn(seed_h)
+                gp, gpre, gh = vjp_fn(self._make_seed(seed_h, None))
                 if split_w:
                     # B/W split table (zb-h1): the weight/pre grads computed
                     # here are traced values — defer only their ACCUMULATION
@@ -851,6 +909,13 @@ class ScheduledPipeline:
         loss_axes = (DATA_AXIS,) if self.has_data_axis else ()
         if loss_axes:
             loss = jax.lax.psum(loss, loss_axes)
+        if self.stat_spec is not None:
+            if stats_acc is None:
+                stats_acc = self._zero_seed_like(self.stat_spec)
+            if loss_axes:
+                stats_acc = jax.tree_util.tree_map(
+                    lambda a: jax.lax.psum(a, loss_axes), stats_acc)
+            return loss * inv_wsum, (g_sp, g_pre, g_post), stats_acc
         return loss * inv_wsum, (g_sp, g_pre, g_post)
 
     # -----------------------------------------------------------------
@@ -1016,7 +1081,7 @@ class ScheduledPipeline:
 
         def cycle(carry, row):
             (h_ring, g_ring, stash, h_last, wstash, taps_store, res_store,
-             pres_store, sk_ring, gk_ring, sk_park, gk_park,
+             pres_store, sk_ring, gk_ring, sk_park, gk_park, stats_acc,
              g_sp, g_pre, g_post, loss) = carry
             if lanes is not None:
                 op_r, mb_r, grp_r, rx_r, capf_r, capg_r = row
@@ -1166,8 +1231,8 @@ class ScheduledPipeline:
                     # store.
                     out, new_res, new_pres, new_taps = jax.lax.cond(
                         i == m - 1, vjp_and_store, recompute_fwd)
+                h1, stashes, stats_t = self._split_out(out)
                 if lanes is not None:
-                    h1, stashes = out
                     # inject this stage's fresh stashes into their lanes;
                     # pass the arriving value onward everywhere else
                     tx_sk = tuple(
@@ -1177,8 +1242,13 @@ class ScheduledPipeline:
                         for (src, _), svv, rg in zip(lanes.pairs, stashes,
                                                      sk_ring))
                 else:
-                    h1 = out
                     tx_sk = sk_ring
+                # FWD ops run only on real (i, s) — no fill/drain garbage
+                # to mask, and BWD recomputes discard their stats, so this
+                # is the one accumulation point
+                new_stats = (jax.tree_util.tree_map(jnp.add, stats_acc,
+                                                    stats_t)
+                             if self.stat_spec is not None else stats_acc)
                 is_last = s == S - 1
                 # loss contribution: forward value only (its vjp is rebuilt
                 # at BWD time from the parked h1 — never stored)
@@ -1194,8 +1264,8 @@ class ScheduledPipeline:
                             st, l, i % Sg, 0), h_last, h1),
                     lambda: h_last)
                 return (new_h_last, wstash, new_taps, new_res, new_pres,
-                        g_sp, g_pre, g_post, loss + contrib, h1, g_ring,
-                        tx_sk, gk_ring)
+                        new_stats, g_sp, g_pre, g_post, loss + contrib, h1,
+                        g_ring, tx_sk, gk_ring)
 
             def bwd_branch():
                 is_last = s == S - 1
@@ -1237,9 +1307,9 @@ class ScheduledPipeline:
                             pk)
                         for pk, k, (src, _) in zip(gk_park, Kg,
                                                    lanes.pairs))
-                    seed = (seed_h, seed_sk)
                 else:
-                    seed = seed_h
+                    seed_sk = None
+                seed = self._make_seed(seed_h, seed_sk)
 
                 if self.split_stage is not None:
                     # structural split: the stored params-constant vjp IS
@@ -1253,7 +1323,7 @@ class ScheduledPipeline:
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, g * Wg + i % Wg, 0), wstash, gzs)
                     return (h_last, new_wstash, taps_store, res_store,
-                            pres_store, g_sp, add(g_pre, gpre),
+                            pres_store, stats_acc, g_sp, add(g_pre, gpre),
                             add(g_post, gpost), loss, h_ring, gh,
                             sk_ring, gk_ring)
 
@@ -1279,14 +1349,15 @@ class ScheduledPipeline:
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, g * Wg + i % Wg, 0), wstash, seed_h)
                     return (h_last, new_wstash, taps_store, res_store,
-                            pres_store, g_sp, g_pre, add(g_post, gpost),
-                            loss, h_ring, gh, sk_ring, tx_gk)
+                            pres_store, stats_acc, g_sp, g_pre,
+                            add(g_post, gpost), loss, h_ring, gh,
+                            sk_ring, tx_gk)
                 # combined backward (non-split tables), or a split table
                 # under a recompute mode — the vjp was just built from the
                 # single forward recompute, so weight grads accumulate here
                 # and the table's W slot (if any) is a no-op.
                 return (h_last, wstash, taps_store, res_store, pres_store,
-                        scatter_gp(g_sp, gp), add(g_pre, gpre),
+                        stats_acc, scatter_gp(g_sp, gp), add(g_pre, gpre),
                         add(g_post, gpost), loss, h_ring, gh,
                         sk_ring, tx_gk)
 
@@ -1304,8 +1375,9 @@ class ScheduledPipeline:
                             st, g * Wg + i % Wg, 0, keepdims=False), wstash)
                     gp = self.split_stage.wgrad_fn(taps, gzs)
                     return (h_last, wstash, taps_store, res_store,
-                            pres_store, scatter_gp(g_sp, gp), g_pre,
-                            g_post, loss, h_ring, g_ring, sk_ring, gk_ring)
+                            pres_store, stats_acc, scatter_gp(g_sp, gp),
+                            g_pre, g_post, loss, h_ring, g_ring,
+                            sk_ring, gk_ring)
                 if not split_dce:
                     # recompute modes: full backward already ran at B.
                     return idle_branch()
@@ -1314,19 +1386,19 @@ class ScheduledPipeline:
                         st, g * Wg + i % Wg, 0, keepdims=False), wstash)
                 gp, gpre, _ = apply_vjp(seed_h)
                 return (h_last, wstash, taps_store, res_store, pres_store,
-                        scatter_gp(g_sp, gp), add(g_pre, gpre), g_post,
-                        loss, h_ring, g_ring, sk_ring, gk_ring)
+                        stats_acc, scatter_gp(g_sp, gp), add(g_pre, gpre),
+                        g_post, loss, h_ring, g_ring, sk_ring, gk_ring)
 
             def idle_branch():
                 return (h_last, wstash, taps_store, res_store, pres_store,
-                        g_sp, g_pre, g_post, loss, h_ring, g_ring,
-                        sk_ring, gk_ring)
+                        stats_acc, g_sp, g_pre, g_post, loss, h_ring,
+                        g_ring, sk_ring, gk_ring)
 
             branches = [idle_branch, fwd_branch, bwd_branch]
             if has_w:
                 branches.append(wgrad_branch)
-            (h_last2, wstash2, taps2, res_store2, pres_store2, g_sp2,
-             g_pre2, g_post2, loss2, tx_h, tx_g, tx_sk, tx_gk) = \
+            (h_last2, wstash2, taps2, res_store2, pres_store2, stats2,
+             g_sp2, g_pre2, g_post2, loss2, tx_h, tx_g, tx_sk, tx_gk) = \
                 jax.lax.switch(opj, branches)
 
             if d > 1:
@@ -1341,14 +1413,16 @@ class ScheduledPipeline:
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm),
                     tx_gk)
             return (tx_h, tx_g, stash, h_last2, wstash2, taps2, res_store2,
-                    pres_store2, tx_sk, tx_gk, sk_park, gk_park,
+                    pres_store2, tx_sk, tx_gk, sk_park, gk_park, stats2,
                     g_sp2, g_pre2, g_post2, loss2), None
 
+        stats0 = (self._zero_seed_like(self.stat_spec)
+                  if self.stat_spec is not None else ())
         carry0 = (h_ring, g_ring, stash, h_last, wstash, taps_store,
                   res_store, pres_store, sk_ring, gk_ring, sk_park, gk_park,
-                  g_sp, g_pre, g_post, loss0)
-        (_, _, _, _, _, _, _, _, _, _, _, _, g_sp, g_pre, g_post, loss), \
-            _ = jax.lax.scan(cycle, carry0, xs)
+                  stats0, g_sp, g_pre, g_post, loss0)
+        (_, _, _, _, _, _, _, _, _, _, _, _, stats_out, g_sp, g_pre,
+         g_post, loss), _ = jax.lax.scan(cycle, carry0, xs)
 
         # --- cross-device reductions ------------------------------------
         # stage grads: per-device shards stay put; replicas over other axes
@@ -1367,6 +1441,13 @@ class ScheduledPipeline:
                      else (STAGE_AXIS,))
         loss = jax.lax.psum(loss, loss_axes) * inv_wsum
 
+        if self.stat_spec is not None:
+            # each stage fills only its own slots (zeros elsewhere) and
+            # data shards hold per-shard partial sums — NOT the model
+            # axis, over which activations (hence stats) are replicated
+            stats_out = jax.tree_util.tree_map(
+                lambda a: jax.lax.psum(a, loss_axes), stats_out)
+            return loss, (g_sp, g_pre, g_post), stats_out
         return loss, (g_sp, g_pre, g_post)
 
 

@@ -118,10 +118,17 @@ class Pipe:
                     f"n_stages={n_stages} does not match the mesh's "
                     f"{mesh_stages}-device stage axis for schedule "
                     f"{sched_obj.name!r} (needs v*d = {expected})")
-            if deferred_batch_norm and sched_obj.name != "gpipe":
+            if deferred_batch_norm and sched_obj.v > 1:
                 raise NotImplementedError(
-                    "deferred_batch_norm through mesh= rides the GPipe "
-                    "wavefront executor (stat lanes); pick schedule='gpipe'")
+                    "deferred_batch_norm needs a forward executor for the "
+                    "running-stats commit; interleaved placements (v > 1) "
+                    "have none — pick a non-interleaved schedule")
+            if deferred_batch_norm and getattr(sched_obj, "splits_backward",
+                                               False):
+                raise NotImplementedError(
+                    "deferred_batch_norm does not compose with "
+                    "split-backward schedules (zb-h1): the W op's vjp seed "
+                    "has no stats slot — pick 'gpipe' or '1f1b'")
         if n_stages is None:
             n_stages = 1
         self.balance = split_balance(len(module), n_stages, balance)
@@ -179,11 +186,12 @@ class Pipe:
                 self._executor = HeteroSpmdPipeline(
                     mesh, self.partitions, self.skip_layout, chunks,
                     checkpoint)
-            if not deferred_batch_norm:
-                from .parallel.hetero_scheduled import HeteroScheduledPipeline
-                self._train_executor = HeteroScheduledPipeline(
-                    mesh, self.partitions, self.skip_layout, chunks,
-                    checkpoint, sched_obj, remat_policy=remat_policy)
+            # every combination that reaches here has a train path (the
+            # BN x v>1 / BN x zb-h1 exclusions raised above)
+            from .parallel.hetero_scheduled import HeteroScheduledPipeline
+            self._train_executor = HeteroScheduledPipeline(
+                mesh, self.partitions, self.skip_layout, chunks,
+                checkpoint, sched_obj, remat_policy=remat_policy)
 
     # --- container protocol (reference pipe.py:358-386) ---
 
@@ -288,16 +296,26 @@ class Pipe:
         ``loss_fn(*outputs, targets_mb) -> [rows]`` is the per-row loss.
         Works for every schedule incl. ``gpipe`` (which thereby gains the
         exact ``except_last`` policy the AD wavefront executor approximates
-        statically)."""
+        statically).
+
+        With ``deferred_batch_norm=True`` the return is
+        ``(loss, packed_grads, new_params)``: the table executor's stat
+        lanes accumulate one mini-batch of BN statistics and the commit
+        hands back the refreshed params — mirroring the forward path's
+        ``(out, new_params)`` contract."""
         if self._train_executor is None:
-            if self.mesh is None:
-                raise ValueError("loss_and_grad requires Pipe(mesh=...)")
-            raise NotImplementedError(
-                "loss_and_grad is unavailable for this Pipe: deferred "
-                "BatchNorm is not routed through the schedule-table "
-                "executor (use the forward path + jax.grad)")
-        return self._train_executor.loss_and_grad(
+            raise ValueError("loss_and_grad requires Pipe(mesh=...)")
+        res = self._train_executor.loss_and_grad(
             params, *inputs, targets=targets, loss_fn=loss_fn, key=key)
+        if getattr(self._train_executor, "has_bn", False):
+            # Deferred-BN: the table executor's stat lanes accumulated one
+            # mini-batch of statistics; commit them once (reference
+            # batchnorm.py semantics) and hand back the refreshed params —
+            # (loss, grads, new_params), mirroring the forward path's
+            # (out, new_params) contract.
+            loss, grads, stats = res
+            return loss, grads, self._commit_bn_mesh(params, stats)
+        return res
 
     def memory_plan(self, chunks: Optional[int] = None) -> dict:
         """Static per-device buffer counts of the training executor — the
