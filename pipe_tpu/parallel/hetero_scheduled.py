@@ -153,6 +153,179 @@ class HeteroScheduledPipeline:
         inert-policy warning at a user who configured it for forward)."""
         return self.remat_policy if self.checkpoint != "never" else None
 
+    # -- shared lowering (forward + loss_and_grad) -------------------------
+    def _lower_boundaries(self, params, inputs, *, what: str,
+                          check_batch_stats: bool = True):
+        """Classify inputs, scatter/pad, and walk the boundary-spec chain
+        — the machinery both :meth:`forward` and :meth:`loss_and_grad`
+        lower through. Returns a dict of the pieces; ``what`` names the
+        calling surface for error messages."""
+        if not isinstance(params, dict):
+            raise TypeError(
+                f"{what} runs on stage-sharded packed params; call "
+                "Pipe.shard_params/init_sharded first")
+        if self.param_pack is None:
+            raise ValueError(
+                "no StageParamPack on this executor; call shard_params() "
+                "(or Pipe.shard_params) first")
+        self.param_pack.check_packed(params)
+        pack = self.param_pack
+        m = self.chunks
+        mb.check(*inputs)
+
+        kinds: List[str] = []
+        for x in inputs:
+            if isinstance(x, mb.NoChunk):
+                kinds.append("nochunk")
+            elif mb.is_array(x):
+                kinds.append("array")
+            else:
+                kinds.append("static")
+        closed = {p: (x.value if k == "nochunk" else x)
+                  for p, (x, k) in enumerate(zip(inputs, kinds))
+                  if k != "array"}
+        dyn = {str(p): x for p, (x, k) in enumerate(zip(inputs, kinds))
+               if k == "array"}
+        if not dyn:
+            raise TypeError(f"{what} needs at least one array input")
+        stacked, true_rows = mb.stack_scatter(dyn, m)
+        if (check_batch_stats and self.has_batch_stats
+                and true_rows % (m * self.n_data)):
+            raise ValueError(
+                f"BatchNorm needs the batch ({true_rows} rows) to divide "
+                f"evenly into chunks*data ({m}*{self.n_data}): padded "
+                "rows would contaminate the batch statistics")
+
+        rows = next(iter(stacked.values())).shape[1]
+        mb_rows = -(-rows // self.n_data) * self.n_data
+        padded = mb_rows != rows
+        if padded:
+            def pad_rows(v):
+                pad = ([(0, 0), (0, mb_rows - rows)]
+                       + [(0, 0)] * (v.ndim - 2))
+                return jnp.pad(v, pad)
+            stacked = {p: pad_rows(v) for p, v in stacked.items()}
+        local_rows = mb_rows // self.n_data
+
+        def local_spec(v):
+            return jax.ShapeDtypeStruct((local_rows,) + v.shape[2:],
+                                        v.dtype)
+
+        in_specs: List[Any] = []
+        for p in range(len(inputs)):
+            if p in closed:
+                in_specs.append(closed[p])
+            else:
+                in_specs.append(local_spec(stacked[str(p)]))
+        plans: List[PackPlan] = []
+        x_plan_specs = [s for p, s in enumerate(in_specs)
+                        if p not in closed]
+        plans.append(PackPlan([jax.ShapeDtypeStruct(s.shape, s.dtype)
+                               for s in x_plan_specs]))
+        # Spec-mode tracker: skip-carrying partitions stash/pop during the
+        # boundary walk (shapes only); its store afterwards holds each
+        # lane's local value spec.
+        from ..extras.skip import SkipTracker, use_skip_tracker
+        spec_tracker = SkipTracker(self.layout, spec_mode=True)
+        specs = in_specs
+        boundaries = [in_specs]
+        with use_skip_tracker(spec_tracker):
+            for s_idx, part in enumerate(self.partitions):
+                out = part.out_spec(pack.abstract_tree(self.row_of(s_idx)),
+                                    *specs)
+                specs = (list(out) if isinstance(out, (tuple, list))
+                         else [out])
+                boundaries.append(specs)
+                plans.append(PackPlan(
+                    [jax.ShapeDtypeStruct(jnp.shape(sp_),
+                                          jnp.result_type(sp_))
+                     for sp_ in specs]))
+        capacities: Dict[str, int] = {}
+        for plan in plans:
+            for dt, sz in plan.per_dtype.items():
+                capacities[dt] = max(capacities.get(dt, 0), sz)
+        dyn_pos = [p for p in range(len(inputs)) if p not in closed]
+        return dict(pack=pack, m=m, kinds=kinds, closed=closed,
+                    stacked=stacked, true_rows=true_rows, rows=rows,
+                    mb_rows=mb_rows, padded=padded, local_rows=local_rows,
+                    plans=plans, boundaries=boundaries,
+                    capacities=capacities, dyn_pos=dyn_pos,
+                    spec_tracker=spec_tracker)
+
+    # -- forward/eval through the FWD-masked tables ------------------------
+    def forward(self, params, *inputs,
+                key: Optional[jax.Array] = None, train: bool = False):
+        """Forward outputs through the op tables with BWD rows masked to
+        IDLE — the eval path for interleaved (v > 1) placements, which
+        have no wavefront executor (reference eval-mode pipeline,
+        ``pipeline.py:153-155``). Returns gathered final-partition outputs
+        (a value, or a tuple for multi-value boundaries).
+
+        Plain stage bodies only: skip lanes and deferred BN are v == 1
+        features and v == 1 models ride the wavefront executor instead.
+        """
+        if self.lane_keys or self.has_bn:
+            raise NotImplementedError(
+                "table-executor forward() runs plain stage bodies; skip/"
+                "BN models use the wavefront executor (v == 1 schedules)")
+        low = self._lower_boundaries(params, inputs, what="forward",
+                                     check_batch_stats=train)
+        pack, plans = low["pack"], low["plans"]
+        boundaries, capacities = low["boundaries"], low["capacities"]
+        closed, dyn_pos = low["closed"], low["dyn_pos"]
+
+        def pre_fn(prep, x_mb, ctx):
+            del prep
+            vals = [x_mb[str(p)] for p in dyn_pos]
+            return plans[0].pack(vals, capacities)
+
+        def make_branch(s_idx):
+            part = self.partitions[s_idx]
+
+            def branch(params_g, carrier, ctx):
+                packed_vals = plans[s_idx].unpack(carrier)
+                vals: List[Any] = []
+                it = iter(packed_vals)
+                for p in range(len(boundaries[s_idx])):
+                    if s_idx == 0 and p in closed:
+                        vals.append(closed[p])
+                    else:
+                        vals.append(next(it))
+                p_tree = pack.unpack_stage(params_g, self.row_of(s_idx))
+                out = part.apply(p_tree, *vals, ctx=ctx)
+                out_vals = (list(out) if isinstance(out, (tuple, list))
+                            else [out])
+                return plans[s_idx + 1].pack(out_vals, capacities)
+
+            return branch
+
+        branches = [make_branch(s_idx) for s_idx in range(self.S)]
+
+        def stage_fn(params_g, h, ctx):
+            s = ctx.stage
+            if isinstance(s, int):
+                return branches[s](params_g, h, ctx)
+            return jax.lax.switch(
+                s, [lambda pg=params_g, hh=h, c=ctx, b=b: b(pg, hh, c)
+                    for b in branches])
+
+        sp = ScheduledPipeline(self.mesh, stage_fn, pre_fn=pre_fn,
+                               post_fn=None, checkpoint=self.checkpoint,
+                               schedule=self.schedule)
+        # out_fn unpacks the final-boundary carrier into row-major values
+        # INSIDE the device program, so the data axis lands on the rows
+        # dim of the collected outputs
+        outs = sp.forward(params, (), low["stacked"], key=key, train=train,
+                          out_fn=lambda h: tuple(plans[self.S].unpack(h)))
+        n_out = len(boundaries[self.S])
+        gathered = []
+        for pos in range(n_out):
+            o = outs[pos]                 # [m, mb_rows, ...]
+            if low["padded"]:
+                o = o[:, :low["rows"]]
+            gathered.append(mb.stack_gather(o, low["true_rows"]))
+        return tuple(gathered) if n_out > 1 else gathered[0]
+
     # -- the training step -------------------------------------------------
     def loss_and_grad(self, params, *inputs,
                       targets: Any = None,
@@ -170,90 +343,35 @@ class HeteroScheduledPipeline:
         is rebuilt per call (boundary plans depend on the input shapes), so
         un-jitted use re-traces the pipeline every step.
         """
-        if not isinstance(params, dict):
-            raise TypeError(
-                "loss_and_grad runs on stage-sharded packed params; call "
-                "Pipe.shard_params/init_sharded first")
-        if self.param_pack is None:
-            raise ValueError(
-                "no StageParamPack on this executor; call shard_params() "
-                "(or Pipe.shard_params) first")
-        self.param_pack.check_packed(params)
-        pack = self.param_pack
-        m = self.chunks
-        mb.check(*inputs)
+        low = self._lower_boundaries(params, inputs, what="loss_and_grad")
+        pack, m = low["pack"], low["m"]
+        closed, stacked = low["closed"], low["stacked"]
+        true_rows, rows, mb_rows = (low["true_rows"], low["rows"],
+                                    low["mb_rows"])
+        plans, boundaries = low["plans"], low["boundaries"]
+        capacities, dyn_pos = low["capacities"], low["dyn_pos"]
+        spec_tracker = low["spec_tracker"]
+        from ..extras.skip import SkipTracker, use_skip_tracker
 
-        # classify inputs exactly like the forward executor: arrays scatter,
-        # NoChunk/static close over
-        kinds: List[str] = []
-        for x in inputs:
-            if isinstance(x, mb.NoChunk):
-                kinds.append("nochunk")
-            elif mb.is_array(x):
-                kinds.append("array")
-            else:
-                kinds.append("static")
-        closed = {p: (x.value if k == "nochunk" else x)
-                  for p, (x, k) in enumerate(zip(inputs, kinds))
-                  if k != "array"}
-        dyn = {str(p): x for p, (x, k) in enumerate(zip(inputs, kinds))
-               if k == "array"}
-        if not dyn:
-            raise TypeError("loss_and_grad needs at least one array input")
-        stacked, true_rows = mb.stack_scatter(dyn, m)
-        w = mb.valid_row_mask(stacked, true_rows)
+        # build the loss mask against the PRE-pad rows ( _lower already
+        # zero-padded `stacked` to divide the data axis), then pad it
+        w = mb.valid_row_mask(
+            {p: v[:, :rows] for p, v in stacked.items()}, true_rows)
         tgt_stacked = None
         if targets is not None:
             tgt_stacked, t_rows = mb.stack_scatter(targets, m)
             if t_rows != true_rows:
                 raise ValueError(
                     f"targets batch {t_rows} != inputs batch {true_rows}")
-
-        # rows must divide the data axis; zero-pad and zero the mask
-        rows = next(iter(stacked.values())).shape[1]
-        mb_rows = -(-rows // self.n_data) * self.n_data
-        if mb_rows != rows:
+        if low["padded"]:
             def pad_rows(v):
-                pad = [(0, 0), (0, mb_rows - rows)] + [(0, 0)] * (v.ndim - 2)
+                pad = ([(0, 0), (0, mb_rows - rows)]
+                       + [(0, 0)] * (v.ndim - 2))
                 return jnp.pad(v, pad)
-            stacked = {p: pad_rows(v) for p, v in stacked.items()}
             if tgt_stacked is not None:
                 tgt_stacked = jax.tree_util.tree_map(pad_rows, tgt_stacked)
             w = jnp.pad(w, [(0, 0), (0, mb_rows - rows)])
-        local_rows = mb_rows // self.n_data
 
-        # -- boundary chain (abstract; partition order) -------------------
-        def local_spec(v):
-            return jax.ShapeDtypeStruct((local_rows,) + v.shape[2:], v.dtype)
-
-        in_specs: List[Any] = []
-        for p in range(len(inputs)):
-            if p in closed:
-                in_specs.append(closed[p])
-            else:
-                in_specs.append(local_spec(stacked[str(p)]))
-        plans: List[PackPlan] = []
-        x_plan_specs = [s for p, s in enumerate(in_specs) if p not in closed]
-        plans.append(PackPlan([jax.ShapeDtypeStruct(s.shape, s.dtype)
-                               for s in x_plan_specs]))
-        # Spec-mode tracker: skip-carrying partitions stash/pop during the
-        # boundary walk (shapes only), and its store afterwards holds each
-        # lane's local value spec (same device as hetero.py's lane sizing).
-        from ..extras.skip import SkipTracker, use_skip_tracker
-        spec_tracker = SkipTracker(self.layout, spec_mode=True)
-        specs = in_specs
-        boundaries = [in_specs]
-        with use_skip_tracker(spec_tracker):
-            for s_idx, part in enumerate(self.partitions):
-                out = part.out_spec(pack.abstract_tree(self.row_of(s_idx)),
-                                    *specs)
-                specs = (list(out) if isinstance(out, (tuple, list))
-                         else [out])
-                boundaries.append(specs)
-                plans.append(PackPlan(
-                    [jax.ShapeDtypeStruct(jnp.shape(sp_),
-                                          jnp.result_type(sp_))
-                     for sp_ in specs]))
         lane_specs = tuple(spec_tracker._store[(0, ns, name)]
                            for ns, name, _, _ in self.lane_keys)
         lane_pairs = tuple((src, dst)
@@ -265,11 +383,6 @@ class HeteroScheduledPipeline:
         collect_stats = self.has_bn
         stat_keys: List[list] = [[] for _ in range(self.S)]
         stat_specs_st: List[list] = [[] for _ in range(self.S)]
-        if self.has_batch_stats and true_rows % (m * self.n_data):
-            raise ValueError(
-                f"BatchNorm needs the batch ({true_rows} rows) to divide "
-                f"evenly into chunks*data ({m}*{self.n_data}): padded rows "
-                "would contaminate the batch statistics")
         if collect_stats:
             import functools as _ft
 
@@ -291,12 +404,6 @@ class HeteroScheduledPipeline:
                                 spec_tracker.accum[k_])
         stat_spec = (tuple(tuple(sp_) for sp_ in stat_specs_st)
                      if collect_stats else None)
-        capacities: Dict[str, int] = {}
-        for plan in plans:
-            for dt, sz in plan.per_dtype.items():
-                capacities[dt] = max(capacities.get(dt, 0), sz)
-
-        dyn_pos = [p for p in range(len(inputs)) if p not in closed]
 
         # -- executor bodies ----------------------------------------------
         def pre_fn(prep, x_mb, ctx):

@@ -86,7 +86,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.partition import StageCtx
 from ..core.remat import validate_mode
-from ..core.schedule import (BWD, FWD, WGRAD, GPipeSchedule,
+from ..core.schedule import (BWD, FWD, IDLE, WGRAD, GPipeSchedule,
                              InterleavedOneFOneBSchedule, OneFOneBSchedule,
                              Schedule, get_schedule)
 from .mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
@@ -467,6 +467,177 @@ class ScheduledPipeline:
             mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False)
         return run(stage_params, pre_params, post_params, x, w, wsum, key)
+
+    # -----------------------------------------------------------------
+    def forward(self, stage_params, pre_params, x, *,
+                key: Optional[jax.Array] = None, train: bool = False,
+                out_fn: Optional[Callable] = None):
+        """FWD-only execution of the op tables: BWD/WGRAD rows masked to
+        IDLE — the compiled analogue of the reference running eval through
+        the same pipeline with checkpointing off (``pipeline.py:153-155``).
+        This is the forward/eval path for interleaved placements (v > 1),
+        which the wavefront executor cannot host. Returns the last virtual
+        stage's outputs ``[m, rows, ...]`` (no post/loss applied).
+
+        ``out_fn(h) -> pytree of [rows, ...]`` post-processes the final
+        stage's activation before collection (e.g. unpacking a packed ring
+        carrier into row-major values) — collected outputs must have ROWS
+        as their leading dim so the data axis lands on it. Identity by
+        default.
+
+        Plain stage bodies only (no skip lanes / stat lanes — both are
+        v == 1 features and v == 1 models have the wavefront executor).
+        """
+        if self.skip_lanes is not None or self.stat_spec is not None:
+            raise NotImplementedError(
+                "forward() runs plain stage bodies; skip/stat lanes ride "
+                "the wavefront executor (v == 1)")
+        if self.split_stage is not None:
+            raise NotImplementedError(
+                "forward() does not use the split-backward protocol")
+        x_leaves = jax.tree_util.tree_leaves(x)
+        if not x_leaves:
+            raise TypeError("x must contain at least one array leaf")
+        m = x_leaves[0].shape[0]
+        key = key if key is not None else make_key(0)
+        data = DATA_AXIS if self.has_data_axis else None
+        out_fn = out_fn if out_fn is not None else (lambda h: h)
+
+        def x_spec(l):
+            spec = [None, data] + [None] * (l.ndim - 2)
+            if self.context_axis and l.ndim > self.context_dim:
+                spec[self.context_dim] = self.context_axis
+            return P(*spec)
+
+        sp_specs = self._stage_param_in_specs(stage_params)
+        ctx0 = StageCtx(key=None, train=train)
+        # per-micro-batch LOCAL specs (this runs at host level, before
+        # shard_map splits the rows/context dims)
+        n_data = self.mesh.shape[DATA_AXIS] if self.has_data_axis else 1
+
+        def x_mb_sds(l):
+            shape = list(l.shape[1:])     # drop the m dim
+            shape[0] //= n_data
+            if self.context_axis and l.ndim > self.context_dim:
+                shape[self.context_dim - 1] //= \
+                    self.mesh.shape[self.context_axis]
+            return jax.ShapeDtypeStruct(tuple(shape), l.dtype)
+
+        x_mb_spec = jax.tree_util.tree_map(x_mb_sds, x)
+        h_spec = jax.eval_shape(
+            lambda p, a: self.pre_fn(p, a, ctx0), pre_params, x_mb_spec)
+        out_sds = jax.eval_shape(out_fn, h_spec)
+        in_specs = (
+            sp_specs,
+            jax.tree_util.tree_map(lambda _: P(), pre_params),
+            jax.tree_util.tree_map(x_spec, x),
+            P(),                          # key
+        )
+        out_specs = jax.tree_util.tree_map(
+            lambda sp_: P(*([STAGE_AXIS, None, data]
+                            + [None] * (len(sp_.shape) - 1))), out_sds)
+        run = jax.shard_map(
+            functools.partial(self._device_forward, m=m, train=train,
+                              out_fn=out_fn),
+            mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False)
+        out = run(stage_params, pre_params, x, key)
+        # the last virtual stage lives on device d-1 (v=1: linear chain;
+        # v>1: stage S-1 = (v-1)*d + (d-1) is on device d-1 either way)
+        return jax.tree_util.tree_map(lambda o: o[-1], out)
+
+    def _device_forward(self, stage_params, pre_params, x, key, *, m,
+                        train, out_fn):
+        d, v = self.n_stages, self.v
+        S = self.n_virtual
+        j = jax.lax.axis_index(STAGE_AXIS)
+        params_dev = stage_params
+
+        ctx0 = StageCtx(key=None, train=train)
+        x_mb_spec = jax.eval_shape(lambda a: _index_spec(a), x)
+        h_spec = jax.eval_shape(
+            lambda p, a: self.pre_fn(p, a, ctx0), pre_params, x_mb_spec)
+
+        (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel = \
+            self._host_tables(m)
+        # eval: checkpointing (hence backward) does not exist — mask every
+        # non-FWD op to IDLE; the FWD entries' relative timing already
+        # satisfies the ring transport constraints the full table verified
+        op_np = np.where(op_np == FWD, FWD, IDLE)
+        xs = (jnp.asarray(op_np), jnp.asarray(mb_np), jnp.asarray(grp_np),
+              jnp.asarray(rxslot_np))
+
+        def zeros_of(spec):
+            return jnp.zeros(spec.shape, spec.dtype)
+
+        def slots_of(spec, k):
+            return jnp.zeros((k + 1,) + tuple(spec.shape), spec.dtype)
+
+        out_sds = jax.eval_shape(out_fn, h_spec)
+        h_ring = jax.tree_util.tree_map(zeros_of, h_spec)
+        stash = jax.tree_util.tree_map(
+            lambda s_: slots_of(s_, v * Sg), h_spec)
+        # one output slot per micro-batch + a sentinel for non-last stages
+        outbuf = jax.tree_util.tree_map(
+            lambda s_: slots_of(s_, m), out_sds)
+
+        if v == 1:
+            fwd_perm = [(k, k + 1) for k in range(d - 1)]
+        else:
+            fwd_perm = [(q, (q + 1) % d) for q in range(d)]
+
+        def cycle(carry, row):
+            h_ring, stash, outbuf = carry
+            op_r, mb_r, grp_r, rx_r = row
+            opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
+            i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
+            g = jax.lax.dynamic_index_in_dim(grp_r, j, 0, keepdims=False)
+            rslot = jax.lax.dynamic_index_in_dim(rx_r, j, 0, keepdims=False)
+            s = g * d + j
+
+            stash = jax.tree_util.tree_map(
+                lambda st, hr: jax.lax.dynamic_update_index_in_dim(
+                    st, hr, rslot, 0), stash, h_ring)
+            kis = jax.random.fold_in(jax.random.fold_in(key, i), s)
+            x_mb = _index(x, i)
+            params_g = (_index(params_dev, 0) if v == 1
+                        else _index(params_dev, g))
+            h_in = jax.tree_util.tree_map(
+                lambda st: jax.lax.dynamic_index_in_dim(
+                    st, g * Sg + i % Sg, 0, keepdims=False), stash)
+
+            def fwd_branch():
+                h0 = jax.lax.cond(
+                    s == 0,
+                    lambda: self.pre_fn(
+                        pre_params, x_mb,
+                        StageCtx(key=jax.random.fold_in(kis, 0),
+                                 train=train, data_axis=self.bn_axis)),
+                    lambda: h_in)
+                h1 = self.stage_fn(
+                    params_g, h0,
+                    StageCtx(key=jax.random.fold_in(kis, 1), train=train,
+                             stage=s, data_axis=self.bn_axis))
+                widx = jnp.where(s == S - 1, i, m)   # sentinel elsewhere
+                new_out = jax.tree_util.tree_map(
+                    lambda buf, l: jax.lax.dynamic_update_index_in_dim(
+                        buf, l, widx, 0), outbuf, out_fn(h1))
+                return new_out, h1
+
+            def idle_branch():
+                return outbuf, h_ring
+
+            outbuf2, tx_h = jax.lax.switch(
+                jnp.clip(opj, 0, 1), [idle_branch, fwd_branch])
+            if d > 1:
+                tx_h = jax.tree_util.tree_map(
+                    lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm),
+                    tx_h)
+            return (tx_h, stash, outbuf2), None
+
+        (_, _, outbuf), _ = jax.lax.scan(
+            cycle, (h_ring, stash, outbuf), xs)
+        return jax.tree_util.tree_map(lambda b: b[None, :m], outbuf)
 
     # -----------------------------------------------------------------
     def _stage_param_in_specs(self, stage_params):

@@ -165,8 +165,10 @@ class Pipe:
         # Pipeline, pipe.py:344-356; forward runs it, pipe.py:431-494):
         # * forward (`__call__`): the GPipe-wavefront hetero executor —
         #   forward has no backward to interleave, so every schedule's
-        #   forward IS the wavefront (v == 1 only; interleaved placements
-        #   have no forward-only executor here);
+        #   forward IS the wavefront (v == 1); interleaved placements
+        #   (v > 1) run the op tables with BWD rows masked to IDLE via
+        #   the table executor's forward() (reference eval-mode pipeline,
+        #   pipeline.py:153-155);
         # * training (`loss_and_grad`): the schedule-table executor, giving
         #   1F1B's min(m, n) activation cap, zb-h1, interleaved-1f1b and the
         #   exact per-micro-batch checkpoint policy through the flagship API.
@@ -333,6 +335,7 @@ class Pipe:
                  remat_policy=None):
         from .extras.norm import DeferredBatchNorm, commit_batchnorm_stats
 
+        explicit_policy = remat_policy
         if remat_policy is None:
             remat_policy = self.remat_policy
         if self._executor is not None:
@@ -343,10 +346,19 @@ class Pipe:
                 return out, self._commit_bn_mesh(params, stats)
             return res
         if self.mesh is not None:
-            raise NotImplementedError(
-                "interleaved placements (v > 1) have no forward-only "
-                "executor; use loss_and_grad for training, or an emulator "
-                "Pipe for inference")
+            # interleaved (v > 1) placements: run the op tables with BWD
+            # rows masked to IDLE — the reference's eval-mode pipeline with
+            # checkpointing off (pipeline.py:153-155). This path has no
+            # remat wrapping: eval has no backward, and training goes
+            # through loss_and_grad (which owns the checkpoint policy) —
+            # refuse an explicit per-call policy rather than ignore it.
+            if explicit_policy is not None:
+                raise NotImplementedError(
+                    "the interleaved (v > 1) forward executor does not "
+                    "apply remat_policy — differentiate via loss_and_grad "
+                    "(the training path owns checkpointing)")
+            return self._train_executor.forward(params, *inputs, key=key,
+                                                train=train)
         if isinstance(params, dict):
             raise TypeError(
                 "stage-sharded packed params need Pipe(mesh=...); the serial "

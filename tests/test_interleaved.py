@@ -133,3 +133,78 @@ def test_bubble_improves_with_v():
     gpipe_bubble = (d - 1) / (m + d - 1)
     inter_bubble = sched.device_bubble(m, d)
     assert inter_bubble < gpipe_bubble
+
+
+# ------ interleaved (v>1) forward/eval executor (VERDICT r3 #6) ------
+
+def test_interleaved_pipe_forward_matches_emulator():
+    """Pipe(mesh=, schedule='interleaved-1f1b') forward: the op tables run
+    with BWD rows masked to IDLE (the reference's eval-mode pipeline with
+    checkpointing off, pipeline.py:153-155) — outputs equal the serial
+    emulator, with and without a data axis."""
+    from pipe_tpu import Lambda, Linear, Pipe, Sequential
+    from pipe_tpu.parallel.mesh import make_mesh
+
+    def build():
+        return Sequential([Linear(8), Lambda(jnp.tanh), Linear(8),
+                           Lambda(jnp.tanh), Linear(8), Lambda(jnp.tanh),
+                           Linear(8), Linear(4)])
+
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    emu = Pipe(build(), chunks=4, n_stages=4, balance=[2, 2, 2, 2])
+    params = emu.init(jax.random.key(0), x)
+    exp = emu(params, x, train=False)
+    for n_data in (1, 2):
+        pipe = Pipe(build(), chunks=4,
+                    mesh=make_mesh(2, n_data,
+                                   devices=jax.devices()[:2 * n_data]),
+                    schedule="interleaved-1f1b", balance=[2, 2, 2, 2])
+        packed = pipe.shard_params(pipe.init(jax.random.key(0), x))
+        out = jax.jit(lambda p, pipe=pipe: pipe(p, x))(packed)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(exp),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_interleaved_trained_model_eval_on_mesh():
+    """Train with interleaved-1f1b loss_and_grad, then compute eval loss
+    ON the mesh (no emulator regroup) — it must equal the emulator's eval
+    of the same trained weights."""
+    import optax
+
+    from pipe_tpu import Lambda, Linear, Pipe, Sequential
+    from pipe_tpu.parallel.mesh import make_mesh
+
+    def build():
+        return Sequential([Linear(8), Lambda(jnp.tanh), Linear(8),
+                           Lambda(jnp.tanh), Linear(8), Lambda(jnp.tanh),
+                           Linear(8), Linear(4)])
+
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    y = jax.random.normal(jax.random.key(2), (8, 4))
+
+    def loss_fn(o, t):
+        return jnp.sum((o - t) ** 2, axis=-1)
+
+    pipe = Pipe(build(), chunks=4,
+                mesh=make_mesh(2, 1, devices=jax.devices()[:2]),
+                schedule="interleaved-1f1b", balance=[2, 2, 2, 2])
+    packed = pipe.shard_params(pipe.init(jax.random.key(0), x))
+    tx = optax.sgd(0.05)
+    opt = tx.init(packed)
+
+    @jax.jit
+    def step(pk, opt):
+        loss, g = pipe.loss_and_grad(pk, x, targets=y, loss_fn=loss_fn)
+        upd, opt = tx.update(g, opt, pk)
+        return optax.apply_updates(pk, upd), opt, loss
+
+    for _ in range(8):
+        packed, opt, loss = step(packed, opt)
+        jax.block_until_ready(loss)
+    out_mesh = jax.jit(lambda p: pipe(p, x))(packed)
+    eval_mesh = float(jnp.mean(loss_fn(out_mesh, y)))
+    emu = Pipe(build(), chunks=4, n_stages=4, balance=[2, 2, 2, 2])
+    out_emu = emu(pipe.unshard_params(packed), x, train=False)
+    eval_emu = float(jnp.mean(loss_fn(out_emu, y)))
+    assert eval_mesh == pytest.approx(eval_emu, rel=1e-5)
+    assert eval_mesh < float(loss)  # eval (no further step) is consistent

@@ -92,9 +92,14 @@ def test_interleaved_1f1b_through_pipe():
     for a, b in zip(jax.tree_util.tree_leaves(back),
                     jax.tree_util.tree_leaves([[p] for p in params])):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-    # no forward-only executor for interleaved placements
-    with pytest.raises(NotImplementedError):
-        pipe(packed, x)
+    # forward for interleaved placements: the op tables with BWD masked
+    # to IDLE (the eval-mode pipeline) — outputs equal the plain chain
+    out = pipe(packed, x)
+    ref_out = x
+    for p, layer in zip(params, seq):
+        ref_out = layer.apply(p, ref_out)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_uneven_balance_and_multi_value_boundary_1f1b():
