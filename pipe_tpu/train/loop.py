@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import warnings
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -101,7 +102,24 @@ class Trainer:
                     interleave=cfg.interleave)
                 self.n_virtual = cfg.n_stages * cfg.interleave
             else:
-                # "1f1b" or "zb-h1" (split-backward zero-bubble tables)
+                # "1f1b" or "zb-h1" (split-backward zero-bubble tables).
+                # zb-h1's recommendation is GATED on the committed cost
+                # model (docs/zb_crossover.md): it beats 1f1b on parallel
+                # hardware only when the measured split overhead sigma is
+                # below the config's breakeven sigma* — at the cpu8-
+                # measured sigma (~1.9-2.3) it loses at every swept
+                # config, so 1f1b stays the default and zb-h1 is an
+                # explicit, measured-first opt-in.
+                if cfg.schedule == "zb-h1":
+                    from ..obs.zb_model import crossover
+                    row = crossover(cfg.chunks, cfg.n_stages, sigma=1.0)
+                    warnings.warn(
+                        f"zb-h1 at (m={cfg.chunks}, n={cfg.n_stages}): "
+                        f"wins on parallel hardware only if its split "
+                        f"overhead sigma < {row['breakeven_sigma']:.2f} "
+                        f"(cpu8 measures sigma 1.9-2.3; see "
+                        f"docs/zb_crossover.md). Measure before "
+                        f"preferring it over '1f1b'.", stacklevel=2)
                 sched = cfg.schedule
                 self.n_virtual = cfg.n_stages
             self.model = PipelinedLM(model_cfg, self.n_virtual)

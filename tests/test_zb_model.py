@@ -1,0 +1,107 @@
+"""zb-h1 cost model (obs/zb_model.py): the falsifiable win criterion.
+
+These tests pin the MODEL's math on synthetic costs — the committed
+calibration artifact (ZB_CROSSOVER_r{N}.json) pins the fit on real cpu8
+measurements. Together: the cpu8 wall-clock loss of zb-h1 and its predicted
+parallel-hardware behavior come from one set of equations.
+"""
+
+import numpy as np
+import pytest
+
+from pipe_tpu.core.schedule import BWD, FWD, WGRAD, get_schedule
+from pipe_tpu.obs.zb_model import (OpCosts, calibrate, crossover, predict,
+                                   schedule_wall)
+
+
+def test_ideal_split_wins_parallel_loses_nothing_serialized():
+    """sigma=1, o=0: the zero-bubble promise — zb-h1 strictly beats 1F1B
+    on PARALLEL hardware wherever 1F1B has a bubble, while total work
+    (serialized wall) is identical."""
+    for (m, n) in ((8, 4), (8, 8), (16, 8)):
+        par = predict(m, n, OpCosts(f=1.0, sigma=1.0, o=0.0), "parallel")
+        ser = predict(m, n, OpCosts(f=1.0, sigma=1.0, o=0.0), "serialized")
+        assert par["zb_wins"], (m, n, par)
+        assert ser["zb_over_1f1b"] == pytest.approx(1.0)
+
+
+def test_measured_sigma_flips_the_parallel_prediction():
+    """At the committed cpu8-measured overhead (sigma ~ 1.6+), the
+    parallel prediction flips against zb-h1 at the shallow bench config —
+    the model explains BOTH the idle-fraction win and the wall-clock loss."""
+    lo = predict(8, 4, OpCosts(f=1.0, sigma=1.0, o=0.0), "parallel")
+    hi = predict(8, 4, OpCosts(f=1.0, sigma=3.0, o=0.0), "parallel")
+    assert lo["zb_wins"] and not hi["zb_wins"]
+
+
+def test_per_cycle_overhead_taxes_zb_more():
+    """zb tables have more cycles; o > 0 must widen 1F1B's absolute lead
+    (the o_max crossover in `crossover()` is exactly this slope)."""
+    a = predict(16, 8, OpCosts(f=1.0, sigma=2.0, o=0.0), "parallel")
+    b = predict(16, 8, OpCosts(f=1.0, sigma=2.0, o=1.0), "parallel")
+    gap_a = a["t_zb"] - a["t_1f1b"]
+    gap_b = b["t_zb"] - b["t_1f1b"]
+    row = crossover(16, 8, sigma=2.0)
+    assert gap_b == pytest.approx(
+        gap_a + (row["cycles_zb"] - row["cycles_1f1b"]) * 1.0)
+    assert gap_b > gap_a
+
+
+def test_breakeven_sigma_is_the_exact_boundary():
+    """t_zb(sigma*) == t_1f1b at o=0, and sigma just below/above the
+    breakeven flips the outcome."""
+    for (m, n) in ((8, 8), (16, 8), (16, 16)):
+        row = crossover(m, n, sigma=1.0)
+        s_star = row["breakeven_sigma"]
+        assert s_star > 1.0, (m, n, s_star)   # ideal split always wins
+        at = predict(m, n, OpCosts(f=1.0, sigma=s_star, o=0.0), "parallel")
+        assert at["zb_over_1f1b"] == pytest.approx(1.0, rel=1e-9)
+        assert predict(m, n, OpCosts(f=1.0, sigma=s_star * 0.99, o=0.0),
+                       "parallel")["zb_wins"]
+        assert not predict(m, n, OpCosts(f=1.0, sigma=s_star * 1.01, o=0.0),
+                           "parallel")["zb_wins"]
+
+
+def test_calibrate_recovers_synthetic_truth():
+    """Generate serialized measurements from known (f, sigma, o); the fit
+    must recover them."""
+    n = 4
+    truth = {64: (0.002, 1.7, 0.004), 128: (0.009, 1.9, 0.012)}
+    rows = []
+    for width, (f, sg, o) in truth.items():
+        for m in (8, 16):
+            c = OpCosts(f=f, sigma=sg, o=o)
+            rows.append({
+                "width": width, "m": m,
+                "t_1f1b": schedule_wall(
+                    get_schedule("1f1b").op_tables(m, n)[0], c,
+                    "serialized"),
+                "t_zb": schedule_wall(
+                    get_schedule("zb-h1").op_tables(m, n)[0], c,
+                    "serialized"),
+            })
+    cal = calibrate(rows, n)
+    for k, width in enumerate(cal["widths"]):
+        f, sg, o = truth[width]
+        assert cal["f_per_width"][k] == pytest.approx(f, rel=1e-6)
+        assert cal["sigma_per_width"][k] == pytest.approx(sg, rel=1e-6)
+        assert cal["o_serialized_per_width"][k] == pytest.approx(o,
+                                                                 rel=1e-6)
+        assert cal["rel_residual_per_width"][k] < 1e-9
+
+
+def test_calibrate_rejects_single_m():
+    with pytest.raises(ValueError, match="micro-batch"):
+        calibrate([{"width": 64, "m": 8, "t_1f1b": 1.0, "t_zb": 1.5}], 4)
+
+
+def test_schedule_wall_modes_agree_with_hand_count():
+    """Hand-check on a tiny table: parallel sums per-cycle maxima,
+    serialized sums everything."""
+    op = np.array([[FWD, 0], [BWD, FWD], [WGRAD, BWD], [0, WGRAD]])
+    c = OpCosts(f=1.0, sigma=1.5, o=0.25)
+    # split table: B and W cost sigma * f = 1.5 each
+    # parallel: max per cycle = [1, 1.5, 1.5, 1.5] + 4 * 0.25
+    assert schedule_wall(op, c, "parallel") == pytest.approx(5.5 + 1.0)
+    # serialized: 1 + (1.5 + 1) + (1.5 + 1.5) + 1.5 + 4 * 0.25
+    assert schedule_wall(op, c, "serialized") == pytest.approx(8.0 + 1.0)
