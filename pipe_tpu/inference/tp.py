@@ -44,7 +44,13 @@ class TPShardedGenerator(Generator):
     (per-leaf PartitionSpecs) — :class:`TPPipelinedLM` (Megatron split)
     and :class:`~..models.moe_lm.MoEPipelinedLM` (experts + heads
     sharded). Params are ``model.init``'s full trees — the per-leaf specs
-    shard them on entry. Beam search is single-device only.
+    shard them on entry.
+
+    Beam search works over the sharded weights too: the beam machinery is
+    layout-agnostic — log-probs come off the (replicated) vocab head
+    after each block's psum, so ``top_k``/parent selection compute
+    identically on every model shard, and the per-step KV-cache reorder
+    gathers on the BATCH axis, which the head-sharded caches keep whole.
     """
 
     def __init__(self, mesh: Mesh, model,
@@ -56,8 +62,6 @@ class TPShardedGenerator(Generator):
                 "TPShardedGenerator needs a model built with "
                 f"tp_axis={MODEL_AXIS!r} (got "
                 f"{getattr(model.block, 'tp_axis', None)!r})")
-        if gen_cfg.num_beams > 1:
-            raise ValueError("beam search is single-device only")
         super().__init__(model, gen_cfg)
         self.mesh = mesh
         self.tp = mesh.shape[MODEL_AXIS]
@@ -78,38 +82,61 @@ class TPShardedGenerator(Generator):
                            "v": jnp.zeros(shape, cd)})
         return caches
 
-    def generate(self, params, prompt: jax.Array,
-                 key: Optional[jax.Array] = None) -> jax.Array:
-        """Sample ``[b, max_new_tokens]`` continuations with the weights
-        sharded over the model axis."""
+    def _sharded_program(self, params, prompt, *, beam: bool):
+        """Build (or fetch) the jitted shard_map program: greedy/sampling
+        (``_generate``, keyed) or beam (``_generate_beam``, deterministic,
+        two replicated outputs)."""
         stage_params, pre_params, post_params = params
-        check_positions(self.model, prompt.shape[1],
-                        self.gen_cfg.max_new_tokens)
-        if key is None:
-            key = jax.random.key(0)
-
-        cache_key = (prompt.shape,
+        cache_key = (beam, prompt.shape,
                      jax.tree_util.tree_structure(params))
         run = self._programs.get(cache_key)
-        if run is None:
-            stage_specs = [self.model.stage_param_specs()
-                           for _ in stage_params]
-            in_specs = (
-                stage_specs,
-                jax.tree_util.tree_map(lambda _: P(), pre_params),
-                jax.tree_util.tree_map(lambda _: P(), post_params),
-                P(), P(),
-            )
+        if run is not None:
+            return run
+        stage_specs = [self.model.stage_param_specs()
+                       for _ in stage_params]
+        in_specs = (
+            stage_specs,
+            jax.tree_util.tree_map(lambda _: P(), pre_params),
+            jax.tree_util.tree_map(lambda _: P(), post_params),
+            P(),
+        )
+        if beam:
+            run = jax.jit(jax.shard_map(
+                lambda sp, pre, post, pr: self._generate_beam(
+                    (sp, pre, post), pr),
+                mesh=self.mesh, in_specs=in_specs, out_specs=(P(), P()),
+                check_vma=False))
+        else:
             run = jax.jit(jax.shard_map(
                 lambda sp, pre, post, pr, k: self._generate(
                     (sp, pre, post), pr, k),
-                mesh=self.mesh, in_specs=in_specs, out_specs=P(),
-                check_vma=False))
-            self._programs[cache_key] = run
-        return run(stage_params, pre_params, post_params,
+                mesh=self.mesh, in_specs=in_specs + (P(),),
+                out_specs=P(), check_vma=False))
+        self._programs[cache_key] = run
+        return run
+
+    def generate(self, params, prompt: jax.Array,
+                 key: Optional[jax.Array] = None) -> jax.Array:
+        """Sample ``[b, max_new_tokens]`` continuations with the weights
+        sharded over the model axis. ``num_beams > 1`` runs beam search
+        (deterministic; ``key`` unused)."""
+        check_positions(self.model, prompt.shape[1],
+                        self.gen_cfg.max_new_tokens)
+        if self.gen_cfg.num_beams > 1:
+            return self.generate_with_scores(params, prompt)[0]
+        if key is None:
+            key = jax.random.key(0)
+        run = self._sharded_program(params, prompt, beam=False)
+        return run(params[0], params[1], params[2],
                    jnp.asarray(prompt, jnp.int32), key)
 
     def generate_with_scores(self, params, prompt):
-        raise NotImplementedError(
-            "beam search over TP-sharded weights is not supported; "
-            "use the single-device Generator (tp_axis=None)")
+        """Beam search over the sharded weights: ``(tokens, scores)``,
+        token-for-token equal to the single-device Generator's."""
+        if self.gen_cfg.num_beams < 2:
+            raise ValueError("generate_with_scores requires num_beams >= 2")
+        check_positions(self.model, prompt.shape[1],
+                        self.gen_cfg.max_new_tokens)
+        run = self._sharded_program(params, prompt, beam=True)
+        return run(params[0], params[1], params[2],
+                   jnp.asarray(prompt, jnp.int32))

@@ -78,11 +78,16 @@ def pytest_collection_modifyitems(config, items):
         if nodeid in _SMOKE:
             item.add_marker(pytest.mark.smoke)
             found.add(nodeid)
-    # Only enforce completeness when the whole suite is collected (a
-    # partial-file invocation legitimately misses the rest); the item
-    # count is the signal, not the spelling of the invocation path.
-    if len(items) > 400:
-        missing = _SMOKE - found
+    # Enforce completeness PER FILE: a smoke nodeid must exist whenever
+    # its file collected at all — catches renames without tripping on
+    # legitimate partial runs (single files, --ignore, -k filters leave
+    # whole files out, not individual smoke ids... except -k, so gate on
+    # no keyword filter).
+    if not config.option.keyword:
+        collected_files = {item.nodeid.split("tests/")[-1].split("::")[0]
+                           for item in items}
+        missing = {nid for nid in _SMOKE - found
+                   if nid.split("::")[0] in collected_files}
         assert not missing, (
             f"smoke-tier nodeids no longer collect (renamed/removed "
             f"tests?): {sorted(missing)}")

@@ -84,3 +84,24 @@ def test_moe_sharded_greedy_matches_unsharded():
         make_mesh(1, 1, n_model=2), model_ep, gen_cfg).generate(params,
                                                                 prompt))
     np.testing.assert_array_equal(got, ref)
+
+
+def test_ep_sharded_beam_matches_unsharded():
+    """Beam search over EP-sharded (experts + heads) weights: tokens AND
+    scores equal the single-device Generator's — pins the serving.md/
+    PARITY claim for the MoE family (the dense dispatch must hold under
+    the b*k beam batch)."""
+    model_ep = MoEPipelinedLM(CFG, 2)
+    model_1 = MoEPipelinedLM(CFG, 2, ep_axis=None)
+    params = model_1.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(5), (2, 8), 0, CFG.vocab,
+                                jnp.int32)
+    gen_cfg = GenerationConfig(max_new_tokens=5, num_beams=2)
+    ref_t, ref_s = Generator(model_1, gen_cfg).generate_with_scores(
+        params, prompt)
+    got_t, got_s = TPShardedGenerator(
+        make_mesh(1, 1, n_model=2), model_ep,
+        gen_cfg).generate_with_scores(params, prompt)
+    np.testing.assert_array_equal(np.asarray(got_t), np.asarray(ref_t))
+    np.testing.assert_allclose(np.asarray(got_s), np.asarray(ref_s),
+                               rtol=1e-5)

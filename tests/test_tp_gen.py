@@ -51,6 +51,32 @@ def test_tp_sharded_greedy_matches_unsharded(tp, b, p, max_new):
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_sharded_beam_matches_unsharded(tp):
+    """Beam search over TP-sharded weights (VERDICT r3 #10): tokens AND
+    scores equal the single-device Generator's — the beam machinery is
+    layout-agnostic (replicated post-psum log-probs; batch-axis cache
+    reorder), so sharding must be invisible to it."""
+    model_tp = TPPipelinedLM(CFG, 2)
+    model_1 = TPPipelinedLM(CFG, 2, tp_axis=None)
+    params = model_1.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, CFG.vocab,
+                                jnp.int32)
+    gen_cfg = GenerationConfig(max_new_tokens=5, num_beams=3)
+    ref_toks, ref_scores = Generator(model_1, gen_cfg).generate_with_scores(
+        params, prompt)
+    mesh = make_mesh(1, 1, n_model=tp)
+    g = TPShardedGenerator(mesh, model_tp, gen_cfg)
+    got_toks, got_scores = g.generate_with_scores(params, prompt)
+    np.testing.assert_array_equal(np.asarray(got_toks),
+                                  np.asarray(ref_toks))
+    np.testing.assert_allclose(np.asarray(got_scores),
+                               np.asarray(ref_scores), rtol=1e-5)
+    # generate() routes num_beams > 1 through beam search
+    np.testing.assert_array_equal(
+        np.asarray(g.generate(params, prompt)), np.asarray(ref_toks))
+
+
 def test_tp_generator_validations():
     model_tp = TPPipelinedLM(CFG, 2)
     model_1 = TPPipelinedLM(CFG, 2, tp_axis=None)
@@ -59,10 +85,7 @@ def test_tp_generator_validations():
         TPShardedGenerator(mesh, model_1)
     with pytest.raises(ValueError, match="model"):
         TPShardedGenerator(make_mesh(2, 1), model_tp)
-    with pytest.raises(ValueError, match="beam"):
-        TPShardedGenerator(mesh, model_tp,
-                           GenerationConfig(max_new_tokens=2, num_beams=2))
     g = TPShardedGenerator(mesh, model_tp,
                            GenerationConfig(max_new_tokens=2))
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="num_beams"):
         g.generate_with_scores(None, jnp.zeros((2, 4), jnp.int32))
