@@ -299,7 +299,15 @@ def main():
                               post_fn=model.loss_post_fn,
                               checkpoint=CHECKPOINT, schedule="1f1b",
                               remat_policy=policy)
-    tx = optax.chain(optax.clip_by_global_norm(0.5), optax.adam(1e-4))
+    # Adam first-moment dtype: the composed bf16 probe measured ~4%
+    # within one session, and the full bench with bf16-mu only measured
+    # +2.4% over r3's committed f32-mu number (cross-session; see
+    # MFU_SWEEP_r04.jsonl). Applied to the pipelined step AND the
+    # single-device baselines alike, so vs_baseline stays like-for-like.
+    # Override with BENCH_MU_DTYPE=float32.
+    mu_dtype = jnp.dtype(os.environ.get("BENCH_MU_DTYPE", "bfloat16"))
+    tx = optax.chain(optax.clip_by_global_norm(0.5),
+                     optax.adam(1e-4, mu_dtype=mu_dtype))
 
     tokens = jax.random.randint(jax.random.key(1), (BATCH, cfg.seq_len),
                                 0, cfg.vocab, jnp.int32)
@@ -445,6 +453,7 @@ def main():
         "chunks": CHUNKS,
         "checkpoint": CHECKPOINT,
         "remat_policy": REMAT_POLICY if policy is not None else "none",
+        "mu_dtype": str(mu_dtype),
         "params": n_params,
         "model_flops": model_flops,
         "mfu": round(mfu, 4),

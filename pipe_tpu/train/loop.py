@@ -52,6 +52,10 @@ class TrainerConfig:
     seed: int = 1234
     schedule: str = "gpipe"    # gpipe | 1f1b | zb-h1 | interleaved
                                # | interleaved-1f1b
+    # Adam first-moment storage dtype: 'bfloat16' halves the m-moment HBM
+    # traffic — measured ~4% step-time win at the 520M bench scale
+    # (MFU_SWEEP_r04.jsonl, docs/mfu_roofline.md); None keeps f32.
+    mu_dtype: Optional[str] = None
     interleave: int = 2        # virtual stages per device (interleaved only)
     # Directory for TensorBoard scalar event files (SURVEY §5 "stdout +
     # TensorBoard scalars"); None disables. Scalars mirror the stdout log
@@ -167,7 +171,8 @@ class Trainer:
         # closure — closures bake at trace time.
         self.tx = optax.chain(
             optax.clip_by_global_norm(cfg.grad_clip),
-            optax.scale_by_adam(),
+            optax.scale_by_adam(
+                mu_dtype=jnp.dtype(cfg.mu_dtype) if cfg.mu_dtype else None),
         )
         # ZeRO-1 layout trees; populated by init_state (they need concrete
         # placed params). The jitted step traces on first call, after that.

@@ -110,3 +110,29 @@ def test_moment_sharding_fallback_replicates_indivisible():
     # scalars (adam count) stay replicated
     report = zero.zero_report(state.opt_state, tr._zero_shardings)
     assert report["replicated_bytes"] >= 0
+
+
+def test_mu_dtype_bf16_composes_with_zero():
+    """TrainerConfig(mu_dtype='bfloat16'): the Adam first-moment leaves
+    are actually stored bf16, the step runs, and it composes with ZeRO-1
+    moment sharding (MFU_SWEEP_r04 knob)."""
+    import dataclasses
+    import math
+
+    from pipe_tpu.data import lm_text
+    from pipe_tpu.models.transformer_lm import LMConfig
+    from pipe_tpu.train.loop import Trainer, TrainerConfig
+
+    lines = lm_text.synthetic_corpus(20_000, 99, seed=3)
+    vocab = lm_text.Vocab(map(lm_text.basic_english_tokenize, lines))
+    source = lm_text.batchify(lm_text.data_process(lines, vocab), 8)
+    mcfg = dataclasses.replace(LMConfig().tiny(), n_layers=2)
+    tr = Trainer(mcfg, TrainerConfig(
+        schedule="1f1b", n_stages=2, n_data=2, chunks=2, batch_size=8,
+        bptt=mcfg.seq_len, lr=1e-2, mu_dtype="bfloat16", zero=True))
+    state, m = tr.train_epoch(source, max_steps=6, log_every=0)
+    import jax.numpy as jnp
+    assert m["loss"] < math.log(mcfg.vocab)
+    bf16_leaves = [l for l in jax.tree_util.tree_leaves(state.opt_state)
+                   if hasattr(l, "dtype") and l.dtype == jnp.bfloat16]
+    assert bf16_leaves, "mu_dtype='bfloat16' produced no bf16 moments"
