@@ -340,3 +340,31 @@ def test_trainer_generate_from_state():
          jax.tree_util.tree_map(np.asarray, state.params[1]),
          jax.tree_util.tree_map(np.asarray, state.params[2])), prompt)
     np.testing.assert_array_equal(out, np.asarray(ref))
+
+
+def test_checkpoint_roundtrip_bf16_moments(tmp_path, corpus):
+    """mu_dtype='bfloat16': save -> restore round-trips the bf16 moment
+    leaves (the template's dtypes match what training produced) and
+    resumed training continues deterministically."""
+    source, _ = corpus
+    trainer, _, _ = tiny_trainer(mu_dtype="bfloat16")
+    state, _ = trainer.train_epoch(source, state=None, max_steps=3,
+                                   log_every=0)
+    import jax.numpy as jnp
+    assert any(l.dtype == jnp.bfloat16
+               for l in jax.tree_util.tree_leaves(state.opt_state)
+               if hasattr(l, "dtype"))
+    save_checkpoint(str(tmp_path / "ckb"), state, int(state.step))
+    restored = restore_checkpoint(str(tmp_path / "ckb"),
+                                  trainer.init_state())
+    for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
+                    jax.tree_util.tree_leaves(restored.opt_state)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    s1, _ = trainer.train_epoch(source, epoch=1, state=state, max_steps=2,
+                                log_every=0)
+    s2, _ = trainer.train_epoch(source, epoch=1, state=restored,
+                                max_steps=2, log_every=0)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
