@@ -49,6 +49,12 @@ class LMConfig:
     causal: bool = True
     compute_dtype: Any = jnp.float32   # set jnp.bfloat16 on TPU
     attn_impl: str = "auto"            # auto | xla | flash (ops.layers.MHA)
+    # Vocab block size for the streaming (fused head+loss) cross-entropy
+    # (``ops/losses.streaming_xent``): the [tokens, vocab] logits never
+    # materialize — peak head memory drops to O(tokens x block) at the
+    # cost of one recompute pass of head FLOPs in the backward. None =
+    # the dense decoder + per_row_ce path (parity default).
+    loss_block: Any = None
 
     def tiny(self) -> "LMConfig":
         return dataclasses.replace(
@@ -145,7 +151,16 @@ class PipelinedLM(PipelinedTransformer):
         computed on the last stage against the matching micro-batch, so the
         [m, mb, seq, vocab] logits never materialize in HBM (the reference
         moves targets to the last GPU for the same reason, ``main.py:216``).
-        """
+
+        With ``cfg.loss_block`` set, even the per-micro-batch
+        ``[mb, seq, vocab]`` logits never materialize: the head+loss fuse
+        into the vocab-streamed cross-entropy (``ops/losses``)."""
+        if self.cfg.loss_block:
+            from ..ops.losses import streaming_xent
+            p = post_params["decoder"]
+            ce = streaming_xent(h, p["w"], p["b"], x_mb["targets"],
+                                int(self.cfg.loss_block))   # [mb, seq]
+            return jnp.mean(ce, axis=-1)                    # [mb_rows]
         logits = self.decoder.apply(post_params["decoder"],
                                     h.astype(jnp.float32), ctx=ctx)
         return per_row_ce(logits, x_mb["targets"])  # [mb_rows]
