@@ -65,6 +65,7 @@ _SMOKE = {
     "test_tp.py::test_pp_tp_loss_and_grad_transparency[2-2]",
     "test_moe.py::test_pp_dp_ep_loss_and_grad_transparency",
     "test_zero.py::test_zero_losses_match_replicated",
+    "test_losses.py::test_loss_block_through_pipelined_step",
     "test_generate.py::test_greedy_generation_matches_naive_reforward",
     "test_pipelined_gen.py::"
     "test_pipelined_greedy_matches_single_device[2-4-8-6]",
