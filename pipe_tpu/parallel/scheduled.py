@@ -198,6 +198,34 @@ def _load_vjp(store, treedef, slot):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _is_diff(spec) -> bool:
+    return jnp.issubdtype(jnp.asarray(spec).dtype
+                          if not hasattr(spec, "dtype") else spec.dtype,
+                          jnp.inexact)
+
+
+def _ring_to_seed(ring_tree, primal_spec):
+    """Ring cotangent -> vjp seed: integer (non-differentiable) primal
+    lanes — e.g. token ids riding the packed boundary carrier — expect
+    ``float0`` cotangents from ``jax.vjp``; the ring parks placeholder
+    zeros of the primal dtype for them (see :func:`_vjp_to_ring`)."""
+    return jax.tree_util.tree_map(
+        lambda rv, sp_: (rv if _is_diff(sp_)
+                         else np.zeros(sp_.shape, jax.dtypes.float0)),
+        ring_tree, primal_spec)
+
+
+def _vjp_to_ring(ct_tree, primal_spec):
+    """vjp cotangent -> ring value: ``float0`` leaves (int primal lanes)
+    become concrete zeros of the PRIMAL dtype so the carry/ppermute pytree
+    stays uniform. The zeros are inert — every consumer converts back via
+    :func:`_ring_to_seed` before seeding a vjp."""
+    return jax.tree_util.tree_map(
+        lambda ct, sp_: (jnp.zeros(sp_.shape, sp_.dtype)
+                         if ct.dtype == jax.dtypes.float0 else ct),
+        ct_tree, primal_spec)
+
+
 @dataclasses.dataclass
 class ScheduledPipeline:
     """Training executor: ``loss_and_grad`` on a ``(stage[, data])`` mesh.
@@ -339,6 +367,15 @@ class ScheduledPipeline:
                     raise ValueError(
                         f"skip lane ({src}, {dst}) out of range for "
                         f"{S} stages (need 0 <= src < dst < {S})")
+            for sp_ in jax.tree_util.tree_leaves(self.skip_lanes.specs):
+                if hasattr(sp_, "dtype") and not jnp.issubdtype(
+                        sp_.dtype, jnp.inexact):
+                    raise NotImplementedError(
+                        f"skip lane values must be float (got "
+                        f"{sp_.dtype}): integer lanes would need the "
+                        "float0 cotangent plumbing the h carrier has "
+                        "(_ring_to_seed/_vjp_to_ring) on the reverse "
+                        "skip ring too")
         if self.stat_spec is not None:
             if self.split_stage is not None:
                 raise ValueError(
@@ -1454,7 +1491,11 @@ class ScheduledPipeline:
                         lambda pp, hh: self._post_contrib(pp, hh, x_mb, w_mb,
                                                           kis),
                         post_params, h1)
-                    return post_vjp(inv_wsum)
+                    gpost_, gh1 = post_vjp(inv_wsum)
+                    # int (non-differentiable) carrier lanes — e.g. token
+                    # ids in the packed boundary — yield float0 cotangents;
+                    # the ring carries concrete placeholder zeros for them
+                    return gpost_, _vjp_to_ring(gh1, h_spec)
 
                 def ring_seed():
                     return (jax.tree_util.tree_map(jnp.zeros_like,
@@ -1480,7 +1521,8 @@ class ScheduledPipeline:
                                                    lanes.pairs))
                 else:
                     seed_sk = None
-                seed = self._make_seed(seed_h, seed_sk)
+                seed_f0 = _ring_to_seed(seed_h, h_spec)
+                seed = self._make_seed(seed_f0, seed_sk)
 
                 if self.split_stage is not None:
                     # structural split: the stored params-constant vjp IS
@@ -1489,7 +1531,8 @@ class ScheduledPipeline:
                     # park for W, pre grads accumulate here (edge-stage
                     # embed path only).
                     gpre, gh, gzs = _load_vjp(res_store, res_treedef,
-                                              res_slot_for(i, g))(seed_h)
+                                              res_slot_for(i, g))(seed_f0)
+                    gh = _vjp_to_ring(gh, h_spec)
                     new_wstash = jax.tree_util.tree_map(
                         lambda st, l: jax.lax.dynamic_update_index_in_dim(
                             st, l, g * Wg + i % Wg, 0), wstash, gzs)
@@ -1511,6 +1554,7 @@ class ScheduledPipeline:
                 else:
                     gp, gpre, gh = apply_vjp(seed)
                     tx_gk = gk_ring
+                gh = _vjp_to_ring(gh, h_spec)
                 if split_dce:
                     # split backward, stored residuals: B emits only the
                     # input grad (XLA DCE prunes the unused weight-grad
@@ -1555,7 +1599,7 @@ class ScheduledPipeline:
                 seed_h = jax.tree_util.tree_map(
                     lambda st: jax.lax.dynamic_index_in_dim(
                         st, g * Wg + i % Wg, 0, keepdims=False), wstash)
-                gp, gpre, _ = apply_vjp(seed_h)
+                gp, gpre, _ = apply_vjp(_ring_to_seed(seed_h, h_spec))
                 return (h_last, wstash, taps_store, res_store, pres_store,
                         stats_acc, scatter_gp(g_sp, gp), add(g_pre, gpre),
                         g_post, loss, h_ring, g_ring, sk_ring, gk_ring)

@@ -315,3 +315,47 @@ def test_stage_count_validation_interleaved():
         # interleaved v=2 on 4 mesh stages needs 8 partitions; 4 layers
         # can't split into 8
         Pipe(seq, chunks=2, mesh=stage_mesh(4), schedule="interleaved-1f1b")
+
+
+def test_integer_inputs_through_table_executor():
+    """Token ids (int32) riding the packed boundary carrier through
+    Pipe(mesh=).loss_and_grad — the TUTORIAL shape. Regression: int lanes
+    in the carrier yield float0 cotangents from jax.vjp, which must be
+    converted at the ring boundaries (concrete placeholder zeros on the
+    ring, float0 when seeding) or the backward's lax.cond branches
+    disagree on dtypes. Loss/grads must equal the emulator."""
+    import dataclasses
+
+    from pipe_tpu.models.common import per_row_ce
+    from pipe_tpu.models.transformer_lm import LMConfig, build_sequential
+
+    cfg = dataclasses.replace(LMConfig().tiny(), n_layers=2, dropout=0.0)
+    tokens = jax.random.randint(jax.random.key(1), (8, cfg.seq_len), 0,
+                                cfg.vocab, jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=-1)
+
+    def loss_fn(logits, tgt):
+        return per_row_ce(logits, tgt)
+
+    emu = Pipe(build_sequential(cfg), chunks=4, checkpoint="except_last",
+               n_stages=2)
+    params = emu.init(jax.random.key(0), tokens)
+
+    def emu_loss(ps):
+        return jnp.mean(loss_fn(emu(ps, tokens), targets))
+
+    exp_loss = float(emu_loss(params))
+    exp_grads = jax.grad(emu_loss)(params)
+
+    for mode in ("never", "except_last"):
+        pipe = Pipe(build_sequential(cfg), chunks=4, checkpoint=mode,
+                    mesh=stage_mesh(2), schedule="1f1b")
+        packed = pipe.shard_params(pipe.init(jax.random.key(0), tokens))
+        loss, grads = jax.jit(lambda p, pipe=pipe: pipe.loss_and_grad(
+            p, tokens, targets=targets, loss_fn=loss_fn))(packed)
+        assert float(loss) == pytest.approx(exp_loss, rel=1e-5)
+        for a, b in zip(
+                jax.tree_util.tree_leaves(pipe.unshard_grads(grads)),
+                jax.tree_util.tree_leaves(exp_grads)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
