@@ -153,6 +153,36 @@ class HeteroScheduledPipeline:
         inert-policy warning at a user who configured it for forward)."""
         return self.remat_policy if self.checkpoint != "never" else None
 
+    def _discover_stats(self, pack, boundaries, spec_tracker):
+        """Train-mode spec pass per partition discovering each virtual
+        stage's deferred-BN accumulator keys/shapes (shared by
+        :meth:`loss_and_grad` and :meth:`forward`). Returns
+        ``(stat_keys, stat_specs_st, stat_spec)`` — all empty/None when the
+        module has no DeferredBatchNorm."""
+        stat_keys: List[list] = [[] for _ in range(self.S)]
+        stat_specs_st: List[list] = [[] for _ in range(self.S)]
+        if not self.has_bn:
+            return stat_keys, stat_specs_st, None
+        import functools as _ft
+        from ..extras.skip import use_skip_tracker
+
+        def _apply_train(part_, p_, *xs_):
+            return part_.apply(p_, *xs_, ctx=StageCtx(train=True))
+
+        with use_skip_tracker(spec_tracker):
+            for s_idx, part in enumerate(self.partitions):
+                seen = set(spec_tracker.accum)
+                jax.eval_shape(
+                    _ft.partial(_apply_train, part),
+                    pack.abstract_tree(self.row_of(s_idx)),
+                    *boundaries[s_idx])
+                for k_ in spec_tracker.accum:
+                    if k_ not in seen:
+                        stat_keys[s_idx].append(k_)
+                        stat_specs_st[s_idx].append(spec_tracker.accum[k_])
+        stat_spec = tuple(tuple(sp_) for sp_ in stat_specs_st)
+        return stat_keys, stat_specs_st, stat_spec
+
     # -- shared lowering (forward + loss_and_grad) -------------------------
     def _lower_boundaries(self, params, inputs, *, what: str,
                           check_batch_stats: bool = True):
@@ -259,20 +289,30 @@ class HeteroScheduledPipeline:
         IDLE — the eval path for interleaved (v > 1) placements, which
         have no wavefront executor (reference eval-mode pipeline,
         ``pipeline.py:153-155``). Returns gathered final-partition outputs
-        (a value, or a tuple for multi-value boundaries).
+        (a value, or a tuple for multi-value boundaries); for deferred-BN
+        models with ``train=True`` the return is ``(outputs, stats)`` and
+        the caller commits the running-stats update (mirroring the
+        wavefront executor's contract).
 
-        Plain stage bodies only: skip lanes and deferred BN are v == 1
-        features and v == 1 models ride the wavefront executor instead.
+        Skip lanes stay v == 1 features (the wavefront executor hosts
+        them — a forward-only pop could arrive after its consumer's FWD
+        cycle on a wrapped ring).
         """
-        if self.lane_keys or self.has_bn:
+        if self.lane_keys:
             raise NotImplementedError(
-                "table-executor forward() runs plain stage bodies; skip/"
-                "BN models use the wavefront executor (v == 1 schedules)")
+                "table-executor forward() runs plain stage bodies; skip "
+                "models use the wavefront executor (v == 1 schedules)")
         low = self._lower_boundaries(params, inputs, what="forward",
                                      check_batch_stats=train)
         pack, plans = low["pack"], low["plans"]
         boundaries, capacities = low["boundaries"], low["capacities"]
         closed, dyn_pos = low["closed"], low["dyn_pos"]
+        # eval-mode BN reads running stats from params (pure) — only a
+        # train-mode forward needs the stat lanes and the commit
+        collect_stats = self.has_bn and train
+        stat_keys, stat_specs_st, stat_spec = (
+            self._discover_stats(pack, boundaries, low["spec_tracker"])
+            if collect_stats else ([], [], None))
 
         def pre_fn(prep, x_mb, ctx):
             del prep
@@ -292,10 +332,33 @@ class HeteroScheduledPipeline:
                     else:
                         vals.append(next(it))
                 p_tree = pack.unpack_stage(params_g, self.row_of(s_idx))
-                out = part.apply(p_tree, *vals, ctx=ctx)
+                if not collect_stats:
+                    out = part.apply(p_tree, *vals, ctx=ctx)
+                    out_vals = (list(out) if isinstance(out, (tuple, list))
+                                else [out])
+                    return plans[s_idx + 1].pack(out_vals, capacities)
+                # run under a local tracker to capture BN stat
+                # accumulations; export zeros for slots this stage does
+                # not own, so every switch branch is structure-uniform
+                from ..extras.skip import SkipTracker
+                local = SkipTracker(self.layout)
+                with local.scope(0, s_idx):
+                    out = part.apply(p_tree, *vals, ctx=ctx)
                 out_vals = (list(out) if isinstance(out, (tuple, list))
                             else [out])
-                return plans[s_idx + 1].pack(out_vals, capacities)
+
+                def zeros_of(spec):
+                    return jax.tree_util.tree_map(
+                        lambda sp_: jnp.zeros(sp_.shape, sp_.dtype), spec)
+
+                stats = tuple(
+                    tuple((local.accum[k_]
+                           if s2 == s_idx and k_ in local.accum
+                           else zeros_of(spec))
+                          for k_, spec in zip(stat_keys[s2],
+                                              stat_specs_st[s2]))
+                    for s2 in range(self.S))
+                return (plans[s_idx + 1].pack(out_vals, capacities), stats)
 
             return branch
 
@@ -311,12 +374,13 @@ class HeteroScheduledPipeline:
 
         sp = ScheduledPipeline(self.mesh, stage_fn, pre_fn=pre_fn,
                                post_fn=None, checkpoint=self.checkpoint,
-                               schedule=self.schedule)
+                               schedule=self.schedule, stat_spec=stat_spec)
         # out_fn unpacks the final-boundary carrier into row-major values
         # INSIDE the device program, so the data axis lands on the rows
         # dim of the collected outputs
-        outs = sp.forward(params, (), low["stacked"], key=key, train=train,
-                          out_fn=lambda h: tuple(plans[self.S].unpack(h)))
+        res = sp.forward(params, (), low["stacked"], key=key, train=train,
+                         out_fn=lambda h: tuple(plans[self.S].unpack(h)))
+        outs, stats_t = res if collect_stats else (res, None)
         n_out = len(boundaries[self.S])
         gathered = []
         for pos in range(n_out):
@@ -324,7 +388,14 @@ class HeteroScheduledPipeline:
             if low["padded"]:
                 o = o[:, :low["rows"]]
             gathered.append(mb.stack_gather(o, low["true_rows"]))
-        return tuple(gathered) if n_out > 1 else gathered[0]
+        out = tuple(gathered) if n_out > 1 else gathered[0]
+        if collect_stats and train:
+            stats = {}
+            for s_idx in range(self.S):
+                for k_, stv in zip(stat_keys[s_idx], stats_t[s_idx]):
+                    stats[k_] = stv
+            return out, stats
+        return out
 
     # -- the training step -------------------------------------------------
     def loss_and_grad(self, params, *inputs,
@@ -381,29 +452,8 @@ class HeteroScheduledPipeline:
         # discovers each stage's accumulator keys/shapes (mirrors
         # hetero.py); same tracker so skip stash specs resolve.
         collect_stats = self.has_bn
-        stat_keys: List[list] = [[] for _ in range(self.S)]
-        stat_specs_st: List[list] = [[] for _ in range(self.S)]
-        if collect_stats:
-            import functools as _ft
-
-            def _apply_train(part_, p_, *xs_):
-                return part_.apply(p_, *xs_,
-                                   ctx=StageCtx(train=True))
-
-            with use_skip_tracker(spec_tracker):
-                for s_idx, part in enumerate(self.partitions):
-                    seen = set(spec_tracker.accum)
-                    jax.eval_shape(
-                        _ft.partial(_apply_train, part),
-                        pack.abstract_tree(self.row_of(s_idx)),
-                        *boundaries[s_idx])
-                    for k_ in spec_tracker.accum:
-                        if k_ not in seen:
-                            stat_keys[s_idx].append(k_)
-                            stat_specs_st[s_idx].append(
-                                spec_tracker.accum[k_])
-        stat_spec = (tuple(tuple(sp_) for sp_ in stat_specs_st)
-                     if collect_stats else None)
+        stat_keys, stat_specs_st, stat_spec = self._discover_stats(
+            pack, boundaries, spec_tracker)
 
         # -- executor bodies ----------------------------------------------
         def pre_fn(prep, x_mb, ctx):

@@ -522,12 +522,17 @@ class ScheduledPipeline:
         as their leading dim so the data axis lands on it. Identity by
         default.
 
-        Plain stage bodies only (no skip lanes / stat lanes — both are
-        v == 1 features and v == 1 models have the wavefront executor).
+        With ``stat_spec`` the stage contract appends a stats output
+        (``(h, stats)``) and the return becomes ``(outputs, stats)``: stats
+        accumulate over the FWD ops (each micro-batch runs exactly once per
+        stage here — no recompute, no double-count) and are psum'd over the
+        stage/data axes, giving deferred BatchNorm a train-mode forward on
+        interleaved (v > 1) placements. Skip lanes stay v == 1 features
+        (the wavefront executor hosts them).
         """
-        if self.skip_lanes is not None or self.stat_spec is not None:
+        if self.skip_lanes is not None:
             raise NotImplementedError(
-                "forward() runs plain stage bodies; skip/stat lanes ride "
+                "forward() runs plain stage bodies; skip lanes ride "
                 "the wavefront executor (v == 1)")
         if self.split_stage is not None:
             raise NotImplementedError(
@@ -573,15 +578,20 @@ class ScheduledPipeline:
         out_specs = jax.tree_util.tree_map(
             lambda sp_: P(*([STAGE_AXIS, None, data]
                             + [None] * (len(sp_.shape) - 1))), out_sds)
+        if self.stat_spec is not None:   # stats: psum'd in-program
+            out_specs = (out_specs, jax.tree_util.tree_map(
+                lambda _: P(), self.stat_spec))
         run = jax.shard_map(
             functools.partial(self._device_forward, m=m, train=train,
                               out_fn=out_fn),
             mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False)
-        out = run(stage_params, pre_params, x, key)
+        res = run(stage_params, pre_params, x, key)
+        out, stats = res if self.stat_spec is not None else (res, None)
         # the last virtual stage lives on device d-1 (v=1: linear chain;
         # v>1: stage S-1 = (v-1)*d + (d-1) is on device d-1 either way)
-        return jax.tree_util.tree_map(lambda o: o[-1], out)
+        out = jax.tree_util.tree_map(lambda o: o[-1], out)
+        return out if self.stat_spec is None else (out, stats)
 
     def _device_forward(self, stage_params, pre_params, x, key, *, m,
                         train, out_fn):
@@ -624,7 +634,7 @@ class ScheduledPipeline:
             fwd_perm = [(q, (q + 1) % d) for q in range(d)]
 
         def cycle(carry, row):
-            h_ring, stash, outbuf = carry
+            h_ring, stash, outbuf, stats_acc = carry
             op_r, mb_r, grp_r, rx_r = row
             opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
             i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
@@ -651,30 +661,44 @@ class ScheduledPipeline:
                         StageCtx(key=jax.random.fold_in(kis, 0),
                                  train=train, data_axis=self.bn_axis)),
                     lambda: h_in)
-                h1 = self.stage_fn(
+                out = self.stage_fn(
                     params_g, h0,
                     StageCtx(key=jax.random.fold_in(kis, 1), train=train,
                              stage=s, data_axis=self.bn_axis))
+                h1, _, st = self._split_out(out)
+                stats2 = (jax.tree_util.tree_map(jnp.add, stats_acc, st)
+                          if self.stat_spec is not None else stats_acc)
                 widx = jnp.where(s == S - 1, i, m)   # sentinel elsewhere
                 new_out = jax.tree_util.tree_map(
                     lambda buf, l: jax.lax.dynamic_update_index_in_dim(
                         buf, l, widx, 0), outbuf, out_fn(h1))
-                return new_out, h1
+                return new_out, h1, stats2
 
             def idle_branch():
-                return outbuf, h_ring
+                return outbuf, h_ring, stats_acc
 
-            outbuf2, tx_h = jax.lax.switch(
+            outbuf2, tx_h, stats2 = jax.lax.switch(
                 jnp.clip(opj, 0, 1), [idle_branch, fwd_branch])
             if d > 1:
                 tx_h = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm),
                     tx_h)
-            return (tx_h, stash, outbuf2), None
+            return (tx_h, stash, outbuf2, stats2), None
 
-        (_, _, outbuf), _ = jax.lax.scan(
-            cycle, (h_ring, stash, outbuf), xs)
-        return jax.tree_util.tree_map(lambda b: b[None, :m], outbuf)
+        stats0 = (self._zero_seed_like(self.stat_spec)
+                  if self.stat_spec is not None else ())
+        (_, _, outbuf, stats_out), _ = jax.lax.scan(
+            cycle, (h_ring, stash, outbuf, stats0), xs)
+        outs = jax.tree_util.tree_map(lambda b: b[None, :m], outbuf)
+        if self.stat_spec is None:
+            return outs
+        # each virtual stage fills only its own slots (zeros elsewhere);
+        # data shards hold per-shard partial sums — psum collects both
+        stat_axes = ((STAGE_AXIS, DATA_AXIS) if self.has_data_axis
+                     else (STAGE_AXIS,))
+        stats_out = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, stat_axes), stats_out)
+        return outs, stats_out
 
     # -----------------------------------------------------------------
     def _stage_param_in_specs(self, stage_params):

@@ -118,11 +118,6 @@ class Pipe:
                     f"n_stages={n_stages} does not match the mesh's "
                     f"{mesh_stages}-device stage axis for schedule "
                     f"{sched_obj.name!r} (needs v*d = {expected})")
-            if deferred_batch_norm and sched_obj.v > 1:
-                raise NotImplementedError(
-                    "deferred_batch_norm needs a forward executor for the "
-                    "running-stats commit; interleaved placements (v > 1) "
-                    "have none — pick a non-interleaved schedule")
             if deferred_batch_norm and getattr(sched_obj, "splits_backward",
                                                False):
                 raise NotImplementedError(
@@ -189,7 +184,8 @@ class Pipe:
                     mesh, self.partitions, self.skip_layout, chunks,
                     checkpoint)
             # every combination that reaches here has a train path (the
-            # BN x v>1 / BN x zb-h1 exclusions raised above)
+            # sole BN exclusion left is zb-h1, raised above; BN x v>1
+            # rides the table executor's stat lanes)
             from .parallel.hetero_scheduled import HeteroScheduledPipeline
             self._train_executor = HeteroScheduledPipeline(
                 mesh, self.partitions, self.skip_layout, chunks,
@@ -357,8 +353,12 @@ class Pipe:
                     "the interleaved (v > 1) forward executor does not "
                     "apply remat_policy — differentiate via loss_and_grad "
                     "(the training path owns checkpointing)")
-            return self._train_executor.forward(params, *inputs, key=key,
-                                                train=train)
+            res = self._train_executor.forward(params, *inputs, key=key,
+                                               train=train)
+            if self._train_executor.has_bn and train:
+                out, stats = res
+                return out, self._commit_bn_mesh(params, stats)
+            return res
         if isinstance(params, dict):
             raise TypeError(
                 "stage-sharded packed params need Pipe(mesh=...); the serial "
@@ -404,9 +404,13 @@ class Pipe:
         if not isinstance(params, dict):
             return commit_batchnorm_stats(self.partitions, list(params),
                                           _StatsShim)
-        pack = self._executor.param_pack
+        ex = (self._executor if self._executor is not None
+              else self._train_executor)
+        pack = ex.param_pack
         new_params = params
         for j, part in enumerate(self.partitions):
+            # packed row holding partition j (device-major for interleaved)
+            row = ex.row_of(j) if hasattr(ex, "row_of") else j
             tree_j = None
             for i, layer in enumerate(part):
                 if not isinstance(layer, DeferredBatchNorm):
@@ -416,8 +420,8 @@ class Pipe:
                     continue
                 if tree_j is None:
                     tree_j = pack.unpack_stage(
-                        {dt: a[j] for dt, a in params.items()}, j)
+                        {dt: a[row] for dt, a in params.items()}, row)
                 tree_j[i] = layer.commit(tree_j[i], st)
             if tree_j is not None:
-                new_params = pack.replace_stage(new_params, j, tree_j)
+                new_params = pack.replace_stage(new_params, row, tree_j)
         return new_params

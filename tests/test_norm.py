@@ -244,19 +244,80 @@ def test_mesh_plain_bn_rejects_padded_rows():
         pipe(params, x, train=True)
 
 
-def test_mesh_bn_interleaved_rejected():
-    """BN composes with 1f1b/gpipe (the table executor's stat lanes);
-    interleaved placements are out (no forward executor for the
-    running-stats commit) and zb-h1 is out (the W op's vjp seed has no
-    stats slot) — both fail FAST at construction, not at the first
-    loss_and_grad trace."""
+def test_mesh_bn_zbh1_rejected():
+    """zb-h1 is out for BN (the W op's vjp seed has no stats slot) — it
+    fails FAST at construction, not at the first loss_and_grad trace."""
     module = Sequential([Linear(6), BatchNorm(), Linear(6), BatchNorm()])
-    with pytest.raises(NotImplementedError, match="interleaved|forward"):
-        Pipe(module, chunks=2, mesh=_stage_mesh(2),
-             deferred_batch_norm=True, schedule="interleaved-1f1b")
     with pytest.raises(NotImplementedError, match="zb-h1|split-backward"):
         Pipe(module, chunks=2, mesh=_stage_mesh(2),
              deferred_batch_norm=True, schedule="zb-h1")
+
+
+@pytest.mark.parametrize("checkpoint", ["never", "except_last"])
+def test_mesh_bn_interleaved_matches_emulator(checkpoint):
+    """Deferred BN composes with interleaved (v > 1) placements: training
+    via loss_and_grad (stat lanes through the op tables) AND train-mode
+    forward (stat lanes through the FWD-masked tables) both return the
+    emulator's committed running stats; eval after the commit matches too
+    (reference pipe.py:341-342 composes BN with the pipeline
+    unconditionally)."""
+    module = Sequential([Linear(6), BatchNorm(), Linear(6), BatchNorm()])
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    y = jax.random.normal(jax.random.key(2), (8, 6))
+
+    def loss_fn(out, tgt):
+        return jnp.sum((out - tgt) ** 2, axis=-1)
+
+    emu = Pipe(module, chunks=4, checkpoint="except_last", n_stages=4,
+               deferred_batch_norm=True)
+    params = emu.init(jax.random.key(0), x)
+
+    def emu_loss(ps):
+        out, _ = emu(ps, x, train=True)
+        return jnp.mean(loss_fn(out, y))
+
+    exp_loss = float(emu_loss(params))
+    exp_grads = jax.grad(emu_loss)(params)
+    out_e, exp_new = emu(params, x, train=True)
+
+    pipe = Pipe(module, chunks=4, checkpoint=checkpoint,
+                mesh=_stage_mesh(2), schedule="interleaved-1f1b",
+                deferred_batch_norm=True)
+    packed = pipe.shard_params(params)
+
+    # training: loss, grads AND committed stats match the emulator
+    loss, grads, new_packed = jax.jit(lambda p: pipe.loss_and_grad(
+        p, x, targets=y, loss_fn=loss_fn))(packed)
+    assert float(loss) == pytest.approx(exp_loss, rel=1e-5)
+    # atol 1e-5: micro-batch BN (2 rows/chunk) amplifies f32
+    # accumulation-order noise in the grads; the same comparison under
+    # jax_enable_x64 agrees to 1e-15, so the difference is ordering, not
+    # math
+    for a, b in zip(jax.tree_util.tree_leaves(pipe.unshard_grads(grads)),
+                    jax.tree_util.tree_leaves(exp_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(pipe.unshard_params(new_packed)),
+            jax.tree_util.tree_leaves(exp_new)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    # train-mode forward: (out, new_params), both matching the emulator
+    out_m, new_fwd = pipe(packed, x, train=True)
+    np.testing.assert_allclose(np.asarray(out_m), np.asarray(out_e),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(pipe.unshard_params(new_fwd)),
+            jax.tree_util.tree_leaves(exp_new)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    # eval after the commit: running stats in use, no stats returned
+    ev_m = pipe(new_fwd, x)
+    ev_e, _new = emu(exp_new, x), None
+    np.testing.assert_allclose(np.asarray(ev_m), np.asarray(ev_e),
+                               rtol=1e-5, atol=1e-6)
 
 
 @pytest.mark.parametrize("checkpoint", ["never", "always"])
