@@ -81,10 +81,6 @@ class HeteroScheduledPipeline:
                         self.lane_keys.append((ns, name, src, dst))
         self.lane_pairs = tuple((src, dst)
                                 for _, _, src, dst in self.lane_keys)
-        if self.lane_keys and self.v > 1:
-            raise NotImplementedError(
-                "@skippable models cannot use interleaved schedules (skip "
-                "lanes need v == 1); use schedule='gpipe' or '1f1b'")
         self.partitions = list(partitions)
         self.chunks = chunks
         self.checkpoint = checkpoint
@@ -294,25 +290,35 @@ class HeteroScheduledPipeline:
         the caller commits the running-stats update (mirroring the
         wavefront executor's contract).
 
-        Skip lanes stay v == 1 features (the wavefront executor hosts
-        them — a forward-only pop could arrive after its consumer's FWD
-        cycle on a wrapped ring).
+        ``@skippable`` stashes ride the executor's forward lanes (each a
+        single direct permute into a FIFO park at the destination) — the
+        eval analogue of the training path's portal lanes.
         """
-        if self.lane_keys:
-            raise NotImplementedError(
-                "table-executor forward() runs plain stage bodies; skip "
-                "models use the wavefront executor (v == 1 schedules)")
         low = self._lower_boundaries(params, inputs, what="forward",
                                      check_batch_stats=train)
         pack, plans = low["pack"], low["plans"]
         boundaries, capacities = low["boundaries"], low["capacities"]
         closed, dyn_pos = low["closed"], low["dyn_pos"]
+        spec_tracker = low["spec_tracker"]
         # eval-mode BN reads running stats from params (pure) — only a
         # train-mode forward needs the stat lanes and the commit
         collect_stats = self.has_bn and train
         stat_keys, stat_specs_st, stat_spec = (
-            self._discover_stats(pack, boundaries, low["spec_tracker"])
+            self._discover_stats(pack, boundaries, spec_tracker)
             if collect_stats else ([], [], None))
+        has_lanes = bool(self.lane_keys)
+        lane_specs = tuple(spec_tracker._store[(0, ns, name)]
+                           for ns, name, _, _ in self.lane_keys)
+        lane_pairs = tuple((src, dst)
+                           for _, _, src, dst in self.lane_keys)
+        branch_pops = [
+            [(l, ns, name) for l, (ns, name, src, dst)
+             in enumerate(self.lane_keys) if dst == s_idx]
+            for s_idx in range(self.S)]
+        branch_stashes = [
+            [(l, ns, name) for l, (ns, name, src, dst)
+             in enumerate(self.lane_keys) if src == s_idx]
+            for s_idx in range(self.S)]
 
         def pre_fn(prep, x_mb, ctx):
             del prep
@@ -322,7 +328,7 @@ class HeteroScheduledPipeline:
         def make_branch(s_idx):
             part = self.partitions[s_idx]
 
-            def branch(params_g, carrier, ctx):
+            def branch(params_g, carrier, ctx, pops=None):
                 packed_vals = plans[s_idx].unpack(carrier)
                 vals: List[Any] = []
                 it = iter(packed_vals)
@@ -332,16 +338,19 @@ class HeteroScheduledPipeline:
                     else:
                         vals.append(next(it))
                 p_tree = pack.unpack_stage(params_g, self.row_of(s_idx))
-                if not collect_stats:
+                if not collect_stats and not has_lanes:
                     out = part.apply(p_tree, *vals, ctx=ctx)
                     out_vals = (list(out) if isinstance(out, (tuple, list))
                                 else [out])
                     return plans[s_idx + 1].pack(out_vals, capacities)
-                # run under a local tracker to capture BN stat
-                # accumulations; export zeros for slots this stage does
-                # not own, so every switch branch is structure-uniform
+                # seed the popped lane values, run under a local tracker
+                # (which also captures BN stat accumulations), then export
+                # this stage's stashes/stats — zeros for lanes/slots it
+                # does not own, so every switch branch is structure-uniform
                 from ..extras.skip import SkipTracker
                 local = SkipTracker(self.layout)
+                for l, ns, name in branch_pops[s_idx]:
+                    local.save(0, ns, name, pops[l])
                 with local.scope(0, s_idx):
                     out = part.apply(p_tree, *vals, ctx=ctx)
                 out_vals = (list(out) if isinstance(out, (tuple, list))
@@ -351,30 +360,44 @@ class HeteroScheduledPipeline:
                     return jax.tree_util.tree_map(
                         lambda sp_: jnp.zeros(sp_.shape, sp_.dtype), spec)
 
-                stats = tuple(
-                    tuple((local.accum[k_]
-                           if s2 == s_idx and k_ in local.accum
-                           else zeros_of(spec))
-                          for k_, spec in zip(stat_keys[s2],
-                                              stat_specs_st[s2]))
-                    for s2 in range(self.S))
-                return (plans[s_idx + 1].pack(out_vals, capacities), stats)
+                ret: List[Any] = [plans[s_idx + 1].pack(out_vals,
+                                                        capacities)]
+                if has_lanes:
+                    stashes = [jnp.zeros(sp_.shape, sp_.dtype)
+                               for sp_ in lane_specs]
+                    for l, ns, name in branch_stashes[s_idx]:
+                        stashes[l] = local.load(0, ns, name)
+                    ret.append(tuple(stashes))
+                if collect_stats:
+                    ret.append(tuple(
+                        tuple((local.accum[k_]
+                               if s2 == s_idx and k_ in local.accum
+                               else zeros_of(spec))
+                              for k_, spec in zip(stat_keys[s2],
+                                                  stat_specs_st[s2]))
+                        for s2 in range(self.S)))
+                return ret[0] if len(ret) == 1 else tuple(ret)
 
             return branch
 
         branches = [make_branch(s_idx) for s_idx in range(self.S)]
 
-        def stage_fn(params_g, h, ctx):
+        def stage_fn(params_g, h, ctx, pops=None):
             s = ctx.stage
             if isinstance(s, int):
-                return branches[s](params_g, h, ctx)
+                return branches[s](params_g, h, ctx, pops)
             return jax.lax.switch(
-                s, [lambda pg=params_g, hh=h, c=ctx, b=b: b(pg, hh, c)
+                s, [lambda pg=params_g, hh=h, c=ctx, pp=pops, b=b:
+                    b(pg, hh, c, pp)
                     for b in branches])
 
+        from .scheduled import SkipLanes
         sp = ScheduledPipeline(self.mesh, stage_fn, pre_fn=pre_fn,
                                post_fn=None, checkpoint=self.checkpoint,
-                               schedule=self.schedule, stat_spec=stat_spec)
+                               schedule=self.schedule,
+                               skip_lanes=(SkipLanes(lane_pairs, lane_specs)
+                                           if has_lanes else None),
+                               stat_spec=stat_spec)
         # out_fn unpacks the final-boundary carrier into row-major values
         # INSIDE the device program, so the data axis lands on the rows
         # dim of the collected outputs

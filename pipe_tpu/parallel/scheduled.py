@@ -107,13 +107,20 @@ class SkipLanes:
     copy streams inside the training fence (``pipeline.py:136-138``).
     Mechanism, all static at trace time:
 
-    * forward: the stash value rides a per-lane ring register one hop per
-      cycle (``dst - src`` hops), is captured into a FIFO park at the
-      destination at its host-computed arrival cycle, and is read at
-      FWD(i, dst) — and re-read at BWD(i, dst) under recompute modes,
-      exactly like the activation stash;
-    * backward: BWD(i, dst)'s vjp yields the pop cotangent, which rides a
-      reverse ring to the source and seeds the stash output of
+    * forward: the stash value boards a per-lane register and takes ONE
+      direct ``ppermute`` hop ``src % d -> dst % d`` (the lane has its
+      own permute, so it never relays through intermediate devices —
+      less ICI traffic than a hop-per-cycle ring, and on wrapped
+      interleaved placements a transiting value cannot collide with a
+      fresh stash at its source device, which is what previously kept
+      skips off v > 1). It is captured into a FIFO park at the
+      destination at its host-computed arrival cycle (``FWD(i, src) + 1``)
+      and read at FWD(i, dst) — and re-read at BWD(i, dst) under
+      recompute modes, exactly like the activation stash. Lanes whose
+      endpoints share a device (possible when v > 1) skip the permute:
+      the register IS the transport;
+    * backward: BWD(i, dst)'s vjp yields the pop cotangent, which takes
+      the reverse direct hop to the source and seeds the stash output of
       BWD(i, src)'s vjp — the compiled ``PortalOrange``/``PortalBlue``
       pair;
     * park sizes are the smallest FIFO depths with no live-window
@@ -123,8 +130,7 @@ class SkipLanes:
     ``stage_fn(params_g, h, ctx, pops) -> (h, stashes)`` where ``pops``/
     ``stashes`` are tuples over lanes — a stage reads only the lanes it
     pops and must return zeros (of the lane spec) for lanes it does not
-    stash. Requires ``v == 1`` (skips + interleaved placements stay
-    unsupported) and a non-split-backward schedule.
+    stash. Requires a non-split-backward schedule.
 
     ``pairs[l] = (src, dst)`` virtual stage indices (``src < dst``);
     ``specs[l]`` is the lane's value pytree of ShapeDtypeStructs.
@@ -344,11 +350,6 @@ class ScheduledPipeline:
         if self.skip_lanes is not None and not self.skip_lanes.pairs:
             self.skip_lanes = None          # empty lanes = no skips
         if self.skip_lanes is not None:
-            if self.schedule.v != 1:
-                raise NotImplementedError(
-                    "skip lanes require v == 1 (interleaved placements "
-                    "wrap the device ring, so a transiting skip value can "
-                    "collide with a fresh stash at its source device)")
             if getattr(self.schedule, "splits_backward", False):
                 raise NotImplementedError(
                     "skip lanes do not compose with split-backward "
@@ -437,7 +438,9 @@ class ScheduledPipeline:
                 "virtual_stages_per_device": v}
         if self.skip_lanes is not None:
             tables = self.schedule.op_tables(m, d)
-            _, _, Kf, Kg = self._skip_tables(m, tables[0], tables[1])
+            grp = (tables[2] if len(tables) > 2
+                   else np.zeros_like(tables[0]))
+            _, _, Kf, Kg = self._skip_tables(m, tables[0], tables[1], grp)
             plan["skip_lanes"] = len(self.skip_lanes.pairs)
             plan["skip_fwd_park_slots"] = sum(Kf)
             plan["skip_bwd_park_slots"] = sum(Kg)
@@ -527,13 +530,11 @@ class ScheduledPipeline:
         accumulate over the FWD ops (each micro-batch runs exactly once per
         stage here — no recompute, no double-count) and are psum'd over the
         stage/data axes, giving deferred BatchNorm a train-mode forward on
-        interleaved (v > 1) placements. Skip lanes stay v == 1 features
-        (the wavefront executor hosts them).
+        interleaved (v > 1) placements. With ``skip_lanes`` the stage
+        contract gains pops/stashes (see :class:`SkipLanes`); stashes take
+        their direct lane hop into the FIFO park and are popped at
+        FWD(i, dst) — no reverse lanes (no backward here).
         """
-        if self.skip_lanes is not None:
-            raise NotImplementedError(
-                "forward() runs plain stage bodies; skip lanes ride "
-                "the wavefront executor (v == 1)")
         if self.split_stage is not None:
             raise NotImplementedError(
                 "forward() does not use the split-backward protocol")
@@ -611,8 +612,18 @@ class ScheduledPipeline:
         # non-FWD op to IDLE; the FWD entries' relative timing already
         # satisfies the ring transport constraints the full table verified
         op_np = np.where(op_np == FWD, FWD, IDLE)
-        xs = (jnp.asarray(op_np), jnp.asarray(mb_np), jnp.asarray(grp_np),
-              jnp.asarray(rxslot_np))
+        lanes = self.skip_lanes
+        if lanes is not None:
+            capf_np, _, Kf, _ = self._skip_tables(m, op_np, mb_np, grp_np,
+                                                  fwd_only=True)
+            lane_fwd_perms, _ = self._lane_perms()
+            xs = (jnp.asarray(op_np), jnp.asarray(mb_np),
+                  jnp.asarray(grp_np), jnp.asarray(rxslot_np),
+                  jnp.asarray(capf_np))
+        else:
+            Kf = ()
+            xs = (jnp.asarray(op_np), jnp.asarray(mb_np),
+                  jnp.asarray(grp_np), jnp.asarray(rxslot_np))
 
         def zeros_of(spec):
             return jnp.zeros(spec.shape, spec.dtype)
@@ -627,6 +638,15 @@ class ScheduledPipeline:
         # one output slot per micro-batch + a sentinel for non-last stages
         outbuf = jax.tree_util.tree_map(
             lambda s_: slots_of(s_, m), out_sds)
+        if lanes is not None:
+            sk_ring0 = tuple(jax.tree_util.tree_map(zeros_of, sp_)
+                             for sp_ in lanes.specs)
+            sk_park0 = tuple(
+                jax.tree_util.tree_map(
+                    lambda s_, k=k: slots_of(s_, k), sp_)
+                for sp_, k in zip(lanes.specs, Kf))
+        else:
+            sk_ring0 = sk_park0 = ()
 
         if v == 1:
             fwd_perm = [(k, k + 1) for k in range(d - 1)]
@@ -634,8 +654,11 @@ class ScheduledPipeline:
             fwd_perm = [(q, (q + 1) % d) for q in range(d)]
 
         def cycle(carry, row):
-            h_ring, stash, outbuf, stats_acc = carry
-            op_r, mb_r, grp_r, rx_r = row
+            h_ring, stash, outbuf, stats_acc, sk_ring, sk_park = carry
+            if lanes is not None:
+                op_r, mb_r, grp_r, rx_r, capf_r = row
+            else:
+                op_r, mb_r, grp_r, rx_r = row
             opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
             i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
             g = jax.lax.dynamic_index_in_dim(grp_r, j, 0, keepdims=False)
@@ -645,6 +668,19 @@ class ScheduledPipeline:
             stash = jax.tree_util.tree_map(
                 lambda st, hr: jax.lax.dynamic_update_index_in_dim(
                     st, hr, rslot, 0), stash, h_ring)
+            if lanes is not None:
+                # capture arriving lane values into their FIFO parks at
+                # the host-planned slots (sentinel writes are no-ops into
+                # the spare slot)
+                fslots = [jax.lax.dynamic_index_in_dim(
+                    capf_r[l], j, 0, keepdims=False)
+                    for l in range(len(lanes.pairs))]
+                sk_park = tuple(
+                    jax.tree_util.tree_map(
+                        lambda st, reg, sl=sl:
+                        jax.lax.dynamic_update_index_in_dim(st, reg, sl, 0),
+                        pk, rg)
+                    for pk, rg, sl in zip(sk_park, sk_ring, fslots))
             kis = jax.random.fold_in(jax.random.fold_in(key, i), s)
             x_mb = _index(x, i)
             params_g = (_index(params_dev, 0) if v == 1
@@ -652,6 +688,12 @@ class ScheduledPipeline:
             h_in = jax.tree_util.tree_map(
                 lambda st: jax.lax.dynamic_index_in_dim(
                     st, g * Sg + i % Sg, 0, keepdims=False), stash)
+            pops = (tuple(
+                jax.tree_util.tree_map(
+                    lambda st, k=k: jax.lax.dynamic_index_in_dim(
+                        st, i % k, 0, keepdims=False), pk)
+                for pk, k in zip(sk_park, Kf))
+                if lanes is not None else None)
 
             def fwd_branch():
                 h0 = jax.lax.cond(
@@ -661,34 +703,52 @@ class ScheduledPipeline:
                         StageCtx(key=jax.random.fold_in(kis, 0),
                                  train=train, data_axis=self.bn_axis)),
                     lambda: h_in)
-                out = self.stage_fn(
-                    params_g, h0,
-                    StageCtx(key=jax.random.fold_in(kis, 1), train=train,
-                             stage=s, data_axis=self.bn_axis))
-                h1, _, st = self._split_out(out)
+                ctx = StageCtx(key=jax.random.fold_in(kis, 1), train=train,
+                               stage=s, data_axis=self.bn_axis)
+                out = (self.stage_fn(params_g, h0, ctx, pops)
+                       if lanes is not None
+                       else self.stage_fn(params_g, h0, ctx))
+                h1, stashes, st = self._split_out(out)
                 stats2 = (jax.tree_util.tree_map(jnp.add, stats_acc, st)
                           if self.stat_spec is not None else stats_acc)
+                if lanes is not None:
+                    # fresh stashes board their lanes at the source stage
+                    tx_sk = tuple(
+                        jax.tree_util.tree_map(
+                            lambda sv, reg, src=src: jnp.where(
+                                jnp.asarray(s == src), sv, reg), svv, rg)
+                        for (src, _), svv, rg in zip(lanes.pairs, stashes,
+                                                     sk_ring))
+                else:
+                    tx_sk = sk_ring
                 widx = jnp.where(s == S - 1, i, m)   # sentinel elsewhere
                 new_out = jax.tree_util.tree_map(
                     lambda buf, l: jax.lax.dynamic_update_index_in_dim(
                         buf, l, widx, 0), outbuf, out_fn(h1))
-                return new_out, h1, stats2
+                return new_out, h1, stats2, tx_sk
 
             def idle_branch():
-                return outbuf, h_ring, stats_acc
+                return outbuf, h_ring, stats_acc, sk_ring
 
-            outbuf2, tx_h, stats2 = jax.lax.switch(
+            outbuf2, tx_h, stats2, tx_sk = jax.lax.switch(
                 jnp.clip(opj, 0, 1), [idle_branch, fwd_branch])
             if d > 1:
                 tx_h = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm),
                     tx_h)
-            return (tx_h, stash, outbuf2, stats2), None
+                if lanes is not None:
+                    tx_sk = tuple(
+                        (jax.tree_util.tree_map(
+                            lambda a, pf=pf: jax.lax.ppermute(
+                                a, STAGE_AXIS, pf), lv)
+                         if pf is not None else lv)
+                        for lv, pf in zip(tx_sk, lane_fwd_perms))
+            return (tx_h, stash, outbuf2, stats2, tx_sk, sk_park), None
 
         stats0 = (self._zero_seed_like(self.stat_spec)
                   if self.stat_spec is not None else ())
-        (_, _, outbuf, stats_out), _ = jax.lax.scan(
-            cycle, (h_ring, stash, outbuf, stats0), xs)
+        (_, _, outbuf, stats_out, _, _), _ = jax.lax.scan(
+            cycle, (h_ring, stash, outbuf, stats0, sk_ring0, sk_park0), xs)
         outs = jax.tree_util.tree_map(lambda b: b[None, :m], outbuf)
         if self.stat_spec is None:
             return outs
@@ -893,35 +953,42 @@ class ScheduledPipeline:
                 rxslot_np[t, p] = g2 * Sg + (mb_np[t - 1, q] % Sg)
         return (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel
 
-    def _skip_tables(self, m, op_np, mb_np):
-        """Host-side skip-lane plan from the op tables (v == 1 only).
+    def _skip_tables(self, m, op_np, mb_np, grp_np, *, fwd_only=False):
+        """Host-side skip-lane plan from the op tables.
 
-        Per lane ``l = (src, dst)``:
+        Per lane ``l = (src, dst)`` (VIRTUAL stage indices; the physical
+        endpoints are ``src % d`` / ``dst % d``):
 
         * ``capf[t, l, p]``: FIFO slot at device ``p`` parking the value
-          arriving on the forward lane ring at cycle ``t`` (sentinel
+          arriving on the forward lane hop at cycle ``t`` (sentinel
           ``Kf[l]`` when nothing real arrives). Arrival is deterministic:
-          the stash emitted at FWD(i, src) travels one hop per cycle, so
-          it reaches ``dst`` at cycle ``fwd(i, src) + (dst - src)``.
-        * ``capg[t, l, p]``: same for the pop cotangent riding the reverse
-          ring from BWD(i, dst) to ``src``.
+          the stash emitted at FWD(i, src) takes the lane's single direct
+          permute, reaching ``dst % d`` at cycle ``fwd(i, src) + 1``.
+        * ``capg[t, l, p]``: same for the pop cotangent taking the reverse
+          hop from BWD(i, dst) to ``src % d``.
         * ``Kf[l]`` / ``Kg[l]``: smallest FIFO depths such that slot
           ``i % K`` never collides across overlapping live windows. The
           forward live window extends to BWD(i, dst) under recompute
           modes (the re-run needs the pops again), mirroring the
           activation stash.
+
+        ``fwd_only=True`` plans for the FWD-masked eval tables: windows
+        end at FWD(i, dst) (no reread — eval has no backward) and the
+        reverse plan is skipped (``capg=None, Kg=()``).
         """
         d = self.n_stages
+        S = self.n_virtual
         T = op_np.shape[0]
         pairs = self.skip_lanes.pairs
-        fwd_c = np.full((m, d), -1, np.int64)
-        bwd_c = np.full((m, d), -1, np.int64)
+        fwd_c = np.full((m, S), -1, np.int64)
+        bwd_c = np.full((m, S), -1, np.int64)
         for t in range(T):
             for p in range(d):
+                s = grp_np[t, p] * d + p
                 if op_np[t, p] == FWD:
-                    fwd_c[mb_np[t, p], p] = t
+                    fwd_c[mb_np[t, p], s] = t
                 elif op_np[t, p] == BWD:
-                    bwd_c[mb_np[t, p], p] = t
+                    bwd_c[mb_np[t, p], s] = t
 
         def fifo_depth(windows):
             for K in range(1, m + 1):
@@ -935,41 +1002,61 @@ class ScheduledPipeline:
         Kf, Kg = [], []
         f_events, g_events = [], []   # (t, lane, device, slot)
         for lidx, (src, dst) in enumerate(pairs):
-            hops = dst - src
             wf, wg = [], []
             for i in range(m):
-                arr_f = fwd_c[i, src] + hops
+                arr_f = fwd_c[i, src] + 1
                 use_f = fwd_c[i, dst]
                 assert 0 <= fwd_c[i, src] and arr_f <= use_f, \
                     (f"skip lane ({src},{dst}): stash for micro-batch {i} "
                      f"arrives at cycle {arr_f} after its FWD {use_f}")
-                reread = (self.remat_policy is None
+                reread = (not fwd_only
+                          and self.remat_policy is None
                           and (self.checkpoint == "always"
                                or (self.checkpoint == "except_last"
                                    and i != m - 1)))
                 wf.append((arr_f, bwd_c[i, dst] if reread else use_f))
-                arr_g = bwd_c[i, dst] + hops
+                if fwd_only:
+                    continue
+                arr_g = bwd_c[i, dst] + 1
                 use_g = bwd_c[i, src]
                 assert 0 <= bwd_c[i, dst] and arr_g <= use_g, \
                     (f"skip lane ({src},{dst}): cotangent for micro-batch "
                      f"{i} arrives at cycle {arr_g} after its BWD {use_g}")
                 wg.append((arr_g, use_g))
-            kf, kg = fifo_depth(wf), fifo_depth(wg)
+            kf = fifo_depth(wf)
             Kf.append(kf)
-            Kg.append(kg)
             for i in range(m):
-                f_events.append((wf[i][0], lidx, dst, i % kf))
-                g_events.append((wg[i][0], lidx, src, i % kg))
+                f_events.append((wf[i][0], lidx, dst % d, i % kf))
+            if not fwd_only:
+                kg = fifo_depth(wg)
+                Kg.append(kg)
+                for i in range(m):
+                    g_events.append((wg[i][0], lidx, src % d, i % kg))
         capf = np.zeros((T, len(pairs), d), np.int32)
-        capg = np.zeros((T, len(pairs), d), np.int32)
         for lidx in range(len(pairs)):
             capf[:, lidx, :] = Kf[lidx]      # sentinel
-            capg[:, lidx, :] = Kg[lidx]
         for (t, lidx, p, slot) in f_events:
             capf[t, lidx, p] = slot
+        if fwd_only:
+            return capf, None, Kf, ()
+        capg = np.zeros((T, len(pairs), d), np.int32)
+        for lidx in range(len(pairs)):
+            capg[:, lidx, :] = Kg[lidx]
         for (t, lidx, p, slot) in g_events:
             capg[t, lidx, p] = slot
         return capf, capg, Kf, Kg
+
+    def _lane_perms(self):
+        """Per-lane direct permute endpoints: ``(src % d, dst % d)`` per
+        lane, ``None`` when both virtual stages share a device (the lane
+        register itself is the transport — no collective needed)."""
+        d = self.n_stages
+        fwd, bwd = [], []
+        for (src, dst) in self.skip_lanes.pairs:
+            ps, pd = src % d, dst % d
+            fwd.append(None if ps == pd else [(ps, pd)])
+            bwd.append(None if ps == pd else [(pd, ps)])
+        return fwd, bwd
 
     def _use_static(self, m: int) -> bool:
         if self.static_unroll is not None:
@@ -1208,7 +1295,9 @@ class ScheduledPipeline:
         (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel = \
             self._host_tables(m)
         if lanes is not None:
-            capf_np, capg_np, Kf, Kg = self._skip_tables(m, op_np, mb_np)
+            capf_np, capg_np, Kf, Kg = self._skip_tables(m, op_np, mb_np,
+                                                         grp_np)
+            lane_fwd_perms, lane_bwd_perms = self._lane_perms()
             xs = (jnp.asarray(op_np), jnp.asarray(mb_np),
                   jnp.asarray(grp_np), jnp.asarray(rxslot_np),
                   jnp.asarray(capf_np), jnp.asarray(capg_np))
@@ -1645,12 +1734,21 @@ class ScheduledPipeline:
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm), tx_h)
                 tx_g = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm), tx_g)
-                tx_sk = jax.tree_util.tree_map(
-                    lambda a: jax.lax.ppermute(a, STAGE_AXIS, fwd_perm),
-                    tx_sk)
-                tx_gk = jax.tree_util.tree_map(
-                    lambda a: jax.lax.ppermute(a, STAGE_AXIS, bwd_perm),
-                    tx_gk)
+                if lanes is not None:
+                    # each lane takes its OWN direct hop (src%d -> dst%d);
+                    # same-device lanes keep the register as transport
+                    tx_sk = tuple(
+                        (jax.tree_util.tree_map(
+                            lambda a, pf=pf: jax.lax.ppermute(
+                                a, STAGE_AXIS, pf), lv)
+                         if pf is not None else lv)
+                        for lv, pf in zip(tx_sk, lane_fwd_perms))
+                    tx_gk = tuple(
+                        (jax.tree_util.tree_map(
+                            lambda a, pb=pb: jax.lax.ppermute(
+                                a, STAGE_AXIS, pb), lv)
+                         if pb is not None else lv)
+                        for lv, pb in zip(tx_gk, lane_bwd_perms))
             return (tx_h, tx_g, stash, h_last2, wstash2, taps2, res_store2,
                     pres_store2, tx_sk, tx_gk, sk_park, gk_park, stats2,
                     g_sp2, g_pre2, g_post2, loss2), None

@@ -170,14 +170,6 @@ class Pipe:
         self._executor = None
         self._train_executor = None
         if mesh is not None:
-            if sched_obj.v > 1 and self.skip_layout.num_skips > 0:
-                # skip lanes need v == 1: interleaved placements wrap the
-                # device ring, so a transiting skip value can collide with
-                # a fresh stash at its source device
-                raise NotImplementedError(
-                    "@skippable models cannot use interleaved schedules on "
-                    "a mesh (skip lanes need v == 1); use "
-                    "schedule='gpipe' or '1f1b'")
             if sched_obj.v == 1:
                 from .parallel.hetero import HeteroSpmdPipeline
                 self._executor = HeteroSpmdPipeline(
