@@ -222,6 +222,178 @@ class PipelinedGenerator:
             [init_toks[:, :, None], out[:, :, :max_new - 1]], axis=2)
         return jax.lax.psum(jnp.where(s == n - 1, gen_toks, 0), STAGE_AXIS)
 
+    # --- beam search over the ring -----------------------------------
+
+    def _device_program_beam(self, stage_params, pre_params, post_params,
+                             prompt_g, *, p, rpg):
+        """Ring-pipelined beam search (deterministic, sum-of-log-probs —
+        the single-device ``Generator._generate_beam`` contract over
+        stage-sharded weights).
+
+        The pipelined twist is the cache reorder: after stage ``n-1``'s
+        top-k for group ``g`` at decode index ``t``, the surviving-beam
+        parent indices must reach EVERY stage's cache slab before that
+        group's step ``t+1`` — so the parent vector rides the ring with
+        the activation carrier (one extra [rpg*k] int32 per hop), and
+        each stage gathers its own slab rows by the arriving parents
+        right before decoding. The wrap edge carries (token, parent)
+        from stage n-1 to stage 0, which needs them exactly one cycle
+        later — the same timing argument as the greedy path's token.
+
+        Beams flatten row-major (``flat = row*k + beam``, matching the
+        single-device cache tiling); prefill runs untiled (rpg rows) and
+        the slabs tile ``rpg -> rpg*k`` once, after the prefill scan.
+        """
+        m, gen, n = self.model, self.gen_cfg, self.n_stages
+        k = gen.num_beams
+        max_new = gen.max_new_tokens
+        s = jax.lax.axis_index(STAGE_AXIS)
+        cd = m.cfg.compute_dtype
+        nh, hd = m.block.attn.nhead, m.block.attn.head_dim
+        cache_len = p + max_new + p
+        sac = p + max_new
+
+        def local_slice(a):
+            if isinstance(a, QuantLeaf):
+                return QuantLeaf(q=a.q[0], scale=a.scale[0])
+            return a[0].astype(cd)
+
+        blocks = [jax.tree_util.tree_map(
+                      local_slice, bp,
+                      is_leaf=lambda x: isinstance(x, QuantLeaf))
+                  for bp in stage_params]
+        block_stack = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *blocks)
+        lps = len(blocks)
+        caches = {"k": jnp.zeros((lps, n, rpg, cache_len, nh, hd), cd),
+                  "v": jnp.zeros((lps, n, rpg, cache_len, nh, hd), cd)}
+
+        # ---- prefill: untiled (rpg rows), identical to the greedy path
+        # except stage n-1 seeds the beam state instead of sampling
+        def pre_cycle(carry, c):
+            h_carry, caches, tok0, sc0 = carry
+            raw = c - s
+            valid = (raw >= 0) & (raw < n)
+            grp = jnp.clip(raw, 0, n - 1)
+            pos = jnp.where(valid, 0, sac)
+            h_embed = m.embed_at(pre_params,
+                                 jnp.take(prompt_g, grp, axis=0), 0)
+            h_in = jnp.where(s == 0, h_embed, h_carry)
+            h_out, caches = self._run_blocks(block_stack, h_in, caches,
+                                             grp, pos)
+            logits = self._head(post_params, h_out[:, -1:, :])[:, 0, :]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            sc_g, tok_g = jax.lax.top_k(logp, k)          # [rpg, k]
+            emit = (s == n - 1) & valid
+            tok0 = jax.lax.dynamic_update_slice(
+                tok0, jnp.where(emit, tok_g.astype(jnp.int32),
+                                jnp.take(tok0, grp, axis=0))[None],
+                (grp, 0, 0))
+            sc0 = jax.lax.dynamic_update_slice(
+                sc0, jnp.where(emit, sc_g,
+                               jnp.take(sc0, grp, axis=0))[None],
+                (grp, 0, 0))
+            return (self._ring(h_out), caches, tok0, sc0), None
+
+        h0 = jnp.zeros((rpg, p, m.cfg.d_model), cd)
+        tok0 = jnp.zeros((n, rpg, k), jnp.int32)
+        sc0 = jnp.zeros((n, rpg, k), jnp.float32)
+        (_, caches, tok0, sc0), _ = jax.lax.scan(
+            pre_cycle, (h0, caches, tok0, sc0), jnp.arange(2 * n - 1))
+        tok0 = jax.lax.psum(jnp.where(s == n - 1, tok0, 0), STAGE_AXIS)
+        sc0 = jax.lax.psum(jnp.where(s == n - 1, sc0, 0.0), STAGE_AXIS)
+
+        # tile slabs rpg -> rpg*k (flat = row*k + beam)
+        tile = jnp.arange(rpg * k) // k
+        caches = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, tile, axis=2), caches)
+
+        # ---- decode: beams ride the rows; parents ride the ring
+        ident = jnp.arange(rpg * k, dtype=jnp.int32) % k   # [rpg*k] beams
+        out0 = jnp.zeros((n, rpg, k, max_new), jnp.int32)
+        out0 = out0.at[:, :, :, 0].set(tok0)
+        scores0 = sc0                                       # [n, rpg, k]
+
+        def dec_cycle(carry, c):
+            (h_carry, par_h, tok_ring, par_ring, caches, scores,
+             out) = carry
+            raw = c - s
+            valid = (raw >= 0) & (raw < n * (max_new - 1))
+            grp = jnp.mod(raw, n)
+            t = jnp.where(valid, raw // n, 0)
+            pos = jnp.where(valid, p + t, sac)
+            first = (c < n)      # step 0: beams seeded from the prefill
+            tok_use = jnp.where(
+                first, jnp.take(tok0, grp, axis=0).reshape(rpg * k),
+                tok_ring)
+            # parent of the beams being decoded this step (identity at
+            # step 0 and on invalid cycles — never shuffle a slab whose
+            # turn it is not)
+            par_in = jnp.where(s == 0, par_ring, par_h)
+            parent = jnp.where(first | ~valid, ident, par_in)
+            flat_parent = (jnp.arange(rpg * k, dtype=jnp.int32) // k) * k \
+                + parent
+            # persistent beam reorder of this group's slab
+            def slab_gather(a):
+                grp_slab = jax.lax.dynamic_slice(
+                    a, (0, grp) + (0,) * (a.ndim - 2),
+                    (lps, 1) + a.shape[2:])
+                reordered = jnp.take(grp_slab, flat_parent, axis=2)
+                return jax.lax.dynamic_update_slice(
+                    a, reordered, (0, grp) + (0,) * (a.ndim - 2))
+            caches = jax.tree_util.tree_map(slab_gather, caches)
+
+            h_embed = m.embed_at(pre_params, tok_use[:, None], pos)
+            h_in = jnp.where(s == 0, h_embed, h_carry)
+            h_out, caches = self._run_blocks(block_stack, h_in, caches,
+                                             grp, pos)
+            logits = self._head(post_params, h_out)[:, 0, :]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            V = logp.shape[-1]
+            sc_g = jax.lax.dynamic_slice(scores, (grp, 0, 0),
+                                         (1, rpg, k))[0]
+            total = sc_g[:, :, None] + logp.reshape(rpg, k, V)
+            sc_new, idx = jax.lax.top_k(total.reshape(rpg, k * V), k)
+            par_new = (idx // V).astype(jnp.int32)          # [rpg, k]
+            tok_new = (idx % V).astype(jnp.int32)
+            emit = (s == n - 1) & valid
+            scores = jax.lax.dynamic_update_slice(
+                scores, jnp.where(emit, sc_new, sc_g)[None], (grp, 0, 0))
+            out_g = jax.lax.dynamic_slice(
+                out, (grp, 0, 0, 0), (1, rpg, k, max_new))[0]
+            out_re = jnp.take_along_axis(out_g, par_new[:, :, None],
+                                         axis=1)
+            t_write = jnp.where(emit, t + 1, max_new)
+            # out-of-range start clamps, so route the garbage write to a
+            # full-copy no-op instead: keep out_g when not emitting
+            out_wr = jax.lax.dynamic_update_slice(
+                out_re, tok_new[:, :, None], (0, 0, t_write))
+            out = jax.lax.dynamic_update_slice(
+                out, jnp.where(emit, out_wr, out_g)[None], (grp, 0, 0, 0))
+            return (self._ring(h_out), self._ring(parent),
+                    self._ring(tok_new.reshape(rpg * k)),
+                    self._ring(par_new.reshape(rpg * k)),
+                    caches, scores, out), None
+
+        h0 = jnp.zeros((rpg * k, 1, m.cfg.d_model), cd)
+        cycles = n * (max_new - 1) + n - 1
+        carry0 = (h0, ident, jnp.zeros((rpg * k,), jnp.int32), ident,
+                  caches, scores0, out0)
+        if max_new > 1:
+            (_, _, _, _, _, scores, out), _ = jax.lax.scan(
+                dec_cycle, carry0, jnp.arange(cycles))
+        else:
+            scores, out = scores0, out0
+        best = jnp.argmax(scores, axis=2)                   # [n, rpg]
+        toks = jnp.take_along_axis(
+            out, best[:, :, None, None], axis=2)[:, :, 0, :]
+        best_sc = jnp.take_along_axis(scores, best[:, :, None],
+                                      axis=2)[:, :, 0]
+        toks = jax.lax.psum(jnp.where(s == n - 1, toks, 0), STAGE_AXIS)
+        best_sc = jax.lax.psum(jnp.where(s == n - 1, best_sc, 0.0),
+                               STAGE_AXIS)
+        return toks, best_sc
+
     # --- public ---
 
     def generate(self, stage_params, pre_params, post_params,
@@ -229,7 +401,11 @@ class PipelinedGenerator:
                  key: Optional[jax.Array] = None) -> jax.Array:
         """Sample ``[b, max_new_tokens]`` continuations of ``prompt
         [b, prompt_len]``; rows ``[g*rpg:(g+1)*rpg]`` form ring group
-        ``g``."""
+        ``g``. ``num_beams > 1`` runs ring-pipelined beam search
+        (deterministic; ``key`` unused)."""
+        if self.gen_cfg.num_beams > 1:
+            return self.generate_with_scores(stage_params, pre_params,
+                                             post_params, prompt)[0]
         b, p = prompt.shape
         n = self.n_stages
         if b % n:
@@ -259,3 +435,39 @@ class PipelinedGenerator:
             self._programs[cache_key] = run
         out = run(stage_params, pre_params, post_params, prompt_g, key)
         return out.reshape(b, self.gen_cfg.max_new_tokens)
+
+    def generate_with_scores(self, stage_params, pre_params, post_params,
+                             prompt: jax.Array):
+        """Ring-pipelined beam search returning ``(tokens [b, max_new],
+        scores [b])`` — the best beam per row, matching the single-device
+        ``Generator.generate_with_scores`` contract."""
+        if self.gen_cfg.num_beams < 2:
+            raise ValueError("generate_with_scores requires num_beams >= 2")
+        b, p = prompt.shape
+        n = self.n_stages
+        if b % n:
+            raise ValueError(f"batch {b} must divide into {n} ring groups")
+        check_positions(self.model, p, self.gen_cfg.max_new_tokens)
+        rpg = b // n
+        prompt_g = jnp.asarray(prompt, jnp.int32).reshape(n, rpg, p)
+
+        cache_key = ("beam", p, rpg,
+                     jax.tree_util.tree_structure((stage_params, pre_params,
+                                                   post_params)))
+        run = self._programs.get(cache_key)
+        if run is None:
+            in_specs = (
+                jax.tree_util.tree_map(lambda _: P(STAGE_AXIS),
+                                       stage_params),
+                jax.tree_util.tree_map(lambda _: P(), pre_params),
+                jax.tree_util.tree_map(lambda _: P(), post_params),
+                P(),
+            )
+            run = jax.jit(jax.shard_map(
+                functools.partial(self._device_program_beam, p=p, rpg=rpg),
+                mesh=self.mesh, in_specs=in_specs, out_specs=(P(), P()),
+                check_vma=False))
+            self._programs[cache_key] = run
+        toks, scores = run(stage_params, pre_params, post_params, prompt_g)
+        return (toks.reshape(b, self.gen_cfg.max_new_tokens),
+                scores.reshape(b))

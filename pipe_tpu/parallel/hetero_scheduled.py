@@ -149,6 +149,73 @@ class HeteroScheduledPipeline:
         inert-policy warning at a user who configured it for forward)."""
         return self.remat_policy if self.checkpoint != "never" else None
 
+    def _branches_uniform(self, low, *, train: bool) -> bool:
+        """True when every per-stage switch branch computes the SAME
+        function — the uniform-partition fast path.
+
+        The per-cycle ``lax.switch`` over stage branches is the price of
+        ARBITRARY partitions (XLA's conditional copy-insertion around the
+        scan carry was measured at ~2x step time on the cpu8 probe, and
+        123 ms/step on-chip for the d=1 analogue). But the reference's only
+        entry point is ``Pipe`` itself, and the most common model is a
+        uniform stack of identical blocks — for those every branch is the
+        same computation over a different (identically-laid-out) param row,
+        so ONE shared branch replaces the switch and the emitted program
+        matches the raw homogeneous :class:`ScheduledPipeline` exactly.
+
+        Uniformity = (a) no skip lanes / deferred-BN bookkeeping (their
+        branches differ per stage), (b) no statics closed into boundary 0,
+        (c) all boundary specs identical (incl. input and output — ring
+        invariance), (d) all packed param rows identical in layout, and
+        (e) every partition's ``apply`` traces to an identical jaxpr with
+        equal closure constants. Checked at trace time; any failure falls
+        back to the switch, so arbitrary partitions are never wrong — just
+        not specialized.
+        """
+        if self.S == 1 or self.lane_keys or self.has_bn:
+            return False
+        if low["closed"]:
+            return False
+        bspecs = [[(tuple(jnp.shape(sp)), str(jnp.result_type(sp)))
+                   for sp in b] for b in low["boundaries"]]
+        if any(b != bspecs[0] for b in bspecs[1:]):
+            return False
+        pack = low["pack"]
+        if any(td != pack.treedefs[0] for td in pack.treedefs[1:]):
+            return False
+        row0 = [(tuple(s.shape), str(s.dtype)) for s in pack.plans[0].specs]
+        for plan in pack.plans[1:]:
+            if [(tuple(s.shape), str(s.dtype)) for s in plan.specs] != row0:
+                return False
+        key_spec = jax.eval_shape(lambda: jax.random.key(0))
+        in_specs = [jax.ShapeDtypeStruct(jnp.shape(sp),
+                                         jnp.result_type(sp))
+                    for sp in low["boundaries"][0]]
+        ref_jaxpr = ref_consts = None
+        try:
+            for s_idx, part in enumerate(self.partitions):
+                def fn(p, key, *vals, _part=part):
+                    ctx = StageCtx(key=key, train=train, stage=0)
+                    return _part.apply(p, *vals, ctx=ctx)
+                closed = jax.make_jaxpr(fn)(
+                    pack.abstract_tree(self.row_of(s_idx)), key_spec,
+                    *in_specs)
+                if ref_jaxpr is None:
+                    ref_jaxpr, ref_consts = str(closed.jaxpr), closed.consts
+                    continue
+                if str(closed.jaxpr) != ref_jaxpr:
+                    return False
+                if len(closed.consts) != len(ref_consts):
+                    return False
+                for a, b in zip(closed.consts, ref_consts):
+                    if (jnp.shape(a) != jnp.shape(b)
+                            or jnp.result_type(a) != jnp.result_type(b)
+                            or not bool(jnp.all(jnp.equal(a, b)))):
+                        return False
+        except Exception:
+            return False        # tracing hiccup: keep the general switch
+        return True
+
     def _discover_stats(self, pack, boundaries, spec_tracker):
         """Train-mode spec pass per partition discovering each virtual
         stage's deferred-BN accumulator keys/shapes (shared by
@@ -382,14 +449,21 @@ class HeteroScheduledPipeline:
 
         branches = [make_branch(s_idx) for s_idx in range(self.S)]
 
-        def stage_fn(params_g, h, ctx, pops=None):
-            s = ctx.stage
-            if isinstance(s, int):
-                return branches[s](params_g, h, ctx, pops)
-            return jax.lax.switch(
-                s, [lambda pg=params_g, hh=h, c=ctx, pp=pops, b=b:
-                    b(pg, hh, c, pp)
-                    for b in branches])
+        self.uniform_fastpath = self._branches_uniform(low, train=train)
+        if self.uniform_fastpath:
+            def stage_fn(params_g, h, ctx, pops=None):
+                # uniform partitions: one shared branch, no lax.switch —
+                # the raw homogeneous executor's program
+                return branches[0](params_g, h, ctx, pops)
+        else:
+            def stage_fn(params_g, h, ctx, pops=None):
+                s = ctx.stage
+                if isinstance(s, int):
+                    return branches[s](params_g, h, ctx, pops)
+                return jax.lax.switch(
+                    s, [lambda pg=params_g, hh=h, c=ctx, pp=pops, b=b:
+                        b(pg, hh, c, pp)
+                        for b in branches])
 
         from .scheduled import SkipLanes
         sp = ScheduledPipeline(self.mesh, stage_fn, pre_fn=pre_fn,
@@ -550,14 +624,21 @@ class HeteroScheduledPipeline:
 
         branches = [make_branch(s_idx) for s_idx in range(self.S)]
 
-        def stage_fn(params_g, h, ctx, pops=None):
-            s = ctx.stage
-            if isinstance(s, int):          # d == 1 static specialization
-                return branches[s](params_g, h, ctx, pops)
-            return jax.lax.switch(
-                s, [lambda pg=params_g, hh=h, c=ctx, pp=pops, b=b:
-                    b(pg, hh, c, pp)
-                    for b in branches])
+        self.uniform_fastpath = self._branches_uniform(low, train=True)
+        if self.uniform_fastpath:
+            def stage_fn(params_g, h, ctx, pops=None):
+                # uniform partitions: one shared branch, no lax.switch —
+                # the raw homogeneous executor's program
+                return branches[0](params_g, h, ctx, pops)
+        else:
+            def stage_fn(params_g, h, ctx, pops=None):
+                s = ctx.stage
+                if isinstance(s, int):      # d == 1 static specialization
+                    return branches[s](params_g, h, ctx, pops)
+                return jax.lax.switch(
+                    s, [lambda pg=params_g, hh=h, c=ctx, pp=pops, b=b:
+                        b(pg, hh, c, pp)
+                        for b in branches])
 
         def post_fn(postp, h, x_mb, ctx):
             del postp

@@ -1006,9 +1006,14 @@ class ScheduledPipeline:
             for i in range(m):
                 arr_f = fwd_c[i, src] + 1
                 use_f = fwd_c[i, dst]
-                assert 0 <= fwd_c[i, src] and arr_f <= use_f, \
-                    (f"skip lane ({src},{dst}): stash for micro-batch {i} "
-                     f"arrives at cycle {arr_f} after its FWD {use_f}")
+                # host-side plan invariants raise (not assert: python -O
+                # must not turn a timing violation into silent corruption)
+                if not (0 <= fwd_c[i, src] and arr_f <= use_f):
+                    raise ValueError(
+                        f"skip lane ({src},{dst}): stash for micro-batch "
+                        f"{i} arrives at cycle {arr_f} after its FWD "
+                        f"{use_f} — the schedule violates the direct-hop "
+                        f"timing assumption")
                 reread = (not fwd_only
                           and self.remat_policy is None
                           and (self.checkpoint == "always"
@@ -1019,9 +1024,12 @@ class ScheduledPipeline:
                     continue
                 arr_g = bwd_c[i, dst] + 1
                 use_g = bwd_c[i, src]
-                assert 0 <= bwd_c[i, dst] and arr_g <= use_g, \
-                    (f"skip lane ({src},{dst}): cotangent for micro-batch "
-                     f"{i} arrives at cycle {arr_g} after its BWD {use_g}")
+                if not (0 <= bwd_c[i, dst] and arr_g <= use_g):
+                    raise ValueError(
+                        f"skip lane ({src},{dst}): cotangent for "
+                        f"micro-batch {i} arrives at cycle {arr_g} after "
+                        f"its BWD {use_g} — the schedule violates the "
+                        f"direct-hop timing assumption")
                 wg.append((arr_g, use_g))
             kf = fifo_depth(wf)
             Kf.append(kf)
@@ -1047,16 +1055,54 @@ class ScheduledPipeline:
         return capf, capg, Kf, Kg
 
     def _lane_perms(self):
-        """Per-lane direct permute endpoints: ``(src % d, dst % d)`` per
-        lane, ``None`` when both virtual stages share a device (the lane
-        register itself is the transport — no collective needed)."""
+        """Per-lane direct permute endpoints, MERGED across disjoint lanes.
+
+        Base form: lane ``(src, dst)`` takes one hop ``src % d -> dst % d``
+        (``None`` when both virtual stages share a device — the lane
+        register itself is the transport, no collective needed).
+
+        Merge: lanes whose endpoint pairs are pairwise disjoint (no shared
+        source, no shared destination) are grouped, and every lane in a
+        group gets the group's UNION perm list. Identical perm lists let
+        XLA's collective-permute combiner fuse the group's per-lane
+        permutes into one collective per cycle instead of L. Soundness: a
+        lane's register riding another pair's route only changes which
+        garbage lands at non-capture devices — un-listed destinations
+        already receive zeros from ``ppermute``, and the host capture
+        tables (``_skip_tables``) park anything not scheduled into the
+        sentinel slot either way.
+        """
         d = self.n_stages
-        fwd, bwd = [], []
-        for (src, dst) in self.skip_lanes.pairs:
-            ps, pd = src % d, dst % d
-            fwd.append(None if ps == pd else [(ps, pd)])
-            bwd.append(None if ps == pd else [(pd, ps)])
-        return fwd, bwd
+
+        def merged(pairs_mod):
+            # greedy grouping: first group whose used srcs/dsts are
+            # disjoint from this lane's pair
+            groups: List[dict] = []
+            assign = [None] * len(pairs_mod)
+            for l, pm in enumerate(pairs_mod):
+                if pm is None:
+                    continue
+                ps, pd = pm
+                for gi, grp in enumerate(groups):
+                    if ps not in grp["src"] and pd not in grp["dst"]:
+                        grp["src"].add(ps)
+                        grp["dst"].add(pd)
+                        grp["perm"].append((ps, pd))
+                        assign[l] = gi
+                        break
+                else:
+                    groups.append({"src": {ps}, "dst": {pd},
+                                   "perm": [(ps, pd)]})
+                    assign[l] = len(groups) - 1
+            return [None if a is None else groups[a]["perm"]
+                    for a in assign]
+
+        fwd_pairs = [None if (src % d) == (dst % d)
+                     else (src % d, dst % d)
+                     for (src, dst) in self.skip_lanes.pairs]
+        bwd_pairs = [None if pm is None else (pm[1], pm[0])
+                     for pm in fwd_pairs]
+        return merged(fwd_pairs), merged(bwd_pairs)
 
     def _use_static(self, m: int) -> bool:
         if self.static_unroll is not None:
@@ -1259,7 +1305,12 @@ class ScheduledPipeline:
 
         # Canonical vjp structure (abstract — no tracers leak in):
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
-        key_spec = jax.eval_shape(lambda: jax.random.key(0))
+        # mirror the CALLER's key impl (rbg on TPU via utils/rng.make_key,
+        # threefry elsewhere): the key rides the stored vjp residuals, and
+        # a hardcoded jax.random.key(0) spec (always threefry) would make
+        # the abstract residual structure drift from the traced one on any
+        # platform whose tuned impl differs
+        key_spec = jax.eval_shape(lambda k: k, key)
         lanes = self.skip_lanes
         pops_spec = lanes.specs if lanes is not None else None
         if self.split_stage is not None:

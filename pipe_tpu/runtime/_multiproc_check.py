@@ -4,15 +4,17 @@ Launched as ``python -m pipe_tpu.runtime._multiproc_check <pid> <nprocs>
 <port> <out_file>`` once per process. Each process:
 
 * boots a 2-local-device CPU platform (so 2 processes give a 4-device
-  global topology: stage axis within a process — the ICI analogue — and
-  the data axis across processes — the DCN analogue);
+  global topology);
 * wires the runtime with :func:`pipe_tpu.runtime.distributed.initialize`
   (explicit local coordinator);
-* builds :func:`global_pipeline_mesh` (2 stages x 2 data), assembles its
-  host-local quarter of the global batch via :func:`host_local_batch`,
-  and runs ONE 1F1B pipeline train step (ScheduledPipeline.loss_and_grad)
-  across both processes;
-* process 0 writes the loss to ``out_file``.
+* builds :func:`global_pipeline_mesh` (2 stages x 2 data) in BOTH
+  layouts — default (stage within a process / ICI analogue, data across
+  / DCN analogue) and ``stage_across=True`` (1 stage per process, so
+  every inter-stage ppermute crosses the process boundary) — assembles
+  the host-local batch via :func:`host_local_batch`, and runs ONE 1F1B
+  pipeline train step (ScheduledPipeline.loss_and_grad) across both
+  processes per layout;
+* process 0 writes the losses to ``out_file``.
 
 The launchers (``tests/test_multiprocess.py`` under ``PIPE_TPU_MULTIPROC=1``
 and ``__graft_entry__.dryrun_multichip``, both via
@@ -162,9 +164,27 @@ def worker(process_id: int, num_processes: int, port: int,
     # ZeRO-1 across the process-spanning data axis: the sharded update's
     # collectives ride the DCN analogue
     _, checksum = _zero_step(mesh, pipe, stacked, xs, w)
+
+    # STAGE axis across the process boundary (1 stage per process): every
+    # inter-stage ppermute hop crosses the DCN analogue — the regime the
+    # reference's vestigial RPC layer declared future work
+    # (``pipe.py:295-302``). The data axis is intra-process here, so every
+    # process addresses the full batch.
+    mesh_sx = dist.global_pipeline_mesh(N_STAGES, N_DATA, stage_across=True)
+    pipe_sx, stacked_sx, xs_g_sx, w_g_sx = _build(mesh_sx)
+    xs_sx = dist.host_local_batch(mesh_sx, np.asarray(xs_g_sx),
+                                  batch_axis=1)
+    w_sx = dist.host_local_batch(mesh_sx, np.asarray(w_g_sx), batch_axis=1)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    repl = NamedSharding(mesh_sx, P())
+    loss_sx, grads_sx = jax.jit(
+        pipe_sx.loss_and_grad,
+        out_shardings=(repl, None))(stacked_sx, {}, {}, xs_sx, w_sx)
+    jax.block_until_ready(grads_sx)
+
     if process_id == 0:
         with open(out_file, "w") as f:
-            f.write(f"{float(loss)!r} {checksum!r}")
+            f.write(f"{float(loss)!r} {checksum!r} {float(loss_sx)!r}")
 
 
 def launch_two_process_check(out_file: str, *, timeout: float = 600.0,
@@ -214,8 +234,8 @@ def launch_two_process_check(out_file: str, *, timeout: float = 600.0,
             "\n".join(t.decode(errors="replace")[-3000:] for t in texts))
     try:
         with open(out_file) as f:
-            loss_s, ck_s = f.read().split()
-            return float(loss_s), float(ck_s)
+            loss_s, ck_s, loss_sx_s = f.read().split()
+            return float(loss_s), float(ck_s), float(loss_sx_s)
     except (OSError, ValueError) as e:
         raise RuntimeError(
             f"workers exited 0 but the loss file contract broke: {e}")

@@ -96,14 +96,24 @@ def is_initialized() -> bool:
 def global_pipeline_mesh(n_stages: int,
                          n_data: Optional[int] = None,
                          *,
-                         devices: Optional[Sequence[jax.Device]] = None
+                         devices: Optional[Sequence[jax.Device]] = None,
+                         stage_across: bool = False
                          ) -> Mesh:
     """A ``(stage, data)`` mesh over every process's devices.
 
-    Stage is the fastest-varying placement axis within a host/slice so the
-    stage ring's ``collective-permute`` stays on ICI; the data axis absorbs
-    the cross-host (DCN) dimension, where only gradient all-reduces travel —
-    the bandwidth-optimal split for pipeline+data parallelism.
+    Default layout: stage is the fastest-varying placement axis within a
+    host/slice so the stage ring's ``collective-permute`` stays on ICI; the
+    data axis absorbs the cross-host (DCN) dimension, where only gradient
+    all-reduces travel — the bandwidth-optimal split for pipeline+data
+    parallelism.
+
+    ``stage_across=True`` inverts the placement: the STAGE axis spans the
+    process boundary (devices laid out stage-major), so every inter-stage
+    ``ppermute`` hop crosses the DCN analogue. That is the layout for
+    models too large for one host's chips — the regime the reference's
+    vestigial RPC layer declared future work (``pipe.py:295-302``). Costs
+    per-cycle activation traffic on the slow fabric; prefer the default
+    whenever the stage ring fits inside a slice.
     """
     devices = list(devices if devices is not None else jax.devices())
     total = len(devices)
@@ -114,6 +124,12 @@ def global_pipeline_mesh(n_stages: int,
         n_data = total // n_stages
     if n_stages * n_data > total:
         raise ValueError(f"mesh {n_stages}x{n_data} exceeds {total} devices")
+    if stage_across:
+        # [stage, data] grid directly: stage contiguous over the process
+        # boundary, data within a process.
+        grid = np.asarray(devices[:n_stages * n_data]).reshape(n_stages,
+                                                               n_data)
+        return Mesh(grid, (STAGE_AXIS, DATA_AXIS))
     # [data, stage] grid transposed so stage is contiguous per data row.
     grid = np.asarray(devices[:n_stages * n_data]).reshape(n_data, n_stages)
     return Mesh(grid.T, (STAGE_AXIS, DATA_AXIS))

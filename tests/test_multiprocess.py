@@ -23,9 +23,13 @@ def test_two_process_pipeline_step_matches_single_process(tmp_path):
     from pipe_tpu.runtime._multiproc_check import (launch_two_process_check,
                                                    single_process_loss)
 
-    m_loss, m_ck = launch_two_process_check(str(tmp_path / "loss.txt"))
+    m_loss, m_ck, m_loss_sx = launch_two_process_check(
+        str(tmp_path / "loss.txt"))
     s_loss, s_ck = single_process_loss()
     assert m_loss == pytest.approx(s_loss, rel=1e-6), (m_loss, s_loss)
     # ZeRO-1 moments sharded over the process-spanning data axis: the
     # partitioned update + re-gather must be a pure layout choice
     assert m_ck == pytest.approx(s_ck, rel=1e-5), (m_ck, s_ck)
+    # stage-across topology: inter-stage ppermute crosses the process
+    # boundary (1 stage per process) — still a pure layout choice
+    assert m_loss_sx == pytest.approx(s_loss, rel=1e-6), (m_loss_sx, s_loss)

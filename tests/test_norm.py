@@ -253,14 +253,17 @@ def test_mesh_bn_zbh1_rejected():
              deferred_batch_norm=True, schedule="zb-h1")
 
 
+@pytest.mark.parametrize("n_data", [1, 2], ids=["pp", "ppxdp"])
 @pytest.mark.parametrize("checkpoint", ["never", "except_last"])
-def test_mesh_bn_interleaved_matches_emulator(checkpoint):
+def test_mesh_bn_interleaved_matches_emulator(checkpoint, n_data):
     """Deferred BN composes with interleaved (v > 1) placements: training
     via loss_and_grad (stat lanes through the op tables) AND train-mode
     forward (stat lanes through the FWD-masked tables) both return the
     emulator's committed running stats; eval after the commit matches too
     (reference pipe.py:341-342 composes BN with the pipeline
-    unconditionally)."""
+    unconditionally). ``n_data=2`` adds a data axis, so the stat-lane
+    psum reduces over (stage, data) and train-mode normalization psums
+    micro-batch stats across the data shards."""
     module = Sequential([Linear(6), BatchNorm(), Linear(6), BatchNorm()])
     x = jax.random.normal(jax.random.key(1), (8, 6))
     y = jax.random.normal(jax.random.key(2), (8, 6))
@@ -281,7 +284,7 @@ def test_mesh_bn_interleaved_matches_emulator(checkpoint):
     out_e, exp_new = emu(params, x, train=True)
 
     pipe = Pipe(module, chunks=4, checkpoint=checkpoint,
-                mesh=_stage_mesh(2), schedule="interleaved-1f1b",
+                mesh=_stage_mesh(2, n_data), schedule="interleaved-1f1b",
                 deferred_batch_norm=True)
     packed = pipe.shard_params(params)
 
@@ -318,6 +321,73 @@ def test_mesh_bn_interleaved_matches_emulator(checkpoint):
     ev_e, _new = emu(exp_new, x), None
     np.testing.assert_allclose(np.asarray(ev_m), np.asarray(ev_e),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_mesh_bn_skip_interleaved_matches_emulator():
+    """@skippable + deferred BN through interleaved (v > 1) placements
+    together: the skip lane's direct per-lane permute and the BN stat
+    lanes ride the same op tables — loss, grads, and committed running
+    stats all match the serial emulator."""
+    from pipe_tpu.core.partition import StageCtx
+    from pipe_tpu.extras.skip import pop, skippable, stash
+    from pipe_tpu.ops.layers import Module
+
+    @skippable(stash=["z"])
+    class S(Module):
+        def init(self, key, *a):
+            return {}
+
+        def apply(self, p, x, ctx=StageCtx()):
+            stash("z", x)
+            return x
+
+    @skippable(pop=["z"])
+    class Po(Module):
+        def init(self, key, *a):
+            return {}
+
+        def apply(self, p, x, ctx=StageCtx()):
+            return x + pop("z")
+
+    # 6 layers over 4 virtual stages (balance [2,1,1,2]): the skip jumps
+    # 0 -> 3 across devices at d=2, with a BN on each edge partition
+    module = Sequential([Linear(6), S(), BatchNorm(), Linear(6),
+                         Po(), BatchNorm()])
+    balance = [2, 1, 1, 2]
+    x = jax.random.normal(jax.random.key(1), (8, 6))
+    y = jax.random.normal(jax.random.key(2), (8, 6))
+
+    def loss_fn(out, tgt):
+        return jnp.sum((out - tgt) ** 2, axis=-1)
+
+    emu = Pipe(module, chunks=4, checkpoint="except_last", n_stages=4,
+               balance=balance, deferred_batch_norm=True)
+    params = emu.init(jax.random.key(0), x)
+
+    def emu_loss(ps):
+        out, _ = emu(ps, x, train=True)
+        return jnp.mean(loss_fn(out, y))
+
+    exp_loss = float(emu_loss(params))
+    exp_grads = jax.grad(emu_loss)(params)
+    _, exp_new = emu(params, x, train=True)
+
+    pipe = Pipe(module, chunks=4, checkpoint="except_last",
+                mesh=_stage_mesh(2), schedule="interleaved-1f1b",
+                balance=balance, deferred_batch_norm=True)
+    packed = pipe.shard_params(params)
+    loss, grads, new_packed = jax.jit(lambda p: pipe.loss_and_grad(
+        p, x, targets=y, loss_fn=loss_fn))(packed)
+    assert float(loss) == pytest.approx(exp_loss, rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pipe.unshard_grads(grads)),
+                    jax.tree_util.tree_leaves(exp_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(pipe.unshard_params(new_packed)),
+            jax.tree_util.tree_leaves(exp_new)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
 
 
 @pytest.mark.parametrize("checkpoint", ["never", "always"])

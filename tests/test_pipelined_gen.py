@@ -70,3 +70,39 @@ def test_pipelined_batch_must_divide_into_groups():
     with pytest.raises(ValueError, match="ring groups"):
         pg.generate(stack_stage_params(sp), pre, post,
                     jnp.zeros((3, 4), jnp.int32))
+
+
+@pytest.mark.parametrize("n_stages,batch,p,max_new,k", [
+    (2, 4, 8, 6, 3),
+    (4, 4, 5, 4, 2),
+    (2, 2, 8, 1, 2),   # max_new=1: beams seeded by prefill only
+])
+def test_pipelined_beam_matches_single_device(n_stages, batch, p, max_new,
+                                              k):
+    """Ring-pipelined beam search == the single-device beam, tokens AND
+    scores: the parent indices riding the ring and the per-stage slab
+    reorders are a layout choice, never a math choice."""
+    model, mesh, (sp, pre, post) = _setup(n_stages)
+    prompt = jax.random.randint(jax.random.key(1), (batch, p), 0, CFG.vocab,
+                                jnp.int32)
+    gen_cfg = GenerationConfig(max_new_tokens=max_new, num_beams=k)
+
+    ref_toks, ref_sc = Generator(model, gen_cfg).generate_with_scores(
+        (sp, pre, post), prompt)
+    pg = PipelinedGenerator(mesh, model, gen_cfg)
+    got_toks, got_sc = pg.generate_with_scores(stack_stage_params(sp), pre,
+                                               post, prompt)
+    np.testing.assert_array_equal(np.asarray(got_toks),
+                                  np.asarray(ref_toks))
+    np.testing.assert_allclose(np.asarray(got_sc), np.asarray(ref_sc),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipelined_beam_generate_routes_to_beam():
+    model, mesh, (sp, pre, post) = _setup(2)
+    gen_cfg = GenerationConfig(max_new_tokens=4, num_beams=2)
+    prompt = jnp.zeros((2, 6), jnp.int32)
+    pg = PipelinedGenerator(mesh, model, gen_cfg)
+    toks = pg.generate(stack_stage_params(sp), pre, post, prompt)
+    ref = Generator(model, gen_cfg).generate((sp, pre, post), prompt)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))

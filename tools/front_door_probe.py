@@ -1,0 +1,154 @@
+"""Front-door adapter tax probe: Pipe(mesh=, schedule=) vs raw executor.
+
+Round 4 measured the flagship `Pipe(mesh=, schedule='1f1b').loss_and_grad`
+at ~2x the raw homogeneous `ScheduledPipeline` on identical math (cpu8,
+4 stages, m=8, d_model 256) — the per-cycle `lax.switch` over stage
+branches. Round 5 adds the uniform-partition fast path
+(`HeteroScheduledPipeline._branches_uniform`): when every partition traces
+to the same jaxpr over the same boundary/param layout, ONE shared branch
+replaces the switch and the front door emits the raw executor's program.
+
+``python tools/front_door_probe.py`` (boots its own virtual 8-device CPU
+platform) times three programs on the same uniform 4-stage stack:
+
+* ``raw``            — `ScheduledPipeline` driven directly (the floor);
+* ``pipe-uniform``   — the front door with the fast path (round 5);
+* ``pipe-switch``    — the front door with the fast path disabled
+  (round 4's program, kept honest via monkeypatch).
+
+One JSON line per program + a summary line with the tax ratios.
+Committed artifact: `FRONTDOOR_r05.json`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pipe_tpu.utils.platform import force_cpu_platform
+
+force_cpu_platform(num_devices=8)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipe_tpu import Lambda, Linear, Pipe, Sequential
+from pipe_tpu.core import microbatch as mb
+from pipe_tpu.core.partition import StageCtx
+from pipe_tpu.parallel.hetero_scheduled import HeteroScheduledPipeline
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.scheduled import ScheduledPipeline
+from pipe_tpu.parallel.spmd import stack_stage_params
+
+D_MODEL = int(os.environ.get("PROBE_D_MODEL", "256"))
+N_STAGES = int(os.environ.get("PROBE_STAGES", "4"))
+LAYERS_PER_STAGE = int(os.environ.get("PROBE_LAYERS_PER_STAGE", "2"))
+M = int(os.environ.get("PROBE_CHUNKS", "8"))
+ROWS = int(os.environ.get("PROBE_ROWS", "64"))
+ITERS = int(os.environ.get("PROBE_ITERS", "5"))
+
+
+def block_layers():
+    return [Linear(D_MODEL), Lambda(jax.nn.gelu)]
+
+
+def time_fn(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / ITERS, out
+
+
+def main():
+    mesh = make_mesh(N_STAGES, 1, devices=jax.devices()[:N_STAGES])
+    n_layers = N_STAGES * LAYERS_PER_STAGE
+    model = Sequential([l for _ in range(n_layers) for l in block_layers()])
+    x = jax.random.normal(jax.random.key(1), (ROWS, D_MODEL))
+    y = jax.random.normal(jax.random.key(2), (ROWS, D_MODEL))
+
+    def loss_fn(out, tgt):
+        return jnp.mean((out - tgt) ** 2, axis=-1)
+
+    results = {}
+
+    # --- raw homogeneous executor (the floor) ---------------------------
+    pipe0 = Pipe(model, chunks=M, checkpoint="except_last",
+                 n_stages=N_STAGES)
+    params_per_stage = pipe0.init(jax.random.key(0), x)
+
+    def stage_fn(params_g, h, ctx):
+        for j, layer in enumerate(params_per_stage_layers):
+            h = layer.apply(params_g[j], h, ctx=ctx.fold(j))
+        return h
+
+    # the raw executor needs a homogeneous stage body: apply the stage's
+    # layer stack from the stacked param rows
+    part0 = pipe0.partitions[0]
+    params_per_stage_layers = list(part0)
+
+    raw = ScheduledPipeline(mesh, stage_fn,
+                            pre_fn=lambda prep, x_mb, ctx: x_mb["x"],
+                            post_fn=lambda postp, h, x_mb, ctx:
+                            loss_fn(h, x_mb["tgt"]),
+                            checkpoint="except_last", schedule="1f1b")
+    stacked = stack_stage_params(params_per_stage)
+    xs, n_rows = mb.stack_scatter({"x": x, "tgt": y}, M)
+    w = mb.valid_row_mask(xs, n_rows)
+    raw_step = jax.jit(lambda sp, xx, ww: raw.loss_and_grad(
+        sp, {}, {}, xx, ww, key=jax.random.key(9)))
+    sec, (loss_raw, _) = time_fn(raw_step, stacked, xs, w)
+    results["raw"] = {"sec_per_step": round(sec, 5),
+                      "loss": round(float(loss_raw), 6)}
+    print(json.dumps({"program": "raw", **results["raw"]}), flush=True)
+
+    # --- front door, fast path on / off ---------------------------------
+    def front_door(tag):
+        pipe = Pipe(model, chunks=M, checkpoint="except_last",
+                    mesh=mesh, schedule="1f1b")
+        packed = pipe.shard_params(pipe.init(jax.random.key(0), x))
+        step = jax.jit(lambda p, xx, yy: pipe.loss_and_grad(
+            p, xx, targets=yy, loss_fn=loss_fn, key=jax.random.key(9)))
+        sec, (loss, _) = time_fn(step, packed, x, y)
+        uni = getattr(pipe._train_executor, "uniform_fastpath", None)
+        results[tag] = {"sec_per_step": round(sec, 5),
+                        "loss": round(float(loss), 6),
+                        "uniform_fastpath": uni}
+        print(json.dumps({"program": tag, **results[tag]}), flush=True)
+
+    front_door("pipe-uniform")
+
+    orig = HeteroScheduledPipeline._branches_uniform
+    HeteroScheduledPipeline._branches_uniform = (
+        lambda self, low, *, train: False)
+    try:
+        front_door("pipe-switch")
+    finally:
+        HeteroScheduledPipeline._branches_uniform = orig
+
+    summary = {
+        "config": {"d_model": D_MODEL, "n_stages": N_STAGES,
+                   "layers_per_stage": LAYERS_PER_STAGE, "chunks": M,
+                   "rows": ROWS, "platform": jax.default_backend(),
+                   "n_devices": jax.device_count()},
+        "tax_uniform_vs_raw": round(
+            results["pipe-uniform"]["sec_per_step"]
+            / results["raw"]["sec_per_step"], 4),
+        "tax_switch_vs_raw": round(
+            results["pipe-switch"]["sec_per_step"]
+            / results["raw"]["sec_per_step"], 4),
+        "results": results,
+    }
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()
