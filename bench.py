@@ -46,6 +46,11 @@ import optax
 from pipe_tpu.core import microbatch as mb
 from pipe_tpu.core.schedule import bubble_fraction
 from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+# The MFU arithmetic lives in obs.telemetry (shared with live-training
+# StepReports); re-exported here for backward compatibility.
+from pipe_tpu.obs.telemetry import (StepReport, device_memory_peaks,
+                                    peak_flops_per_chip,
+                                    train_flops_per_token)
 from pipe_tpu.parallel.mesh import make_mesh
 from pipe_tpu.parallel.scheduled import ScheduledPipeline
 from pipe_tpu.parallel.spmd import stack_stage_params
@@ -72,49 +77,6 @@ def tutorial_config(platform: str) -> LMConfig:
     # CPU/dev fallback: same structure, small dims, so the script stays runnable.
     return LMConfig(vocab=1024, d_model=128, nhead=4, d_ff=256, n_layers=8,
                     seq_len=64)
-
-
-def train_flops_per_token(cfg: LMConfig, checkpoint: str, chunks: int):
-    """(required, hardware) FLOPs per trained token.
-
-    MAC counting: per layer, QKV+out projections 4*d^2 and FFN 2*d*d_ff; the
-    attention score/value matmuls add seq*d per token (causal halves the
-    window); the decoder projection d*vocab. One MAC = 2 FLOPs; backward
-    costs 2x forward. ``required`` is the standard MFU numerator (3x forward,
-    no recompute); ``hardware`` adds the remat re-forward the executor
-    actually runs — the schedule-table executor applies the EXACT
-    per-micro-batch policy (reference ``pipe.py:354``): except_last remats
-    chunks-1 of chunks micro-batches. Only the per-layer term remats: the
-    policy wraps the stage body, not embed/decoder.
-    """
-    d, ff, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
-    eff_s = cfg.seq_len / 2 if cfg.causal else cfg.seq_len
-    layer_macs = L * (4 * d * d + 2 * d * ff + 2 * eff_s * d)
-    macs = layer_macs + d * V
-    remat = {"never": 0.0, "except_last": (chunks - 1) / chunks,
-             "always": 1.0}[checkpoint]
-    required = 2 * macs * 3
-    hardware = required + 2 * layer_macs * remat
-    return required, hardware
-
-
-# bf16 peak FLOP/s per chip by device kind (dense; conservative defaults).
-_PEAK_BF16 = (
-    ("v6", 918e12),     # Trillium
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),  # device_kind "TPU v5 lite" (v5e)
-    ("v5lite", 197e12),
-    ("v4", 275e12),
-)
-
-
-def peak_flops_per_chip() -> float:
-    kind = jax.devices()[0].device_kind.lower()
-    for tag, peak in _PEAK_BF16:
-        if tag in kind:
-            return peak
-    return 197e12  # unknown kind: assume v5e-class
 
 
 def make_step(model, sched, tx):
@@ -440,6 +402,18 @@ def main():
     mfu = (req_tok * pipe_tps_chip) / peak
     hfu = (hw_tok * pipe_tps_chip) / peak
 
+    # The same numbers as a StepReport, so BENCH_*.json rounds carry the
+    # bubble/MFU/memory fields in the exact schema live training emits.
+    report = StepReport.compute(
+        step=0, wall_sec=sec_per_step, tokens=tokens_per_step,
+        n_stages=n_stages, chunks=CHUNKS, checkpoint=hw_mode,
+        schedule="1f1b", loss=loss, model_cfg=cfg,
+        analytic_bubble=bubble_fraction(CHUNKS, n_stages),
+        measured_bubble=measured_bubble,
+        measured_bubble_method=bubble_method,
+        memory=device_memory_peaks(), platform=platform,
+        device_kind=jax.devices()[0].device_kind)
+
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(pipe_tps_chip, 2),
@@ -464,6 +438,7 @@ def main():
         "measured_bubble_method": bubble_method,
         "measured_bubble_multistage": bubble_multistage,
         "final_loss": round(loss, 4),
+        "step_report": report.to_json(),
         "config": dataclasses.asdict(
             dataclasses.replace(cfg, compute_dtype=str(cfg.compute_dtype))),
     }))

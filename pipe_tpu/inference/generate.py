@@ -26,11 +26,13 @@ re-forward loop.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..obs.telemetry import get_registry
 from .quant import QuantLeaf, dequant_tree
 
 __all__ = ["GenerationConfig", "Generator", "check_positions",
@@ -116,7 +118,7 @@ class Generator:
     """
 
     def __init__(self, model, gen_cfg: GenerationConfig = GenerationConfig(),
-                 *, layer_scan: bool = True):
+                 *, layer_scan: bool = True, phase_timing: bool = False):
         if not hasattr(model, "embed_at"):
             raise TypeError(
                 f"{type(model).__name__} has no embed_at; KV-cache "
@@ -129,8 +131,14 @@ class Generator:
         self.model = model
         self.gen_cfg = gen_cfg
         self.layer_scan = layer_scan
+        # phase_timing=True additionally times a prefill-only program per
+        # generate() call so the registry sees separate prefill/decode
+        # histograms (decode = e2e - prefill). It re-runs prefill, so it
+        # costs one extra prompt pass per call — opt-in, for profiling.
+        self.phase_timing = phase_timing
         self._jitted = jax.jit(self._generate)
         self._jitted_beam = None  # built on first beam-search call
+        self._jitted_prefill = None  # built on first phase_timing call
 
     # --- internals ---
 
@@ -230,6 +238,28 @@ class Generator:
         out = jnp.moveaxis(toks, 0, 1)  # [b, max_new-1]
         return jnp.concatenate([out, last[:, None]], axis=1)
 
+    def _prefill_only(self, params, prompt):
+        """Prefill pass alone (same math as the head of ``_generate``),
+        jitted separately so ``phase_timing`` can attribute wall time to
+        prefill vs decode without instrumenting inside the scan."""
+        stage_params, pre_params, post_params = params
+        blocks = self._blocks(stage_params)
+        h, _ = self._prefill(blocks, pre_params, prompt,
+                             prompt.shape[1] + self.gen_cfg.max_new_tokens)
+        return self._head(post_params, h[:, -1:, :])
+
+    def _observe_phases(self, reg, params, prompt, e2e_sec: float) -> None:
+        """Time the prefill-only program and fold the split into the
+        registry. First call includes its compile (as the e2e number's
+        first call does); decode is the e2e remainder."""
+        if self._jitted_prefill is None:
+            self._jitted_prefill = jax.jit(self._prefill_only)
+        t0 = time.perf_counter()
+        jax.block_until_ready(self._jitted_prefill(params, prompt))
+        pf = time.perf_counter() - t0
+        reg.histogram("serve.prefill_sec").observe(pf)
+        reg.histogram("serve.decode_sec").observe(max(e2e_sec - pf, 0.0))
+
     def _generate_beam(self, params, prompt):
         """Beam search: deterministic, sum-of-log-probs scoring.
 
@@ -303,7 +333,23 @@ class Generator:
             return self.generate_with_scores(params, prompt)[0]
         if key is None:
             key = jax.random.key(0)
-        return self._jitted(params, jnp.asarray(prompt, jnp.int32), key)
+        prompt = jnp.asarray(prompt, jnp.int32)
+        reg = get_registry()
+        t0 = time.perf_counter()
+        out = self._jitted(params, prompt, key)
+        if reg.enabled:
+            # Block for an honest latency number; callers read the tokens
+            # to host right after anyway.
+            out = jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            reg.histogram("serve.generate_sec").observe(dt)
+            tokens = prompt.shape[0] * self.gen_cfg.max_new_tokens
+            reg.counter("serve.tokens").inc(tokens)
+            if dt > 0:
+                reg.gauge("serve.tokens_per_sec").set(tokens / dt)
+            if self.phase_timing:
+                self._observe_phases(reg, params, prompt, dt)
+        return out
 
     def generate_with_scores(self, params, prompt: jax.Array):
         """Beam search returning ``(tokens [b, max_new], scores [b])`` —
@@ -314,4 +360,16 @@ class Generator:
                         self.gen_cfg.max_new_tokens)
         if self._jitted_beam is None:
             self._jitted_beam = jax.jit(self._generate_beam)
-        return self._jitted_beam(params, jnp.asarray(prompt, jnp.int32))
+        prompt = jnp.asarray(prompt, jnp.int32)
+        reg = get_registry()
+        t0 = time.perf_counter()
+        out = self._jitted_beam(params, prompt)
+        if reg.enabled:
+            out = jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            reg.histogram("serve.beam_sec").observe(dt)
+            tokens = prompt.shape[0] * self.gen_cfg.max_new_tokens
+            reg.counter("serve.tokens").inc(tokens)
+            if dt > 0:
+                reg.gauge("serve.tokens_per_sec").set(tokens / dt)
+        return out

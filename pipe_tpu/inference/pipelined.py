@@ -36,16 +36,19 @@ single-device Generator token-for-token.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs.telemetry import get_registry
 from ..parallel.mesh import STAGE_AXIS
 from .generate import (GenerationConfig, check_positions, head_logits,
                        sample_logits)
 from .quant import QuantLeaf, dequant_tree
+from ..utils.compat import shard_map
 
 __all__ = ["PipelinedGenerator"]
 
@@ -419,8 +422,12 @@ class PipelinedGenerator:
         cache_key = (p, rpg,
                      jax.tree_util.tree_structure((stage_params, pre_params,
                                                    post_params)))
+        reg = get_registry()
         run = self._programs.get(cache_key)
-        if run is None:
+        if run is not None:
+            reg.counter("serve.pipelined.program_cache_hits").inc()
+        else:
+            reg.counter("serve.pipelined.program_cache_misses").inc()
             in_specs = (
                 jax.tree_util.tree_map(lambda _: P(STAGE_AXIS),
                                        stage_params),
@@ -428,12 +435,23 @@ class PipelinedGenerator:
                 jax.tree_util.tree_map(lambda _: P(), post_params),
                 P(), P(),
             )
-            run = jax.jit(jax.shard_map(
+            run = jax.jit(shard_map(
                 functools.partial(self._device_program, p=p, rpg=rpg),
                 mesh=self.mesh, in_specs=in_specs, out_specs=P(),
                 check_vma=False))
             self._programs[cache_key] = run
+        t0 = time.perf_counter()
         out = run(stage_params, pre_params, post_params, prompt_g, key)
+        if reg.enabled:
+            # Block for an honest wall-clock number; serving callers read
+            # the tokens to host right after anyway.
+            out = jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            reg.histogram("serve.pipelined.generate_sec").observe(dt)
+            tokens = b * self.gen_cfg.max_new_tokens
+            reg.counter("serve.pipelined.tokens").inc(tokens)
+            if dt > 0:
+                reg.gauge("serve.pipelined.tokens_per_sec").set(tokens / dt)
         return out.reshape(b, self.gen_cfg.max_new_tokens)
 
     def generate_with_scores(self, stage_params, pre_params, post_params,
@@ -454,8 +472,12 @@ class PipelinedGenerator:
         cache_key = ("beam", p, rpg,
                      jax.tree_util.tree_structure((stage_params, pre_params,
                                                    post_params)))
+        reg = get_registry()
         run = self._programs.get(cache_key)
-        if run is None:
+        if run is not None:
+            reg.counter("serve.pipelined.program_cache_hits").inc()
+        else:
+            reg.counter("serve.pipelined.program_cache_misses").inc()
             in_specs = (
                 jax.tree_util.tree_map(lambda _: P(STAGE_AXIS),
                                        stage_params),
@@ -463,11 +485,20 @@ class PipelinedGenerator:
                 jax.tree_util.tree_map(lambda _: P(), post_params),
                 P(),
             )
-            run = jax.jit(jax.shard_map(
+            run = jax.jit(shard_map(
                 functools.partial(self._device_program_beam, p=p, rpg=rpg),
                 mesh=self.mesh, in_specs=in_specs, out_specs=(P(), P()),
                 check_vma=False))
             self._programs[cache_key] = run
+        t0 = time.perf_counter()
         toks, scores = run(stage_params, pre_params, post_params, prompt_g)
+        if reg.enabled:
+            toks, scores = jax.block_until_ready((toks, scores))
+            dt = time.perf_counter() - t0
+            reg.histogram("serve.pipelined.beam_sec").observe(dt)
+            tokens = b * self.gen_cfg.max_new_tokens
+            reg.counter("serve.pipelined.tokens").inc(tokens)
+            if dt > 0:
+                reg.gauge("serve.pipelined.tokens_per_sec").set(tokens / dt)
         return (toks.reshape(b, self.gen_cfg.max_new_tokens),
                 scores.reshape(b))

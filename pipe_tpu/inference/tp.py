@@ -30,8 +30,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs.telemetry import get_registry
 from ..parallel.mesh import MODEL_AXIS
 from .generate import GenerationConfig, Generator, check_positions
+from ..utils.compat import shard_map
 
 __all__ = ["TPShardedGenerator"]
 
@@ -91,7 +93,9 @@ class TPShardedGenerator(Generator):
                      jax.tree_util.tree_structure(params))
         run = self._programs.get(cache_key)
         if run is not None:
+            get_registry().counter("serve.tp.program_cache_hits").inc()
             return run
+        get_registry().counter("serve.tp.program_cache_misses").inc()
         stage_specs = [self.model.stage_param_specs()
                        for _ in stage_params]
         in_specs = (
@@ -101,13 +105,13 @@ class TPShardedGenerator(Generator):
             P(),
         )
         if beam:
-            run = jax.jit(jax.shard_map(
+            run = jax.jit(shard_map(
                 lambda sp, pre, post, pr: self._generate_beam(
                     (sp, pre, post), pr),
                 mesh=self.mesh, in_specs=in_specs, out_specs=(P(), P()),
                 check_vma=False))
         else:
-            run = jax.jit(jax.shard_map(
+            run = jax.jit(shard_map(
                 lambda sp, pre, post, pr, k: self._generate(
                     (sp, pre, post), pr, k),
                 mesh=self.mesh, in_specs=in_specs + (P(),),

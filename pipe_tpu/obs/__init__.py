@@ -1,7 +1,27 @@
-"""Observability: profiler scopes, bubble measurement, memory reporting."""
+"""Observability: metrics registry, structured events, profiler scopes,
+bubble measurement, per-stage timeline attribution, memory reporting.
 
+See ``docs/observability.md`` for the full subsystem tour.
+"""
+
+from .events import (EventLog, NULL_EVENT_LOG, NullEventLog, SPAN_KINDS)
 from .meters import (BubbleMeter, device_memory_report, measured_bubble_slope,
-                     profile_trace, stage_busy_from_trace, stage_scope)
+                     measured_bubble_two_point, profile_trace,
+                     stage_busy_from_trace, stage_scope,
+                     stage_timeline_from_trace)
+from .telemetry import (Counter, EwmaTimer, Gauge, Histogram, MetricsRegistry,
+                        StepReport, device_memory_peaks, get_registry,
+                        null_registry, peak_flops_per_chip, set_registry,
+                        train_flops_per_token)
+from .tb_writer import ScalarWriter
 
-__all__ = ["BubbleMeter", "device_memory_report", "measured_bubble_slope",
-           "profile_trace", "stage_busy_from_trace", "stage_scope"]
+__all__ = [
+    "BubbleMeter", "device_memory_report", "measured_bubble_slope",
+    "measured_bubble_two_point", "profile_trace", "stage_busy_from_trace",
+    "stage_scope", "stage_timeline_from_trace",
+    "EventLog", "NullEventLog", "NULL_EVENT_LOG", "SPAN_KINDS",
+    "Counter", "EwmaTimer", "Gauge", "Histogram", "MetricsRegistry",
+    "StepReport", "device_memory_peaks", "get_registry", "null_registry",
+    "peak_flops_per_chip", "set_registry", "train_flops_per_token",
+    "ScalarWriter",
+]

@@ -1,0 +1,187 @@
+"""Structured JSONL event log with nested spans.
+
+The host-side counterpart of the trace scopes: where
+``meters.stage_scope`` names *device* work for the profiler
+(``chunk{i}-stage{j}`` in XLA op names), :class:`EventLog` records *host*
+structure — steps, compiles, evaluation, serving calls, and (on the
+emulator, which runs tasks in Python) per-stage/per-micro-batch task
+spans — as one JSON object per line, cheap enough to leave on in
+production loops.
+
+Record schema (one dict per line)::
+
+    {"kind": <str>, "id": <int>, "parent": <int|null>,
+     "t": <sec since log open>, "dur": <sec, spans only>, ...attrs}
+
+plus a ``log_open`` header carrying the wall-clock epoch so host events
+can be correlated with profiler traces. Span kinds used by the built-in
+wiring: ``step``, ``stage``, ``microbatch``, ``comm``,
+``checkpoint-recompute`` (:data:`SPAN_KINDS`); ``step_report`` records
+carry a full :class:`~.telemetry.StepReport` (``to_json`` payload).
+
+Spans nest through a per-thread stack: ``parent`` is the id of the
+innermost open span on the same thread. Records are written at span
+*exit*, so children precede parents in the file; :meth:`EventLog.read`
+returns them in file order and tests reconstruct the tree from
+``id``/``parent``.
+
+``NULL_EVENT_LOG`` is the disabled sink — same API, no file, no clock
+reads beyond the context-manager protocol — so call sites never branch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, IO, List, Optional
+
+__all__ = ["EventLog", "NullEventLog", "NULL_EVENT_LOG", "SPAN_KINDS",
+           "STEP", "STAGE", "MICROBATCH", "COMM", "RECOMPUTE"]
+
+STEP = "step"
+STAGE = "stage"
+MICROBATCH = "microbatch"
+COMM = "comm"
+RECOMPUTE = "checkpoint-recompute"
+SPAN_KINDS = (STEP, STAGE, MICROBATCH, COMM, RECOMPUTE)
+
+
+class EventLog:
+    """Append-only JSONL event sink with nested span support."""
+
+    def __init__(self, path: str, *, autoflush: bool = True):
+        self.path = path
+        self._autoflush = autoflush
+        self._file: Optional[IO[str]] = open(path, "a")
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._next_id = 0
+        self._t0 = time.perf_counter()
+        self._write({"kind": "log_open", "wall_time": time.time(),
+                     "id": self._alloc_id(), "parent": None, "t": 0.0})
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _alloc_id(self) -> int:
+        self._next_id += 1
+        return self._next_id
+
+    def _stack(self) -> List[int]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record)
+        with self._lock:
+            if self._file is None:
+                return
+            self._file.write(line + "\n")
+            if self._autoflush:
+                self._file.flush()
+
+    # -- recording ---------------------------------------------------------
+
+    def event(self, kind: str, **attrs: Any) -> None:
+        """Instantaneous event under the current span (if any)."""
+        stack = self._stack()
+        rec = {"kind": kind, "id": self._alloc_id(),
+               "parent": stack[-1] if stack else None,
+               "t": time.perf_counter() - self._t0}
+        rec.update(attrs)
+        self._write(rec)
+
+    @contextlib.contextmanager
+    def span(self, kind: str, **attrs: Any):
+        """Timed span; nests under the innermost open span on this thread."""
+        stack = self._stack()
+        span_id = self._alloc_id()
+        parent = stack[-1] if stack else None
+        stack.append(span_id)
+        t0 = time.perf_counter()
+        try:
+            yield span_id
+        finally:
+            dur = time.perf_counter() - t0
+            stack.pop()
+            rec = {"kind": kind, "id": span_id, "parent": parent,
+                   "t": t0 - self._t0, "dur": dur}
+            rec.update(attrs)
+            self._write(rec)
+
+    def step_report(self, report) -> None:
+        """Record a :class:`~.telemetry.StepReport` (or a plain dict)."""
+        payload = report.to_json() if hasattr(report, "to_json") else report
+        self.event("step_report", **payload)
+
+    def metrics_snapshot(self, registry) -> None:
+        """Record a registry snapshot (counters/gauges/timers/histograms)."""
+        self.event("metrics", metrics=registry.snapshot())
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- readback ----------------------------------------------------------
+
+    @staticmethod
+    def read(path: str) -> List[Dict[str, Any]]:
+        """All records in file order (children precede their parent span)."""
+        out: List[Dict[str, Any]] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+
+class NullEventLog:
+    """Disabled sink: same surface as :class:`EventLog`, writes nothing."""
+
+    path = None
+
+    def event(self, kind: str, **attrs: Any) -> None:
+        pass
+
+    def span(self, kind: str, **attrs: Any):
+        return contextlib.nullcontext(0)
+
+    def step_report(self, report) -> None:
+        pass
+
+    def metrics_snapshot(self, registry) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullEventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NULL_EVENT_LOG = NullEventLog()

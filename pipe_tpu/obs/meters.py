@@ -20,15 +20,17 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import os
+import re
 from typing import Dict, List, Optional, Tuple
 
 import jax
 
 from ..core.schedule import Schedule, bubble_fraction
+from .xplane import load_trace_planes
 
 __all__ = ["stage_scope", "profile_trace", "device_memory_report",
-           "BubbleMeter", "stage_busy_from_trace", "measured_bubble_slope",
+           "BubbleMeter", "stage_busy_from_trace",
+           "stage_timeline_from_trace", "measured_bubble_slope",
            "measured_bubble_two_point"]
 
 
@@ -39,10 +41,17 @@ def stage_scope(microbatch: int, stage: int):
 
 @contextlib.contextmanager
 def profile_trace(logdir: str, *, host_tracer_level: int = 2):
-    """Capture a profiler trace viewable in TensorBoard/Perfetto/XProf."""
-    options = jax.profiler.ProfileOptions()
-    options.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(logdir, profiler_options=options)
+    """Capture a profiler trace viewable in TensorBoard/Perfetto/XProf.
+
+    ``ProfileOptions`` is a recent jax addition; older releases take no
+    options and trace at their default host level — same capture files.
+    """
+    try:
+        options = jax.profiler.ProfileOptions()
+        options.host_tracer_level = host_tracer_level
+        jax.profiler.start_trace(logdir, profiler_options=options)
+    except AttributeError:
+        jax.profiler.start_trace(logdir)
     try:
         yield logdir
     finally:
@@ -100,60 +109,107 @@ class BubbleMeter:
                 f"analytic={self.analytic:.2%}")
 
 
-def _merge_busy_ns(events: List[Tuple[float, float]]) -> float:
-    """Union length of [start, end) intervals (events overlap across lines)."""
-    events.sort()
-    busy = 0.0
-    cur_s, cur_e = None, None
+def _merge_intervals(events: List[Tuple[float, float]]
+                     ) -> List[Tuple[float, float]]:
+    """Union of [start, end) intervals (events overlap across lines)."""
+    events = sorted(events)
+    merged: List[Tuple[float, float]] = []
     for s, e in events:
-        if cur_e is None or s > cur_e:
-            if cur_e is not None:
-                busy += cur_e - cur_s
-            cur_s, cur_e = s, e
+        if merged and s <= merged[-1][1]:
+            if e > merged[-1][1]:
+                merged[-1] = (merged[-1][0], e)
         else:
-            cur_e = max(cur_e, e)
-    if cur_e is not None:
-        busy += cur_e - cur_s
-    return busy
+            merged.append((s, e))
+    return merged
+
+
+def _merge_busy_ns(events: List[Tuple[float, float]]) -> float:
+    """Union length of [start, end) intervals."""
+    return sum(e - s for s, e in _merge_intervals(events))
 
 
 def stage_busy_from_trace(logdir: str) -> Dict[str, float]:
     """Per-device busy seconds from a :func:`profile_trace` capture.
 
-    Parses the xplane protos with ``jax.profiler.ProfileData`` and merges the
-    op-event intervals of every ``/device:*`` plane — the trace-driven
-    counterpart of the reference author's TensorBoard-trace verification
-    (``/root/reference/README.md:559-567``). Returns ``{plane_name: busy_sec}``
-    plus a ``"_span"`` key holding the whole trace's wall span in seconds.
-    Device planes exist for real accelerators (``/device:TPU:0`` ...); the
-    virtual CPU platform reports only host threads, for which
-    :func:`measured_bubble_slope` is the fallback.
+    Parses the xplane protos (dependency-free, any jax version — see
+    :mod:`.xplane`) and merges the op-event intervals of every
+    ``/device:*`` plane — the trace-driven counterpart of the reference
+    author's TensorBoard-trace verification
+    (``/root/reference/README.md:559-567``). Returns ``{plane_name:
+    busy_sec}`` plus a ``"_span"`` key holding the whole trace's wall span
+    in seconds. Device planes exist for real accelerators
+    (``/device:TPU:0`` ...); the virtual CPU platform reports only host
+    threads, for which :func:`measured_bubble_slope` is the fallback.
     """
-    from jax.profiler import ProfileData
-
     busy: Dict[str, float] = {}
     lo, hi = float("inf"), 0.0
-    for root, _, files in os.walk(logdir):
-        for fname in files:
-            if not fname.endswith(".xplane.pb"):
-                continue
-            with open(os.path.join(root, fname), "rb") as f:
-                pd = ProfileData.from_serialized_xspace(f.read())
-            for plane in pd.planes:
-                if not plane.name.startswith("/device:"):
-                    continue
-                events: List[Tuple[float, float]] = []
-                for line in plane.lines:
-                    for ev in line.events:
-                        s = float(ev.start_ns)
-                        e = s + float(ev.duration_ns)
-                        events.append((s, e))
-                        lo, hi = min(lo, s), max(hi, e)
-                if events:
-                    busy[plane.name] = busy.get(plane.name, 0.0) + \
-                        _merge_busy_ns(events) / 1e9
+    for plane in load_trace_planes(logdir):
+        if not plane.name.startswith("/device:"):
+            continue
+        events: List[Tuple[float, float]] = []
+        for line in plane.lines:
+            for ev in line.events:
+                events.append((ev.start_ns, ev.end_ns))
+                lo, hi = min(lo, ev.start_ns), max(hi, ev.end_ns)
+        if events:
+            busy[plane.name] = busy.get(plane.name, 0.0) + \
+                _merge_busy_ns(events) / 1e9
     busy["_span"] = (hi - lo) / 1e9 if hi > lo else 0.0
     return busy
+
+
+_SCOPE_RE = re.compile(r"chunk(\d+)-stage(\d+)")
+
+
+def stage_timeline_from_trace(logdir: str) -> Dict[str, object]:
+    """Per-stage busy/idle attribution bucketed by the ``chunk{i}-stage{j}``
+    named scopes (:func:`stage_scope` — they survive into XLA op names).
+
+    Extends :func:`stage_busy_from_trace` from per-plane to per-stage: every
+    event whose name carries a scope tag is credited to that (stage,
+    micro-batch) bucket, intervals unioned per bucket. Prefers ``/device:*``
+    planes; when none exist (virtual CPU platform) it falls back to host
+    planes carrying scope-tagged events, and reports which source it used so
+    callers can label the numbers honestly.
+
+    Returns::
+
+        {"source": "device" | "host" | None,      # None: no tagged events
+         "span": (lo_ns, hi_ns),                   # over tagged events
+         "stages": {j: {"busy_sec": float,
+                        "intervals": [(s_ns, e_ns), ...],   # merged
+                        "chunks": {i: busy_sec}}}}
+    """
+    planes = load_trace_planes(logdir)
+    for source, keep in (("device", lambda p: p.name.startswith("/device:")),
+                         ("host", lambda p: True)):
+        raw: Dict[int, List[Tuple[float, float]]] = {}
+        per_chunk: Dict[int, Dict[int, float]] = {}
+        lo, hi = float("inf"), 0.0
+        for plane in planes:
+            if not keep(plane):
+                continue
+            for line in plane.lines:
+                for ev in line.events:
+                    m = _SCOPE_RE.search(ev.name)
+                    if not m:
+                        continue
+                    chunk, stage = int(m.group(1)), int(m.group(2))
+                    raw.setdefault(stage, []).append((ev.start_ns, ev.end_ns))
+                    ch = per_chunk.setdefault(stage, {})
+                    ch[chunk] = ch.get(chunk, 0.0) + ev.duration_ns / 1e9
+                    lo, hi = min(lo, ev.start_ns), max(hi, ev.end_ns)
+        if raw:
+            stages = {}
+            for stage, events in sorted(raw.items()):
+                merged = _merge_intervals(events)
+                stages[stage] = {
+                    "busy_sec": sum(e - s for s, e in merged) / 1e9,
+                    "intervals": merged,
+                    "chunks": dict(sorted(per_chunk[stage].items())),
+                }
+            return {"source": source, "span": (lo, hi), "stages": stages}
+    return {"source": None, "span": (0.0, 0.0), "stages": {}}
 
 
 def measured_bubble_slope(t_m: float, t_2m: float, m: int) -> float:

@@ -1,0 +1,472 @@
+"""Process-local metrics registry and per-step reporting.
+
+The reference stack was studied through its tracing surface alone
+(``record_function`` spans + TensorBoard traces); pipe_tpu folds that and
+the scalar side into one layer:
+
+* :class:`MetricsRegistry` — counters, gauges, EWMA timers, log-scale
+  histograms. Process-local, dependency-free, and a cheap no-op when
+  disabled: a disabled registry hands out shared null instruments whose
+  methods do nothing (no allocation, no clock reads), so hot paths can
+  instrument unconditionally.
+* :class:`StepReport` — one training step folded into the fields the
+  committed ``BENCH_*.json`` artifacts carry (tokens/sec, MFU/HFU,
+  analytic + measured bubble, per-device memory peaks), so every round's
+  numbers are comparable whether they came from ``bench.py`` or a live
+  training run.
+* the MFU arithmetic (:func:`train_flops_per_token`,
+  :func:`peak_flops_per_chip`) — moved here from ``bench.py`` so serving
+  and training paths share one FLOPs model.
+
+Export goes through two sinks: ``tb_writer.ScalarWriter`` (TensorBoard)
+and the JSONL event log (:mod:`.events`).
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import dataclasses
+import math
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "EwmaTimer", "Histogram", "MetricsRegistry",
+    "StepReport", "get_registry", "set_registry", "null_registry",
+    "train_flops_per_token", "peak_flops_per_chip", "device_memory_peaks",
+]
+
+
+# --------------------------------------------------------------------------
+# Instruments
+# --------------------------------------------------------------------------
+
+class Counter:
+    """Monotonic count (dispatches, cache hits, tokens, ...)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins scalar (tokens/sec, uniform_fastpath 0/1, ...)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class EwmaTimer:
+    """Duration tracker: count/total plus an exponential moving average.
+
+    The EWMA (default alpha 0.1 ≈ a ~10-observation horizon) is the
+    steady-state per-step number; ``total/count`` includes warmup/compile.
+    """
+
+    __slots__ = ("alpha", "count", "total", "ewma", "last")
+
+    def __init__(self, alpha: float = 0.1):
+        self.alpha = alpha
+        self.count = 0
+        self.total = 0.0
+        self.ewma = 0.0
+        self.last = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        self.last = seconds
+        self.ewma = seconds if self.count == 1 else (
+            self.alpha * seconds + (1.0 - self.alpha) * self.ewma)
+
+    @contextlib.contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+
+class Histogram:
+    """Log-scale latency histogram (powers of 2 from ~1 µs to ~1 h).
+
+    Fixed 42-bucket layout keeps ``observe`` a bisect + increment; the
+    percentile estimate returns the upper edge of the covering bucket
+    (≤ 2x the true value — plenty for latency-distribution shape).
+    """
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    _EDGES = [2.0 ** e for e in range(-20, 12)]   # 0.95 µs .. 2048 s
+
+    def __init__(self):
+        self.counts = [0] * (len(self._EDGES) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.counts[bisect.bisect_left(self._EDGES, seconds)] += 1
+        self.count += 1
+        self.sum += seconds
+        self.min = min(self.min, seconds)
+        self.max = max(self.max, seconds)
+
+    @contextlib.contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    def percentile(self, q: float) -> float:
+        """Upper-edge estimate of the q-quantile (q in [0, 1])."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self._EDGES[i] if i < len(self._EDGES) else self.max
+        return self.max
+
+    def summary(self) -> Dict[str, float]:
+        if self.count == 0:
+            return {"count": 0}
+        return {"count": self.count, "sum": self.sum,
+                "mean": self.sum / self.count,
+                "min": self.min, "max": self.max,
+                "p50": self.percentile(0.50), "p90": self.percentile(0.90),
+                "p99": self.percentile(0.99)}
+
+
+class _NullInstrument:
+    """Shared do-nothing stand-in for every instrument type. ``time()``
+    reads no clock, so a disabled registry costs one attribute call per
+    instrumentation site and nothing else."""
+
+    __slots__ = ()
+    value = 0
+    count = 0
+    total = 0.0
+    ewma = 0.0
+    last = 0.0
+    sum = 0.0
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, seconds: float) -> None:
+        pass
+
+    def time(self):
+        return _NULL_CONTEXT
+
+    def percentile(self, q: float) -> float:
+        return 0.0
+
+    def summary(self) -> Dict[str, float]:
+        return {"count": 0}
+
+
+_NULL_CONTEXT = contextlib.nullcontext()
+NULL_INSTRUMENT = _NullInstrument()
+
+
+# --------------------------------------------------------------------------
+# Registry
+# --------------------------------------------------------------------------
+
+class MetricsRegistry:
+    """Named-instrument store. ``counter/gauge/timer/histogram`` create on
+    first use and return the same object thereafter; a disabled registry
+    returns the shared :data:`NULL_INSTRUMENT` and records nothing."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._instruments: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, factory):
+        if not self.enabled:
+            return NULL_INSTRUMENT
+        inst = self._instruments.get(name)
+        if inst is None:
+            with self._lock:
+                inst = self._instruments.setdefault(name, factory())
+        return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def timer(self, name: str, alpha: float = 0.1) -> EwmaTimer:
+        return self._get(name, lambda: EwmaTimer(alpha))
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """All instruments as plain data (histograms/timers as dicts)."""
+        out: Dict[str, Any] = {}
+        for name, inst in sorted(self._instruments.items()):
+            if isinstance(inst, (Counter, Gauge)):
+                out[name] = inst.value
+            elif isinstance(inst, EwmaTimer):
+                out[name] = {"count": inst.count, "total": inst.total,
+                             "ewma": inst.ewma, "last": inst.last}
+            else:
+                out[name] = inst.summary()
+        return out
+
+    def scalars(self) -> Dict[str, float]:
+        """Flat name → float view for ``ScalarWriter`` export (timer →
+        ``name.ewma``, histogram → ``name.p50``/``name.p99``)."""
+        out: Dict[str, float] = {}
+        for name, inst in sorted(self._instruments.items()):
+            if isinstance(inst, (Counter, Gauge)):
+                out[name] = float(inst.value)
+            elif isinstance(inst, EwmaTimer):
+                if inst.count:
+                    out[f"{name}.ewma"] = inst.ewma
+            elif inst.count:
+                out[f"{name}.p50"] = inst.percentile(0.50)
+                out[f"{name}.p99"] = inst.percentile(0.99)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+_default_registry = MetricsRegistry(enabled=True)
+_NULL_REGISTRY = MetricsRegistry(enabled=False)
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-local default registry (enabled unless replaced)."""
+    return _default_registry
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process default (tests, or ``null_registry()`` to disable
+    all default-registry instrumentation). Returns the previous one."""
+    global _default_registry
+    prev, _default_registry = _default_registry, registry
+    return prev
+
+
+def null_registry() -> MetricsRegistry:
+    """The shared disabled registry — every instrument is a no-op."""
+    return _NULL_REGISTRY
+
+
+# --------------------------------------------------------------------------
+# FLOPs model (moved from bench.py so train + serve share one MFU basis)
+# --------------------------------------------------------------------------
+
+def train_flops_per_token(cfg, checkpoint: str, chunks: int):
+    """(required, hardware) FLOPs per trained token.
+
+    MAC counting: per layer, QKV+out projections 4*d^2 and FFN 2*d*d_ff; the
+    attention score/value matmuls add seq*d per token (causal halves the
+    window); the decoder projection d*vocab. One MAC = 2 FLOPs; backward
+    costs 2x forward. ``required`` is the standard MFU numerator (3x forward,
+    no recompute); ``hardware`` adds the remat re-forward the executor
+    actually runs — the schedule-table executor applies the EXACT
+    per-micro-batch policy (reference ``pipe.py:354``): except_last remats
+    chunks-1 of chunks micro-batches. Only the per-layer term remats: the
+    policy wraps the stage body, not embed/decoder.
+
+    ``cfg`` is duck-typed (``d_model``/``d_ff``/``n_layers``/``vocab``/
+    ``seq_len``/``causal``) so obs does not import the model zoo.
+    """
+    d, ff, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
+    eff_s = cfg.seq_len / 2 if cfg.causal else cfg.seq_len
+    layer_macs = L * (4 * d * d + 2 * d * ff + 2 * eff_s * d)
+    macs = layer_macs + d * V
+    remat = {"never": 0.0, "except_last": (chunks - 1) / chunks,
+             "always": 1.0}[checkpoint]
+    required = 2 * macs * 3
+    hardware = required + 2 * layer_macs * remat
+    return required, hardware
+
+
+# bf16 peak FLOP/s per chip by device kind (dense; conservative defaults).
+_PEAK_BF16 = (
+    ("v6", 918e12),     # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),  # device_kind "TPU v5 lite" (v5e)
+    ("v5lite", 197e12),
+    ("v4", 275e12),
+)
+
+
+def peak_flops_per_chip() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, peak in _PEAK_BF16:
+        if tag in kind:
+            return peak
+    return 197e12  # unknown kind: assume v5e-class
+
+
+def device_memory_peaks() -> Dict[str, Dict[str, int]]:
+    """Per-device ``memory_stats()`` peaks ({} per device on backends that
+    do not report, e.g. the virtual CPU platform)."""
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    for dev in jax.local_devices():
+        stats = getattr(dev, "memory_stats", lambda: None)() or {}
+        out[str(dev)] = {k: stats[k] for k in
+                         ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                         if k in stats}
+    return out
+
+
+# --------------------------------------------------------------------------
+# StepReport
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StepReport:
+    """One step's telemetry, folded to the committed BENCH_*.json fields.
+
+    ``compute`` derives throughput and MFU/HFU from raw timings;
+    ``to_json`` emits the artifact-schema dict (``metric``/``value``/
+    ``unit`` head keys, then the context fields every round carries).
+    """
+
+    step: int
+    wall_sec: float
+    tokens: int
+    n_stages: int = 1
+    chunks: int = 1
+    checkpoint: str = "never"
+    schedule: Optional[str] = None
+    loss: Optional[float] = None
+    tokens_per_sec: float = 0.0
+    tokens_per_sec_per_chip: float = 0.0
+    mfu: Optional[float] = None
+    hfu: Optional[float] = None
+    analytic_bubble: Optional[float] = None
+    measured_bubble: Optional[float] = None
+    measured_bubble_method: Optional[str] = None
+    memory: Dict[str, Dict[str, int]] = dataclasses.field(default_factory=dict)
+    compile_inclusive: bool = False
+    platform: Optional[str] = None
+    device_kind: Optional[str] = None
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def compute(cls, *, step: int, wall_sec: float, tokens: int,
+                n_stages: int = 1, chunks: int = 1,
+                checkpoint: str = "never", schedule: Optional[str] = None,
+                loss: Optional[float] = None, model_cfg=None,
+                analytic_bubble: Optional[float] = None,
+                measured_bubble: Optional[float] = None,
+                measured_bubble_method: Optional[str] = None,
+                memory: Optional[Dict[str, Dict[str, int]]] = None,
+                compile_inclusive: bool = False,
+                peak_flops: Optional[float] = None,
+                platform: Optional[str] = None,
+                device_kind: Optional[str] = None,
+                **extra: Any) -> "StepReport":
+        """Fold raw timings into derived rates. ``model_cfg`` (an LMConfig-
+        shaped object) enables MFU/HFU via :func:`train_flops_per_token`;
+        ``peak_flops`` overrides :func:`peak_flops_per_chip` (pass it to
+        avoid a device lookup, e.g. in synthetic tests)."""
+        tps = tokens / wall_sec if wall_sec > 0 else 0.0
+        mfu = hfu = None
+        if model_cfg is not None and wall_sec > 0:
+            req_tok, hw_tok = train_flops_per_token(model_cfg, checkpoint,
+                                                    chunks)
+            peak = peak_flops if peak_flops is not None \
+                else peak_flops_per_chip()
+            per_chip = tps / max(n_stages, 1)
+            mfu = (req_tok * per_chip) / peak
+            hfu = (hw_tok * per_chip) / peak
+        return cls(step=step, wall_sec=wall_sec, tokens=tokens,
+                   n_stages=n_stages, chunks=chunks, checkpoint=checkpoint,
+                   schedule=schedule, loss=loss, tokens_per_sec=tps,
+                   tokens_per_sec_per_chip=tps / max(n_stages, 1),
+                   mfu=mfu, hfu=hfu, analytic_bubble=analytic_bubble,
+                   measured_bubble=measured_bubble,
+                   measured_bubble_method=measured_bubble_method,
+                   memory=memory or {}, compile_inclusive=compile_inclusive,
+                   platform=platform, device_kind=device_kind, extra=extra)
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "metric": "train_tokens_per_sec_per_chip",
+            "value": round(self.tokens_per_sec_per_chip, 2),
+            "unit": "tokens/s/chip",
+            "step": self.step,
+            "wall_sec": round(self.wall_sec, 6),
+            "tokens": self.tokens,
+            "tokens_per_sec": round(self.tokens_per_sec, 2),
+            "n_stages": self.n_stages,
+            "chunks": self.chunks,
+            "checkpoint": self.checkpoint,
+            "schedule": self.schedule,
+            "mfu": round(self.mfu, 4) if self.mfu is not None else None,
+            "hfu": round(self.hfu, 4) if self.hfu is not None else None,
+            "analytic_bubble": (round(self.analytic_bubble, 4)
+                                if self.analytic_bubble is not None else None),
+            "measured_bubble": (round(self.measured_bubble, 4)
+                                if self.measured_bubble is not None else None),
+            "measured_bubble_method": self.measured_bubble_method,
+            "final_loss": (round(self.loss, 4)
+                           if self.loss is not None else None),
+            "memory": self.memory,
+            "compile_inclusive": self.compile_inclusive,
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+        }
+        out.update(self.extra)
+        return out
+
+    def scalar_items(self) -> List[Tuple[str, float]]:
+        """(tag, value) pairs for a ``ScalarWriter`` sink."""
+        items: List[Tuple[str, float]] = [
+            ("telemetry/tokens_per_sec", self.tokens_per_sec),
+            ("telemetry/ms_step", self.wall_sec * 1e3),
+        ]
+        if self.loss is not None:
+            items.append(("telemetry/loss", self.loss))
+        if self.mfu is not None:
+            items.append(("telemetry/mfu", self.mfu))
+        if self.hfu is not None:
+            items.append(("telemetry/hfu", self.hfu))
+        if self.analytic_bubble is not None:
+            items.append(("telemetry/analytic_bubble", self.analytic_bubble))
+        if self.measured_bubble is not None:
+            items.append(("telemetry/measured_bubble", self.measured_bubble))
+        for dev, stats in self.memory.items():
+            if "peak_bytes_in_use" in stats:
+                items.append((f"telemetry/peak_gib/{dev}",
+                              stats["peak_bytes_in_use"] / 2 ** 30))
+        return items

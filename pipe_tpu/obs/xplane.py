@@ -1,0 +1,229 @@
+"""Minimal XSpace (xplane.pb) reader — no proto toolchain required.
+
+``jax.profiler`` traces serialize as XSpace protos; reading them back
+normally needs ``jax.profiler.ProfileData`` (absent on older jax) or the
+tensorflow/tensorboard proto stack (absent here by design — the repo's
+observability layer is dependency-free, see ``tb_writer.py`` which hand-
+ENCODES the TB event protos). This module is the decoding mirror: a wire-
+format parser for exactly the XSpace fields the timeline tools read —
+
+* ``XSpace.planes`` (1) → ``XPlane``: ``name`` (2), ``lines`` (3),
+  ``event_metadata`` (4, map<int64, XEventMetadata>);
+* ``XLine``: ``name`` (2), ``timestamp_ns`` (3), ``events`` (4);
+* ``XEvent``: ``metadata_id`` (1), ``offset_ps`` (2), ``duration_ps`` (3);
+* ``XEventMetadata``: ``id`` (1), ``name`` (2), ``display_name`` (4).
+
+Event start times are absolute nanoseconds (``line.timestamp_ns +
+offset_ps/1000``), matching ``ProfileData``'s ``start_ns`` convention, so
+:mod:`.meters` and ``tools/timeline_report.py`` see one interface on every
+jax version.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Tuple
+
+__all__ = ["TraceEvent", "TraceLine", "TracePlane", "parse_xspace",
+           "load_trace_planes", "encode_xspace"]
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    name: str
+    start_ns: float
+    duration_ns: float
+
+    @property
+    def end_ns(self) -> float:
+        return self.start_ns + self.duration_ns
+
+
+@dataclasses.dataclass
+class TraceLine:
+    name: str
+    timestamp_ns: int
+    events: List[TraceEvent]
+
+
+@dataclasses.dataclass
+class TracePlane:
+    name: str
+    lines: List[TraceLine]
+
+
+# --- protobuf wire-format primitives ---------------------------------------
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
+    """Yield ``(field_number, wire_type, payload)`` triples; varint payloads
+    arrive pre-decoded as ints re-encoded positionally (returned raw int)."""
+    pos, n = 0, len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 0x7
+        if wire == 0:                       # varint
+            val, pos = _read_varint(buf, pos)
+            yield field, wire, val
+        elif wire == 1:                     # fixed64
+            yield field, wire, buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:                     # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            yield field, wire, buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:                     # fixed32
+            yield field, wire, buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire} at {pos}")
+
+
+def _parse_event(buf: bytes) -> Tuple[int, int, int]:
+    metadata_id = offset_ps = duration_ps = 0
+    for field, wire, val in _fields(buf):
+        if field == 1 and wire == 0:
+            metadata_id = val
+        elif field == 2 and wire == 0:
+            offset_ps = val
+        elif field == 3 and wire == 0:
+            duration_ps = val
+    return metadata_id, offset_ps, duration_ps
+
+
+def _parse_line(buf: bytes) -> Tuple[str, int, List[Tuple[int, int, int]]]:
+    name, timestamp_ns, events = "", 0, []
+    for field, wire, val in _fields(buf):
+        if field == 2 and wire == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 3 and wire == 0:
+            timestamp_ns = val
+        elif field == 4 and wire == 2:
+            events.append(_parse_event(val))
+    return name, timestamp_ns, events
+
+
+def _parse_event_metadata(buf: bytes) -> Tuple[int, str]:
+    mid, name, display = 0, "", ""
+    for field, wire, val in _fields(buf):
+        if field == 1 and wire == 0:
+            mid = val
+        elif field == 2 and wire == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 4 and wire == 2:
+            display = val.decode("utf-8", "replace")
+    return mid, display or name
+
+
+def _parse_metadata_entry(buf: bytes) -> Tuple[int, str]:
+    """One map<int64, XEventMetadata> entry (key=1, value=2)."""
+    key, name = 0, ""
+    for field, wire, val in _fields(buf):
+        if field == 1 and wire == 0:
+            key = val
+        elif field == 2 and wire == 2:
+            mid, name = _parse_event_metadata(val)
+            key = key or mid
+    return key, name
+
+
+def _parse_plane(buf: bytes) -> TracePlane:
+    name = ""
+    raw_lines: List[Tuple[str, int, List[Tuple[int, int, int]]]] = []
+    metadata: Dict[int, str] = {}
+    for field, wire, val in _fields(buf):
+        if field == 2 and wire == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 3 and wire == 2:
+            raw_lines.append(_parse_line(val))
+        elif field == 4 and wire == 2:
+            key, mname = _parse_metadata_entry(val)
+            metadata[key] = mname
+    lines = []
+    for lname, ts, raw_events in raw_lines:
+        events = [TraceEvent(name=metadata.get(mid, f"metadata:{mid}"),
+                             start_ns=ts + off_ps / 1e3,
+                             duration_ns=dur_ps / 1e3)
+                  for mid, off_ps, dur_ps in raw_events]
+        lines.append(TraceLine(name=lname, timestamp_ns=ts, events=events))
+    return TracePlane(name=name, lines=lines)
+
+
+def parse_xspace(data: bytes) -> List[TracePlane]:
+    """Parse one serialized XSpace into its planes."""
+    return [_parse_plane(val) for field, wire, val in _fields(data)
+            if field == 1 and wire == 2]
+
+
+# --- encoder (synthetic traces for tests and offline fixtures) -------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(num: int, wire: int, payload: bytes) -> bytes:
+    return _varint(num << 3 | wire) + payload
+
+
+def _msg(num: int, payload: bytes) -> bytes:
+    return _field(num, 2, _varint(len(payload)) + payload)
+
+
+def encode_xspace(planes: List[TracePlane]) -> bytes:
+    """Serialize planes back to XSpace wire format (inverse of
+    :func:`parse_xspace`, same field subset). Lets tests and fixtures
+    fabricate device planes without a real TPU capture."""
+    out = bytearray()
+    for plane in planes:
+        names = {}
+        for line in plane.lines:
+            for ev in line.events:
+                names.setdefault(ev.name, len(names) + 1)
+        pbuf = bytearray(_msg(2, plane.name.encode()))
+        for line in plane.lines:
+            lbuf = bytearray(_msg(2, line.name.encode()))
+            lbuf += _field(3, 0, _varint(line.timestamp_ns))
+            for ev in line.events:
+                ebuf = (_field(1, 0, _varint(names[ev.name]))
+                        + _field(2, 0, _varint(
+                            int((ev.start_ns - line.timestamp_ns) * 1e3)))
+                        + _field(3, 0, _varint(int(ev.duration_ns * 1e3))))
+                lbuf += _msg(4, bytes(ebuf))
+            pbuf += _msg(3, bytes(lbuf))
+        for name, mid in names.items():
+            meta = _field(1, 0, _varint(mid)) + _msg(2, name.encode())
+            entry = _field(1, 0, _varint(mid)) + _msg(2, meta)
+            pbuf += _msg(4, entry)
+        out += _msg(1, bytes(pbuf))
+    return bytes(out)
+
+
+def load_trace_planes(logdir: str) -> List[TracePlane]:
+    """All planes from every ``*.xplane.pb`` under a ``profile_trace``
+    capture directory (one file per host per session)."""
+    planes: List[TracePlane] = []
+    for root, _, files in os.walk(logdir):
+        for fname in sorted(files):
+            if fname.endswith(".xplane.pb"):
+                with open(os.path.join(root, fname), "rb") as f:
+                    planes.extend(parse_xspace(f.read()))
+    return planes

@@ -48,6 +48,7 @@ from ..core import microbatch as mb
 from ..core.packing import PackPlan, StageParamPack
 from ..core.partition import StageCtx
 from ..core.schedule import Schedule, get_schedule
+from ..obs.telemetry import get_registry
 from .mesh import DATA_AXIS, STAGE_AXIS
 from .scheduled import ScheduledPipeline
 
@@ -86,7 +87,16 @@ class HeteroScheduledPipeline:
         self.checkpoint = checkpoint
         self.has_data = DATA_AXIS in mesh.axis_names
         self.n_data = mesh.shape[DATA_AXIS] if self.has_data else 1
+        # Collective axis the runtime StageCtx carries (scheduled.py sets
+        # the same on its contexts): batch-stat psums reduce over the data
+        # axis only when it is real (> 1 replica).
+        self.bn_axis = DATA_AXIS if self.has_data and self.n_data > 1 \
+            else None
         self.param_pack: Optional[StageParamPack] = None
+        # uniform-fastpath verdict cache: (param treedefs, boundary shapes,
+        # train) → bool, so the O(S) per-partition re-trace + const
+        # comparisons (with their host syncs) run once per configuration.
+        self._uniform_cache: Dict[Any, bool] = {}
         # Deferred-BN stat lanes through the op tables (reference
         # batchnorm.py capability, pipe.py:341-342) — mirrors hetero.py
         from ..extras.norm import BatchNorm, DeferredBatchNorm
@@ -171,6 +181,23 @@ class HeteroScheduledPipeline:
         equal closure constants. Checked at trace time; any failure falls
         back to the switch, so arbitrary partitions are never wrong — just
         not specialized.
+
+        The probe's StageCtx mirrors the runtime one the executor builds
+        (same ``train`` flag, same ``data_axis`` collective name), except
+        ``stage``, pinned to 0. That pin is the probe's one ASSUMPTION:
+        ``apply`` must not Python-branch on ``ctx.stage`` (e.g.
+        ``if ctx.stage == 3: extra_op()``) — such a module would trace
+        identically at stage 0 yet compute per-stage-different functions,
+        and the fast path would wrongly collapse them into one branch. No
+        Partition in the repo reads ``ctx.stage`` (the executor threads it
+        for the switch itself); a ``data_axis`` collective inside ``apply``
+        fails the unbound-axis trace here and falls back to the switch —
+        conservative, never wrong.
+
+        The verdict is cached per (param treedefs, boundary shapes, train):
+        the O(S) re-trace plus per-const host syncs run once per
+        configuration, not once per jit retrace (cache hits/misses are
+        counted in the metrics registry).
         """
         if self.S == 1 or self.lane_keys or self.has_bn:
             return False
@@ -187,6 +214,21 @@ class HeteroScheduledPipeline:
         for plan in pack.plans[1:]:
             if [(tuple(s.shape), str(s.dtype)) for s in plan.specs] != row0:
                 return False
+        cache_key = (tuple(pack.treedefs),
+                     tuple(tuple(b) for b in bspecs), train)
+        cached = self._uniform_cache.get(cache_key)
+        if cached is not None:
+            get_registry().counter("pipe.uniform_probe.cache_hits").inc()
+            return cached
+        get_registry().counter("pipe.uniform_probe.cache_misses").inc()
+        verdict = self._probe_branches_uniform(low, train=train)
+        self._uniform_cache[cache_key] = verdict
+        return verdict
+
+    def _probe_branches_uniform(self, low, *, train: bool) -> bool:
+        """The uncached jaxpr-equality probe behind
+        :meth:`_branches_uniform` (which see)."""
+        pack = low["pack"]
         key_spec = jax.eval_shape(lambda: jax.random.key(0))
         in_specs = [jax.ShapeDtypeStruct(jnp.shape(sp),
                                          jnp.result_type(sp))
@@ -195,7 +237,8 @@ class HeteroScheduledPipeline:
         try:
             for s_idx, part in enumerate(self.partitions):
                 def fn(p, key, *vals, _part=part):
-                    ctx = StageCtx(key=key, train=train, stage=0)
+                    ctx = StageCtx(key=key, train=train, stage=0,
+                                   data_axis=self.bn_axis)
                     return _part.apply(p, *vals, ctx=ctx)
                 closed = jax.make_jaxpr(fn)(
                     pack.abstract_tree(self.row_of(s_idx)), key_spec,
@@ -215,6 +258,17 @@ class HeteroScheduledPipeline:
         except Exception:
             return False        # tracing hiccup: keep the general switch
         return True
+
+    def _record_fastpath(self, surface: str) -> None:
+        """Publish the dispatch decision: the ``pipe.uniform_fastpath``
+        gauge (1 = shared branch, 0 = lax.switch) plus per-path lowering
+        counters, so the silent fallback to the ~2x-slower switch path is
+        visible in any metrics snapshot."""
+        reg = get_registry()
+        reg.gauge("pipe.uniform_fastpath").set(int(self.uniform_fastpath))
+        reg.counter(f"pipe.lowerings.{surface}").inc()
+        reg.counter("pipe.lowerings.fastpath" if self.uniform_fastpath
+                    else "pipe.lowerings.switch").inc()
 
     def _discover_stats(self, pack, boundaries, spec_tracker):
         """Train-mode spec pass per partition discovering each virtual
@@ -450,6 +504,7 @@ class HeteroScheduledPipeline:
         branches = [make_branch(s_idx) for s_idx in range(self.S)]
 
         self.uniform_fastpath = self._branches_uniform(low, train=train)
+        self._record_fastpath("forward")
         if self.uniform_fastpath:
             def stage_fn(params_g, h, ctx, pops=None):
                 # uniform partitions: one shared branch, no lax.switch —
@@ -625,6 +680,7 @@ class HeteroScheduledPipeline:
         branches = [make_branch(s_idx) for s_idx in range(self.S)]
 
         self.uniform_fastpath = self._branches_uniform(low, train=True)
+        self._record_fastpath("loss_and_grad")
         if self.uniform_fastpath:
             def stage_fn(params_g, h, ctx, pops=None):
                 # uniform partitions: one shared branch, no lax.switch —

@@ -78,6 +78,7 @@ import dataclasses
 import functools
 import warnings
 from typing import Any, Callable, Optional
+from ..utils.compat import shard_map
 
 import jax
 import jax.numpy as jnp
@@ -90,6 +91,7 @@ from ..core.schedule import (BWD, FWD, IDLE, WGRAD, GPipeSchedule,
                              InterleavedOneFOneBSchedule, OneFOneBSchedule,
                              Schedule, get_schedule)
 from .mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
+from ..obs.telemetry import get_registry
 from ..utils.rng import make_key
 
 __all__ = ["ScheduledPipeline", "SplitBackwardStage", "SkipLanes"]
@@ -468,6 +470,12 @@ class ScheduledPipeline:
         m = x_leaves[0].shape[0]
         key = key if key is not None else make_key(0)
         data = DATA_AXIS if self.has_data_axis else None
+        # Lowering counters: these fire at TRACE time (this method runs
+        # inside the caller's jit trace), so they count compiles/retraces,
+        # not executions — a growing count on a steady workload is the
+        # compile-cache-miss signal.
+        get_registry().counter("scheduled.loss_and_grad.lowerings").inc()
+        get_registry().gauge("scheduled.cycles").set(self._cycles(m))
         # Total loss weight, computed OUTSIDE the device program (w is the
         # full global array here) and passed in replicated. Keeping this as
         # an in-program psum over the data axis made it the one SUBGROUP
@@ -502,7 +510,7 @@ class ScheduledPipeline:
         if self.stat_spec is not None:    # stats: psum'd in-program
             out_specs = out_specs + (
                 jax.tree_util.tree_map(lambda _: P(), self.stat_spec),)
-        run = jax.shard_map(
+        run = shard_map(
             functools.partial(self._device_program, m=m),
             mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False)
@@ -544,6 +552,7 @@ class ScheduledPipeline:
         m = x_leaves[0].shape[0]
         key = key if key is not None else make_key(0)
         data = DATA_AXIS if self.has_data_axis else None
+        get_registry().counter("scheduled.forward.lowerings").inc()
         out_fn = out_fn if out_fn is not None else (lambda h: h)
 
         def x_spec(l):
@@ -582,7 +591,7 @@ class ScheduledPipeline:
         if self.stat_spec is not None:   # stats: psum'd in-program
             out_specs = (out_specs, jax.tree_util.tree_map(
                 lambda _: P(), self.stat_spec))
-        run = jax.shard_map(
+        run = shard_map(
             functools.partial(self._device_forward, m=m, train=train,
                               out_fn=out_fn),
             mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
@@ -1289,8 +1298,10 @@ class ScheduledPipeline:
         d, v = self.n_stages, self.v
         S = self.n_virtual
         if d == 1 and self._use_static(m):
+            get_registry().counter("scheduled.program.static_unroll").inc()
             return self._device_program_static(
                 stage_params, pre_params, post_params, x, w, wsum, key, m=m)
+        get_registry().counter("scheduled.program.dynamic_scan").inc()
         j = jax.lax.axis_index(STAGE_AXIS)
         # This device's shard: [v, ...] — its interleave groups in order.
         params_dev = stage_params

@@ -10,7 +10,9 @@ and the analytic pipeline-bubble fraction (the BASELINE.md north-star).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import os
 import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional
@@ -23,6 +25,10 @@ import optax
 from ..core import microbatch as mb
 from ..core.schedule import bubble_fraction
 from ..models.transformer_lm import LMConfig, PipelinedLM
+from ..obs import events as ev
+from ..obs.meters import profile_trace
+from ..obs.telemetry import (StepReport, device_memory_peaks, get_registry,
+                             peak_flops_per_chip)
 from ..parallel.mesh import make_mesh
 from ..parallel.spmd import SpmdPipeline, stack_stage_params
 from ..data import lm_text
@@ -73,6 +79,15 @@ class TrainerConfig:
     # asserted in tests/test_prefetch.py). Falls back to inline assembly
     # when no C++ toolchain is available.
     prefetch_depth: int = 0
+    # Unified telemetry (docs/observability.md): directory receiving the
+    # structured JSONL event log (`events.jsonl` — step spans + per-step
+    # StepReport records) and periodic profiler traces. None disables —
+    # the loop then talks to no-op sinks (no file writes, no clock reads).
+    telemetry_dir: Optional[str] = None
+    # With telemetry_dir set: capture a profiler trace of one step every N
+    # steps into telemetry_dir/trace_step{N} (0 disables). Feed captures to
+    # tools/timeline_report.py for per-stage busy/idle attribution.
+    profile_every: int = 0
 
 
 class Trainer:
@@ -185,6 +200,17 @@ class Trainer:
             self.tb: Optional["ScalarWriter"] = ScalarWriter(cfg.tb_dir)
         else:
             self.tb = None
+        # Telemetry sinks: the process-local registry (cheap counters the
+        # executors also feed) and the structured event log. With no
+        # telemetry_dir the event log is the shared null sink — call sites
+        # stay unconditional, writes cost nothing.
+        self.registry = get_registry()
+        if cfg.telemetry_dir is not None:
+            os.makedirs(cfg.telemetry_dir, exist_ok=True)
+            self.events: Any = ev.EventLog(
+                os.path.join(cfg.telemetry_dir, "events.jsonl"))
+        else:
+            self.events = ev.NULL_EVENT_LOG
 
     # --- state ---
 
@@ -436,6 +462,16 @@ class Trainer:
         key = jax.random.fold_in(make_key(cfg.seed), epoch)
 
         tokens_per_step = cfg.batch_size * cfg.bptt
+        # Per-step telemetry: registry instruments are live regardless (a
+        # disabled registry hands back no-ops); StepReports and spans go to
+        # the JSONL event log only when telemetry_dir is configured.
+        telemetry_on = self.events is not ev.NULL_EVENT_LOG
+        step_timer = self.registry.timer("train.step_sec")
+        steps_ctr = self.registry.counter("train.steps")
+        tokens_ctr = self.registry.counter("train.tokens")
+        tps_gauge = self.registry.gauge("train.tokens_per_sec")
+        peak = peak_flops_per_chip() if telemetry_on else None
+        device_kind = jax.devices()[0].device_kind if telemetry_on else None
         t_first = t0 = time.perf_counter()
         losses = []
         w = None
@@ -444,21 +480,65 @@ class Trainer:
             # Row count is constant until the tail-batch break, so the valid-
             # row mask is too — build it once, not per step.
             w = mask if w is None else w
-            state, loss = self._step_fn(state, x, w,
-                                        jax.random.fold_in(key, b),
-                                        jnp.float32(lr))
-            # Virtual-CPU platform: serialize steps (see sync_if_forced_cpu —
-            # interleaved async runs livelock the collective rendezvous
-            # there). No-op on real TPU.
-            sync_if_forced_cpu(loss)
+            tracing = bool(telemetry_on and cfg.profile_every
+                           and (b + 1) % cfg.profile_every == 0)
+            t_step = time.perf_counter()
+            with contextlib.ExitStack() as scopes:
+                scopes.enter_context(self.events.span(ev.STEP, step=b,
+                                                      epoch=epoch))
+                if tracing:
+                    trace_dir = os.path.join(cfg.telemetry_dir,
+                                             f"trace_step{b + 1}")
+                    scopes.enter_context(profile_trace(trace_dir))
+                state, loss = self._step_fn(state, x, w,
+                                            jax.random.fold_in(key, b),
+                                            jnp.float32(lr))
+                # Virtual-CPU platform: serialize steps (see
+                # sync_if_forced_cpu — interleaved async runs livelock the
+                # collective rendezvous there). No-op on real TPU.
+                sync_if_forced_cpu(loss)
+                if tracing:
+                    jax.block_until_ready(loss)  # capture the whole step
+            wall = time.perf_counter() - t_step
+            step_timer.observe(wall)
+            steps_ctr.inc()
+            tokens_ctr.inc(tokens_per_step)
+            if wall > 0:
+                tps_gauge.set(tokens_per_step / wall)
             losses.append(loss)
+            at_log = bool(log_every and (b + 1) % log_every == 0)
+            if telemetry_on:
+                # Caveat: on async-dispatch backends per-step wall time is
+                # honest only at sync points (forced-CPU syncs every step;
+                # elsewhere log/trace steps sync). compile_inclusive marks
+                # the step-0 outlier.
+                if tracing:
+                    self.events.event("profile_trace", step=b,
+                                      path=trace_dir)
+                report = StepReport.compute(
+                    step=int(state.step), wall_sec=wall,
+                    tokens=tokens_per_step, n_stages=cfg.n_stages,
+                    chunks=cfg.chunks, checkpoint=cfg.checkpoint,
+                    schedule=cfg.schedule,
+                    loss=float(loss) if at_log else None,
+                    model_cfg=self.model_cfg,
+                    analytic_bubble=self.analytic_bubble(),
+                    memory=(device_memory_peaks()
+                            if at_log or b == 0 else {}),
+                    compile_inclusive=(b == 0), peak_flops=peak,
+                    platform=jax.default_backend(),
+                    device_kind=device_kind, epoch=epoch)
+                self.events.step_report(report)
+                if self.tb is not None and at_log:
+                    for tag, val in report.scalar_items():
+                        self.tb.add_scalar(tag, val, int(state.step))
             if self._autosave_pending():
                 self._autosave(state, log_fn)
                 break
             if b == 0:
                 float(loss)               # sync out the compile
                 t0 = time.perf_counter()  # steady-state timing from step 2
-            if log_every and (b + 1) % log_every == 0:
+            if at_log:
                 l = float(losses[-1])
                 # Steady-state ms/batch from step 2 on; the step-1 line has no
                 # steady-state sample yet, so it reports the compile-inclusive
@@ -487,6 +567,9 @@ class Trainer:
         if self.tb is not None and losses:
             self.tb.add_scalar("train/epoch_loss", final, int(state.step))
             self.tb.flush()
+        if telemetry_on:
+            self.events.metrics_snapshot(self.registry)
+            self.events.flush()
         # t0 was reset after step 0, so elapsed covers len(losses)-1 steps
         return state, {"loss": final,
                        "steps": len(losses),

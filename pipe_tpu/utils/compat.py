@@ -1,0 +1,59 @@
+"""Version tolerance for the narrow jax surface this framework binds.
+
+The framework targets current jax (``jax.shard_map``,
+``jax_num_cpu_devices``, ``jax.profiler.ProfileData``); CI containers and
+user sites often carry one stable release behind, where the same
+capabilities live under older names (``jax.experimental.shard_map`` with
+``check_rep``, ``--xla_force_host_platform_device_count``) or do not exist
+at all (xplane parsing). Every cross-version binding goes through here so
+call sites stay on the modern spelling and the fallback policy is written
+once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["shard_map", "profile_data", "set_num_cpu_devices"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` on current jax; the ``jax.experimental`` spelling
+    (with ``check_vma`` renamed to its predecessor ``check_rep``) on
+    releases that predate the public export."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def profile_data() -> Optional[Any]:
+    """``jax.profiler.ProfileData`` (xplane proto parsing) or None when
+    this jax cannot read traces back — callers degrade to their
+    timing-based fallbacks."""
+    try:
+        from jax.profiler import ProfileData  # type: ignore[attr-defined]
+        return ProfileData
+    except ImportError:
+        return None
+
+
+def set_num_cpu_devices(num_devices: int) -> None:
+    """Request ``num_devices`` virtual CPU devices, before backend init.
+
+    Current jax exposes this as the ``jax_num_cpu_devices`` config; older
+    releases only honor the XLA flag ``--xla_force_host_platform_device_
+    count``, which must be in ``XLA_FLAGS`` before the CPU client starts.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", num_devices)
+    except AttributeError:
+        import os
+        flag = f"--xla_force_host_platform_device_count={num_devices}"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = f"{flags} {flag}".strip()

@@ -28,23 +28,28 @@ def force_cpu_platform(num_devices: int = 8) -> None:
     after ``import jax``.
     """
     os.environ.setdefault("PIPE_TPU_FORCED_CPU", "1")
+    import jax
+    from jax._src import xla_bridge as xb
+
     # N virtual devices time-share the host cores (often ONE core in CI).
     # XLA:CPU's collective rendezvous hard-terminates the process when a
     # participant is >45s late — which a device legitimately is whenever its
     # pre-collective compute runs serialized behind 7 siblings. Give the
     # rendezvous real headroom; these flags must be set before backend init.
-    flags = os.environ.get("XLA_FLAGS", "")
-    for flag in ("xla_cpu_collective_timeout_seconds",
-                 "xla_cpu_collective_call_terminate_timeout_seconds"):
-        if flag not in flags:       # never override an operator's setting
-            flags = f"{flags} --{flag}=600".strip()
-    os.environ["XLA_FLAGS"] = flags
-    import jax
-    from jax._src import xla_bridge as xb
+    # Older XLA builds (no ``jax_num_cpu_devices`` config either) predate
+    # the flags AND abort on unknown XLA_FLAGS, so gate on the vintage.
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        for flag in ("xla_cpu_collective_timeout_seconds",
+                     "xla_cpu_collective_call_terminate_timeout_seconds"):
+            if flag not in flags:   # never override an operator's setting
+                flags = f"{flags} --{flag}=600".strip()
+        os.environ["XLA_FLAGS"] = flags
 
     xb._backend_factories.pop("axon", None)
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", num_devices)
+    from .compat import set_num_cpu_devices
+    set_num_cpu_devices(num_devices)
 
 
 def sync_if_forced_cpu(x):

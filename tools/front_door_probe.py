@@ -16,8 +16,8 @@ platform) times three programs on the same uniform 4-stage stack:
 * ``pipe-switch``    — the front door with the fast path disabled
   (round 4's program, kept honest via monkeypatch).
 
-One JSON line per program + a summary line with the tax ratios.
-Committed artifact: `FRONTDOOR_r05.json`.
+One JSON line per program + a summary line with the tax ratios
+(stdout only; redirect to keep a record).
 """
 
 from __future__ import annotations

@@ -1,0 +1,150 @@
+"""Per-stage busy/idle timeline from a ``profile_trace`` capture.
+
+``obs.meters.profile_trace`` (the Trainer's ``profile_every`` hook, or any
+manual ``with profile_trace(logdir):`` block) leaves ``*.xplane.pb`` files
+behind; ``obs.meters.stage_timeline_from_trace`` buckets their events by
+the ``chunk{i}-stage{j}`` named scopes the executors emit. This tool turns
+that into the measured counterpart of ``tools/schedule_viz.py``: one ASCII
+row per stage, busy buckets filled and idle visibly empty, with per-stage
+busy seconds and the measured bubble (idle fraction over the trace span) —
+rendered next to the analytic schedule table so the two can be eyeballed
+for agreement.
+
+Honest-fallback contract: device planes (``/device:*``) are preferred;
+host planes with scope tags are labeled as such; a capture with no tagged
+events at all (e.g. the virtual-CPU platform, whose python tracer records
+host frames only) degrades to the analytic picture plus an explanation,
+exit code 0 — a missing device plane is an expected environment, not an
+error.
+
+Usage:
+    python tools/timeline_report.py LOGDIR [--schedule 1f1b] [-m M] [-n N]
+        [--width 72] [--json out.json]
+
+``-m``/``-n`` default to what the trace itself shows (max chunk/stage
+tag + 1); pass them explicitly when the capture is partial.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from pipe_tpu.obs.meters import stage_timeline_from_trace
+
+import schedule_viz
+
+
+def _bucket_row(intervals: List[Tuple[float, float]], lo: float, hi: float,
+                width: int) -> str:
+    """Discretize merged busy intervals into ``width`` buckets over
+    [lo, hi): '#' mostly busy (>=50%), '+' partially, '.' idle."""
+    if hi <= lo:
+        return "." * width
+    step = (hi - lo) / width
+    busy = [0.0] * width
+    for s, e in intervals:
+        b0 = max(0, int((s - lo) / step))
+        b1 = min(width - 1, int((e - lo) / step))
+        for b in range(b0, b1 + 1):
+            cell_lo, cell_hi = lo + b * step, lo + (b + 1) * step
+            busy[b] += max(0.0, min(e, cell_hi) - max(s, cell_lo))
+    return "".join("#" if f >= 0.5 * step else "+" if f > 0 else "."
+                   for f in busy)
+
+
+def summarize(timeline: Dict[str, object], schedule: str,
+              m: int, n: int) -> Dict[str, object]:
+    """Machine-readable report: measured per-stage busy plus the analytic
+    bubble for the same (schedule, m, n) geometry."""
+    lo, hi = timeline["span"]
+    span_sec = max(hi - lo, 0.0) / 1e9
+    stages = timeline["stages"]
+    measured = None
+    if stages and span_sec > 0:
+        busy = sum(s["busy_sec"] for s in stages.values())
+        measured = 1.0 - busy / (span_sec * len(stages))
+    analytic = schedule_viz.make_schedule(schedule).bubble(m, n)
+    return {
+        "source": timeline["source"],
+        "span_sec": span_sec,
+        "schedule": schedule, "chunks": m, "n_stages": n,
+        "analytic_bubble": analytic,
+        "measured_bubble": measured,
+        "stages": {int(j): {"busy_sec": s["busy_sec"],
+                            "chunks": {int(i): v
+                                       for i, v in s["chunks"].items()}}
+                   for j, s in stages.items()},
+    }
+
+
+def render(timeline: Dict[str, object], summary: Dict[str, object],
+           width: int) -> str:
+    lines = []
+    src = timeline["source"]
+    if src is None:
+        lines.append("no chunk{i}-stage{j} tagged events in this capture.")
+        lines.append("(Expected on CPU: jaxlib's python tracer records host")
+        lines.append(" frames only — capture on a real accelerator to get")
+        lines.append(" /device:* planes with XLA op names.)")
+        return "\n".join(lines)
+    lo, hi = timeline["span"]
+    span_sec = summary["span_sec"]
+    hdr = (f"measured timeline  source={src}  span={span_sec * 1e3:.2f}ms")
+    if summary["measured_bubble"] is not None:
+        hdr += (f"  measured_bubble={summary['measured_bubble']:.1%}"
+                f"  analytic={summary['analytic_bubble']:.1%}")
+    if src == "host":
+        hdr += "  [host plane: wall-clock upper bound, not device busy]"
+    lines.append(hdr)
+    for j, s in sorted(timeline["stages"].items()):
+        row = _bucket_row(s["intervals"], lo, hi, width)
+        frac = s["busy_sec"] / span_sec if span_sec > 0 else 0.0
+        lines.append(f"stage {j}|".rjust(9) + row
+                     + f"| busy {s['busy_sec'] * 1e3:8.2f}ms ({frac:5.1%})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logdir", help="profile_trace output directory")
+    p.add_argument("--schedule", default="1f1b",
+                   choices=["gpipe", "1f1b", "zb-h1", "interleaved-1f1b"])
+    p.add_argument("-m", type=int, default=None,
+                   help="micro-batches (default: inferred from the trace)")
+    p.add_argument("-n", type=int, default=None,
+                   help="stages (default: inferred from the trace)")
+    p.add_argument("--width", type=int, default=72,
+                   help="timeline buckets per row")
+    p.add_argument("--json", default=None,
+                   help="also write the machine-readable summary here")
+    args = p.parse_args(argv)
+
+    timeline = stage_timeline_from_trace(args.logdir)
+    stages = timeline["stages"]
+    n = args.n or (max(stages) + 1 if stages else 1)
+    m = args.m or (max((max(s["chunks"], default=0)
+                        for s in stages.values()), default=0) + 1
+                   if stages else 1)
+    summary = summarize(timeline, args.schedule, m, n)
+
+    print(render(timeline, summary, args.width))
+    print()
+    print("analytic schedule for the same geometry:")
+    print(schedule_viz.ascii_timeline(args.schedule, m, n))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+        print(f"\nwrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
