@@ -219,6 +219,15 @@ def time_steps(step_fn, params, opt_state, args, warmup=2, iters=8):
 
 
 def main():
+    # Hard-disable telemetry for every program this process times: the
+    # null registry hands back shared no-op instruments, so not even
+    # trace-time counter bumps ride the bench hot path, and the claim
+    # "disabled telemetry is zero-cost here" is enforced rather than
+    # assumed (tests/test_overlap_transport.py pins the lowered HLO of a
+    # step as byte-identical under default vs null registry).
+    from pipe_tpu.obs.telemetry import null_registry, set_registry
+    set_registry(null_registry())
+
     platform = jax.default_backend()
     n_chips = jax.device_count()
     cfg = tutorial_config(platform)
@@ -345,15 +354,18 @@ def main():
             print(f"bubble slope timing failed: {e}", file=sys.stderr)
 
     # Multi-stage measured bubble: the one real chip cannot host a ppermute
-    # ring, so probe a 4-stage pipeline on the virtual 8-CPU mesh.
+    # ring, so probe a 4-stage pipeline on the virtual 8-CPU mesh — the
+    # quick mode of the multistage probe, which also records serialized vs
+    # packed-overlapped boundary transport side by side every round.
+    here = os.path.dirname(os.path.abspath(__file__))
     bubble_multistage = None
     try:
         import subprocess
-        env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
         out = subprocess.run(
-            [sys.executable, "-m", "pipe_tpu.obs.bubble_probe", "4", "8",
-             "--schedules"],
+            [sys.executable, os.path.join(here, "tools",
+                                          "multistage_probe.py"),
+             "--quick", "4", "8"],
             capture_output=True, text=True, timeout=900, env=env)
         if out.returncode == 0:
             bubble_multistage = json.loads(out.stdout.strip().splitlines()[-1])
@@ -362,6 +374,31 @@ def main():
                   f"{out.stderr[-2000:]}", file=sys.stderr)
     except Exception as e:
         print(f"multi-stage bubble probe failed: {e}", file=sys.stderr)
+
+    # Front-door adapter tax (Pipe(mesh=) vs raw executor), tracked every
+    # round: the probe's last stdout line is its summary with the
+    # tax_*_vs_raw ratios (cpu8 — the TPU chip is busy being the headline).
+    front_door_tax = None
+    try:
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "front_door_probe.py")],
+            capture_output=True, text=True, timeout=900, env=env)
+        if out.returncode == 0:
+            summary = json.loads(out.stdout.strip().splitlines()[-1])
+            front_door_tax = {
+                "tax_uniform_vs_raw": summary["tax_uniform_vs_raw"],
+                "tax_switch_vs_raw": summary["tax_switch_vs_raw"],
+                "raw_sec_per_step":
+                    summary["results"]["raw"]["sec_per_step"],
+            }
+        else:
+            print(f"front-door probe rc={out.returncode}: "
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"front-door probe failed: {e}", file=sys.stderr)
 
     # vs_baseline denominator = the FASTER of the two honest accumulation
     # programs (see make_plain_step), so the ratio never flatters the
@@ -437,6 +474,7 @@ def main():
                             if measured_bubble is not None else None),
         "measured_bubble_method": bubble_method,
         "measured_bubble_multistage": bubble_multistage,
+        "front_door_tax": front_door_tax,
         "final_loss": round(loss, 4),
         "step_report": report.to_json(),
         "config": dataclasses.asdict(

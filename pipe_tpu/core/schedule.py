@@ -37,6 +37,9 @@ __all__ = [
     "WGRAD",
     "ZeroBubbleSchedule",
     "verify_zb_op_tables",
+    "shift_comm_tables",
+    "verify_shifted_op_tables",
+    "overlap_fifo_capacity",
 ]
 
 # Op codes for the (cycle, stage) tables driving the manual fwd+bwd executor
@@ -395,13 +398,27 @@ def verify_interleaved_op_tables(op, mbi, grp, m: int, d: int,
 
 
 def verify_op_tables(op: np.ndarray, mbi: np.ndarray, m: int, n: int,
-                     stash_slots: Optional[int] = None) -> None:
+                     stash_slots: Optional[int] = None,
+                     comm_shift: int = 1) -> None:
     """Check the :meth:`Schedule.op_tables` invariants (see docstring there).
 
     A table passing this check — *including* the stash-capacity check, so
     pass the schedule's ``stash_slots(m, n)`` — executes correctly on the
     manual executor; new schedules only need to emit valid tables.
+
+    ``comm_shift`` selects the transport contract the table is proved
+    against. ``1`` (default) is the serialized contract: a boundary value
+    sent at cycle ``t`` is consumable at ``t + 1``, and the reverse ring is
+    rigid (``BWD(i, j) == BWD(i, j+1) + 1`` exactly). ``>= 2`` is the
+    overlapped (software-pipelined) contract of
+    :func:`verify_shifted_op_tables`: sends fly while the next cycle
+    computes, so every receive must land ``comm_shift`` cycles after its
+    send and the reverse ring becomes an elastic receive FIFO.
     """
+    if comm_shift > 1:
+        verify_shifted_op_tables(op, mbi, None, m=m, d=n, v=1,
+                                 hop=comm_shift, stash_slots=stash_slots)
+        return
     t_fwd = np.full((m, n), -1)
     t_bwd = np.full((m, n), -1)
     for t in range(op.shape[0]):
@@ -644,6 +661,208 @@ def verify_zb_op_tables(op: np.ndarray, mbi: np.ndarray, m: int, n: int,
             for i in range(m - Wg):
                 assert t_b[i + Wg, j] > t_w[i, j], \
                     f"wstash slot clobber at stage {j}, mb {i}"
+
+
+# ---------------------------------------------------------------------------
+# Overlapped (software-pipelined) transport: comm slots shifted vs compute
+# ---------------------------------------------------------------------------
+#
+# The serialized executors issue their boundary ppermutes at the END of each
+# scan body, and the value is consumable one cycle later — comm sits on the
+# critical path between producer and consumer cycles. Overlapped transport
+# instead permutes the PREVIOUS cycle's packed boundary buffer at the START
+# of a body (no data dependency on that body's compute), parks the arrival
+# into a receive FIFO after the compute has read the old carry, and makes it
+# readable one body later still. A value produced at cycle t is therefore
+# first consumable at t + 2: every cross-stage edge costs ``hop`` (= 2)
+# cycles, and a serialized table must be *re-timed* before it can drive the
+# overlapped executor. The functions below are that retiming pass and its
+# proof obligations.
+
+
+def _times_by_code(op, mbi, grp, m, d, v):
+    """``(t_fwd, t_bwd, t_w)[m, v*d]`` from op tables; ``grp=None`` reads a
+    stage-major table (column p IS the stage, v == 1). Unscheduled ops stay
+    ``-1``; each (op, i, s) may appear at most once."""
+    S = v * d
+    times = {FWD: np.full((m, S), -1), BWD: np.full((m, S), -1),
+             WGRAD: np.full((m, S), -1)}
+    for t in range(op.shape[0]):
+        for p in range(op.shape[1]):
+            code = int(op[t, p])
+            if code == IDLE:
+                continue
+            s = (int(grp[t, p]) * d + p) if grp is not None else p
+            i = int(mbi[t, p])
+            assert times[code][i, s] == -1, (t, p)
+            times[code][i, s] = t
+    return times[FWD], times[BWD], times[WGRAD]
+
+
+def shift_comm_tables(op, mbi, grp=None, *, m: int, d: int, v: int = 1,
+                      hop: int = 2):
+    """Re-time a verified serialized table to the overlapped-transport
+    contract; returns ``(op, mb, grp)`` device tables.
+
+    Ops are visited in (cycle, device) order — every dependency's producer
+    has a strictly smaller original cycle, so one in-order pass suffices —
+    and each is assigned the earliest cycle satisfying:
+
+    * one op per device per cycle, in the ORIGINAL per-device order
+      (order preservation makes the pass collision-free by construction and
+      keeps each device's accumulation order, hence bitwise results,
+      identical to the serialized run);
+    * ``FWD(i, s) >= FWD(i, s-1) + hop`` — the activation parked from the
+      packed forward buffer is readable ``hop`` cycles after its send;
+    * ``BWD(i, s) >= BWD(i, s+1) + hop`` — the reverse ring becomes
+      *elastic*: cotangents land in a grad-park FIFO instead of being
+      consumed in place, so the rigid ``== + 1`` chain relaxes to an
+      inequality;
+    * ``BWD(i, s) > FWD(i, s)`` and ``WGRAD(i, s) > BWD(i, s)``.
+
+    ``d == 1`` has no transport and returns the input unchanged (plus a
+    zero ``grp`` if none was given).
+    """
+    grp_in = grp if grp is not None else np.zeros_like(op)
+    if d <= 1 or hop <= 1:
+        return op.copy(), mbi.copy(), grp_in.copy()
+    S = v * d
+    times = {FWD: np.full((m, S), -1), BWD: np.full((m, S), -1),
+             WGRAD: np.full((m, S), -1)}
+    last = np.full(op.shape[1], -1, np.int64)
+    events = []
+    for t in range(op.shape[0]):
+        for p in range(op.shape[1]):
+            code = int(op[t, p])
+            if code == IDLE:
+                continue
+            i = int(mbi[t, p])
+            g = int(grp_in[t, p])
+            s = g * d + p
+            lo = int(last[p]) + 1
+            if code == FWD:
+                if s > 0:
+                    lo = max(lo, int(times[FWD][i, s - 1]) + hop)
+            elif code == BWD:
+                lo = max(lo, int(times[FWD][i, s]) + 1)
+                if s + 1 < S:
+                    lo = max(lo, int(times[BWD][i, s + 1]) + hop)
+            else:  # WGRAD
+                lo = max(lo, int(times[BWD][i, s]) + 1)
+            times[code][i, s] = lo
+            last[p] = lo
+            events.append((lo, p, code, i, g))
+    T2 = int(last.max()) + 1
+    op2 = np.full((T2, op.shape[1]), IDLE, np.int32)
+    mbi2 = np.zeros((T2, op.shape[1]), np.int32)
+    grp2 = np.zeros((T2, op.shape[1]), np.int32)
+    for t2, p, code, i, g in events:
+        op2[t2, p], mbi2[t2, p], grp2[t2, p] = code, i, g
+    return op2, mbi2, grp2
+
+
+def _check_overlap_windows(arrive, read, K: int, what: str) -> None:
+    """Slot-clobber proof under park-after-compute semantics: value ``a``
+    parked at ``arrive[a]`` into slot ``a % K`` must survive through its
+    last read ``read[a]`` (a read at cycle t sees parks <= t - 1, so a park
+    AT the read cycle is safe). ``arrive < 0`` marks entries with no
+    arrival (e.g. stage 0) and is skipped."""
+    m = len(arrive)
+    for a in range(m):
+        if arrive[a] < 0:
+            continue
+        for b in range(m):
+            if b == a or arrive[b] < 0 or a % K != b % K:
+                continue
+            assert not (arrive[a] <= arrive[b] <= read[a] - 1), (
+                f"{what}: slot clobber — value {a} (parked t={arrive[a]}, "
+                f"last read t={read[a]}) overwritten by value {b} at "
+                f"t={arrive[b]} with {K} slots")
+
+
+def overlap_fifo_capacity(arrive, read) -> int:
+    """Smallest slot count K (slots ``i % K``) passing
+    :func:`_check_overlap_windows` for the given arrival/last-read cycles.
+    Makes no monotonicity assumption — GPipe's backward drains micro-batches
+    in DECREASING order, so grad-park arrivals are not FIFO in i."""
+    return overlap_joint_capacity([(arrive, read)], len(arrive))
+
+
+def overlap_joint_capacity(windows, m: int) -> int:
+    """Smallest K valid SIMULTANEOUSLY for every ``(arrive, read)`` window
+    set in ``windows``. The executor uses one slot count across all virtual
+    stages and park uses (slot ``g*K + i % K``), and clobber-freedom is not
+    monotone in K (``i % K`` sharing reshuffles as K grows), so the joint
+    minimum must be searched, not maxed over per-stage minima. ``K = m``
+    always passes (every micro-batch gets its own slot)."""
+    for K in range(1, m + 1):
+        try:
+            for arrive, read in windows:
+                _check_overlap_windows(arrive, read, K, "probe")
+        except AssertionError:
+            continue
+        return K
+    return m
+
+
+def verify_shifted_op_tables(op, mbi, grp=None, *, m: int, d: int,
+                             v: int = 1, hop: int = 2,
+                             splits_backward: bool = False,
+                             stash_slots: Optional[int] = None,
+                             grad_slots: Optional[int] = None,
+                             wstash_slots: Optional[int] = None) -> None:
+    """Prove an overlapped-transport table: every receive lands before its
+    consumer reads it, for any of the four schedule families (gpipe, 1f1b,
+    interleaved-1f1b via ``grp``/``v``, zb-h1 via ``splits_backward``).
+
+    Timing model (see module comment): a boundary value produced at cycle t
+    is permuted at t + 1 and parked after that body's compute, so its first
+    legal read is t + 2 (= ``hop``). Checks:
+
+    * each (i, s) runs FWD and BWD (and WGRAD iff ``splits_backward``)
+      exactly once, on the right device (``s % d``), one op per device per
+      cycle (table shape);
+    * ``FWD(i, s+1) >= FWD(i, s) + hop`` and ``BWD(i, s) >= BWD(i, s+1) +
+      hop`` — no consumer reads a value still in flight;
+    * ``BWD > FWD`` and ``WGRAD > BWD`` per (i, s);
+    * with capacities given, the park FIFOs never clobber a live value:
+      activations (arrive ``FWD(i, s-1) + 1``, last read = the micro-batch's
+      last op at s — conservative across recompute modes), grad park
+      (arrive ``BWD(i, s+1) + 1``, read at ``BWD(i, s)``), and the local
+      B→W cotangent stash for split-backward tables.
+    """
+    S = v * d
+    t_f, t_b, t_w = _times_by_code(op, mbi, grp, m, d, v)
+    assert (t_f >= 0).all() and (t_b >= 0).all(), "missing ops"
+    if splits_backward:
+        assert (t_w >= 0).all(), "missing W ops"
+    for i in range(m):
+        for s in range(S):
+            assert t_b[i, s] > t_f[i, s], f"B before F at {(i, s)}"
+            if splits_backward:
+                assert t_w[i, s] > t_b[i, s], f"W before B at {(i, s)}"
+            if s + 1 < S:
+                assert t_f[i, s + 1] >= t_f[i, s] + hop, (
+                    f"shifted comm slot violation: FWD({i},{s + 1}) at "
+                    f"t={t_f[i, s + 1]} consumes an activation sent at "
+                    f"t={t_f[i, s]} that is still in flight "
+                    f"(hop={hop})")
+                assert t_b[i, s] >= t_b[i, s + 1] + hop, (
+                    f"shifted comm slot violation: BWD({i},{s}) at "
+                    f"t={t_b[i, s]} consumes a gradient sent at "
+                    f"t={t_b[i, s + 1]} that is still in flight "
+                    f"(hop={hop})")
+    read_last = np.maximum(t_f, np.maximum(t_b, t_w))
+    for s in range(S):
+        if stash_slots is not None and s > 0:
+            _check_overlap_windows(t_f[:, s - 1] + 1, read_last[:, s],
+                                   stash_slots, f"stash (stage {s})")
+        if grad_slots is not None and s + 1 < S:
+            _check_overlap_windows(t_b[:, s + 1] + 1, t_b[:, s],
+                                   grad_slots, f"grad park (stage {s})")
+        if wstash_slots is not None and splits_backward:
+            _check_overlap_windows(t_b[:, s], t_w[:, s],
+                                   wstash_slots, f"wstash (stage {s})")
 
 
 _SCHEDULES = {

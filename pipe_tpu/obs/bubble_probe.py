@@ -1,11 +1,14 @@
 """Multi-stage measured-bubble probe on the virtual CPU mesh.
 
-``python -m pipe_tpu.obs.bubble_probe [n_stages] [chunks] [--schedules]``
-forces the 8-device CPU platform, times one compiled pipeline train step at
-``m`` and ``2m`` micro-batches (per-micro-batch work held constant), and
-prints one JSON line with the measured and analytic bubble; ``--schedules``
-adds head-to-head table-executor timings (1f1b vs zb-h1) with each table's
-analytic idle fraction. bench.py runs this as a
+``python -m pipe_tpu.obs.bubble_probe [n_stages] [chunks] [--schedules]
+[--transport]`` forces the 8-device CPU platform, times one compiled
+pipeline train step at ``m`` and ``2m`` micro-batches (per-micro-batch work
+held constant), and prints one JSON line with the measured and analytic
+bubble; ``--schedules`` adds head-to-head table-executor timings (1f1b vs
+zb-h1) with each table's analytic idle fraction, and ``--transport`` adds
+the packed overlapped-transport 1f1b row (with per-transport measured
+bubbles) next to the serialized one. bench.py runs this (via
+``tools/multistage_probe.py --quick``) as a
 subprocess so the single-chip TPU benchmark can still report a REAL
 multi-stage bubble measurement (VERDICT r1 #6: the reference author verified
 the schedule with profiler traces, ``/root/reference/README.md:559-567``;
@@ -22,7 +25,7 @@ import time
 def main(n_stages: int = 4, chunks: int = 8,
          compare_schedules: bool = False, d_model: int = 256,
          d_ff: int = 512, seq_len: int = 64, skip_slope: bool = False,
-         iters: int = 4) -> dict:
+         iters: int = 4, compare_transport: bool = False) -> dict:
     from pipe_tpu.utils.platform import force_cpu_platform
     force_cpu_platform(8)
 
@@ -98,10 +101,9 @@ def main(n_stages: int = 4, chunks: int = 8,
         # mesh carries real per-cycle machinery overhead, so the analytic
         # column is the schedule property and the seconds are the honest
         # end-to-end number on THIS platform.
+        from pipe_tpu.obs.meters import measured_bubble_slope
         from pipe_tpu.parallel.scheduled import ScheduledPipeline
 
-        x, n_rows = make_batch(m)
-        w = mb.valid_row_mask(x, n_rows)
         scheds = {}
         # "1f1b+policy" is the HEADLINE training program (BENCH_r03:
         # except_last + dots_saveable) running on the real multi-device
@@ -114,24 +116,45 @@ def main(n_stages: int = 4, chunks: int = 8,
                                  .dots_saveable)),
             ("zb-h1", dict(checkpoint="never", schedule="zb-h1")),
         ]
-        for name, kw_s in configs:
-            pipe = ScheduledPipeline(
-                mesh, model.stage_fn, pre_fn=model.pre_fn,
-                post_fn=model.loss_post_fn, **kw_s)
+        if compare_transport:
+            # Same workload with the packed, software-pipelined boundary
+            # transport forced on (auto keeps it off on cpu) — the
+            # serialized "1f1b" row next to it is the side-by-side the
+            # bench records every round.
+            configs.insert(1, ("1f1b-overlap",
+                               dict(checkpoint="never", schedule="1f1b",
+                                    overlap_transport=True)))
 
-            lg = jax.jit(lambda sp, pipe=pipe: pipe.loss_and_grad(
-                sp, prep, postp, x, w))
+        def step_time_sched(pipe, mm: int) -> float:
+            xx, nr = make_batch(mm)
+            ww = mb.valid_row_mask(xx, nr)
+            lg = jax.jit(lambda sp: pipe.loss_and_grad(
+                sp, prep, postp, xx, ww))
             jax.block_until_ready(lg(sp))
             t0 = time.perf_counter()
             for _ in range(iters):
                 out_lg = lg(sp)
             jax.block_until_ready(out_lg)
+            return (time.perf_counter() - t0) / iters
+
+        for name, kw_s in configs:
+            pipe = ScheduledPipeline(
+                mesh, model.stage_fn, pre_fn=model.pre_fn,
+                post_fn=model.loss_post_fn, **kw_s)
+            sec = step_time_sched(pipe, m)
             scheds[name] = {
-                "sec_per_step": round((time.perf_counter() - t0) / iters, 5),
+                "sec_per_step": round(sec, 5),
                 # __post_init__ already built the Schedule; reuse it
                 "analytic_bubble": round(
                     pipe.schedule.bubble(m, n_stages), 4),
             }
+            if compare_transport and name in ("1f1b", "1f1b-overlap"):
+                # per-transport measured bubble from the same m/2m slope
+                # the headline probe uses, but through the TABLE executor
+                # so comm/compute overlap shows up in the number
+                sec_2m = step_time_sched(pipe, 2 * m)
+                scheds[name]["measured_bubble"] = round(
+                    measured_bubble_slope(sec, sec_2m, m), 4)
         out["schedules"] = scheds
     return out
 
@@ -140,10 +163,11 @@ if __name__ == "__main__":
     args = sys.argv[1:]
     cmp_scheds = "--schedules" in args
     skip_slope = "--no-slope" in args
+    cmp_transport = "--transport" in args
     kw = {}
     pos = []
     for a in args:
-        if a in ("--schedules", "--no-slope"):
+        if a in ("--schedules", "--no-slope", "--transport"):
             continue
         if "=" in a and a.startswith("--"):
             k, v = a[2:].split("=", 1)
@@ -153,4 +177,5 @@ if __name__ == "__main__":
     n = int(pos[0]) if len(pos) > 0 else 4
     m = int(pos[1]) if len(pos) > 1 else 8
     print(json.dumps(main(n, m, compare_schedules=cmp_scheds,
-                          skip_slope=skip_slope, **kw)))
+                          skip_slope=skip_slope,
+                          compare_transport=cmp_transport, **kw)))

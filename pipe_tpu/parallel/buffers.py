@@ -17,8 +17,12 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["slot_buffer", "masked_slot_write", "drop_sentinel"]
+__all__ = [
+    "slot_buffer", "masked_slot_write", "drop_sentinel",
+    "packed_words", "pack_words", "unpack_words",
+]
 
 
 def slot_buffer(spec_tree, slots: int):
@@ -40,3 +44,93 @@ def masked_slot_write(buf_tree, val_tree, index, pred, sentinel: int):
 def drop_sentinel(buf_tree, slots: int):
     """The real slots: ``leaf[:slots]`` per leaf."""
     return jax.tree_util.tree_map(lambda b: b[:slots], buf_tree)
+
+
+# ---------------------------------------------------------------------------
+# Packed word carrier: one flat uint32 buffer per transport direction
+# ---------------------------------------------------------------------------
+#
+# The overlapped executors move each direction's whole boundary pytree
+# (activations + forward skip lanes; gradients + reverse lanes) as ONE
+# contiguous ``uint32[N]`` vector, so each scan cycle issues exactly one
+# ``ppermute`` per direction regardless of how many leaves, dtypes or lanes
+# ride along. Packing is a pure bitcast/reshape — bitwise exact for every
+# dtype (bf16 riding next to f32 loses nothing), no casts, no copies beyond
+# the concatenation XLA fuses into the collective's source buffer.
+#
+# Layout: leaves in ``tree_leaves`` order; each leaf is raveled, padded to a
+# whole number of 32-bit words, and bitcast to uint32. The layout is static
+# (shapes/dtypes known at trace time) so unpacking slices at fixed offsets.
+
+_WORD = 4  # bytes per packed word
+
+
+def _leaf_words(shape, dtype) -> int:
+    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    nbytes = size * np.dtype(dtype).itemsize
+    return -(-nbytes // _WORD)
+
+
+def packed_words(spec_tree) -> int:
+    """Total uint32 words ``pack_words`` produces for this spec (leaves need
+    only ``.shape``/``.dtype``)."""
+    return sum(_leaf_words(leaf.shape, leaf.dtype)
+               for leaf in jax.tree_util.tree_leaves(spec_tree))
+
+
+def _pack_leaf(x):
+    if x.dtype == jnp.bool_:
+        raise TypeError("pack_words: bool leaves have no defined bit "
+                        "layout; cast to uint8 first")
+    itemsize = np.dtype(x.dtype).itemsize
+    flat = x.reshape(-1)
+    if itemsize >= _WORD:
+        w = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+        return w.reshape(-1)
+    r = _WORD // itemsize
+    pad = (-flat.size) % r
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return jax.lax.bitcast_convert_type(flat.reshape(-1, r), jnp.uint32)
+
+
+def pack_words(tree):
+    """Pack a pytree of arrays into one flat ``uint32`` vector (bitwise)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((0,), jnp.uint32)
+    return jnp.concatenate([_pack_leaf(x) for x in leaves])
+
+
+def _unpack_leaf(words, shape, dtype):
+    itemsize = np.dtype(dtype).itemsize
+    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if itemsize >= _WORD:
+        k = itemsize // _WORD
+        x = jax.lax.bitcast_convert_type(
+            words.reshape(-1, k) if k > 1 else words, dtype)
+    else:
+        r = _WORD // itemsize
+        x = jax.lax.bitcast_convert_type(words, _uint_of(itemsize))
+        x = x.reshape(-1)[:size]
+        if x.dtype != np.dtype(dtype):
+            x = jax.lax.bitcast_convert_type(x, dtype)
+    return x.reshape(shape)
+
+
+def _uint_of(itemsize: int):
+    return {1: jnp.uint8, 2: jnp.uint16}[itemsize]
+
+
+def unpack_words(vec, spec_tree):
+    """Inverse of :func:`pack_words` given the (static) spec of the packed
+    tree; slices at fixed offsets, bitwise exact."""
+    leaves, treedef = jax.tree_util.tree_flatten(spec_tree)
+    out, off = [], 0
+    for leaf in leaves:
+        nw = _leaf_words(leaf.shape, np.dtype(leaf.dtype))
+        out.append(_unpack_leaf(
+            jax.lax.dynamic_slice_in_dim(vec, off, nw), tuple(leaf.shape),
+            np.dtype(leaf.dtype)))
+        off += nw
+    return jax.tree_util.tree_unflatten(treedef, out)

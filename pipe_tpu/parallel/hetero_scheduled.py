@@ -59,10 +59,17 @@ class HeteroScheduledPipeline:
     """Training executor lowering Pipe partitions onto schedule tables."""
 
     def __init__(self, mesh, partitions, skip_layout, chunks: int,
-                 checkpoint: str, schedule, remat_policy=None):
+                 checkpoint: str, schedule, remat_policy=None,
+                 overlap_transport=None):
         self.mesh = mesh
         self.d = mesh.shape[STAGE_AXIS]
         self.remat_policy = remat_policy
+        # Overlapped packed boundary transport, forwarded verbatim to the
+        # inner ScheduledPipeline (which resolves the tri-state per
+        # backend) — the front door inherits the same one-collective-
+        # per-direction engine. The eval forward() path is unaffected
+        # (its FWD-masked tables always run serialized).
+        self.overlap_transport = overlap_transport
         self.schedule: Schedule = (get_schedule(schedule)
                                    if isinstance(schedule, str) else schedule)
         self.v = self.schedule.v
@@ -148,7 +155,8 @@ class HeteroScheduledPipeline:
                                schedule=self.schedule,
                                remat_policy=self._train_remat_policy(),
                                skip_lanes=(SkipLanes(self.lane_pairs, ())
-                                           if self.lane_pairs else None))
+                                           if self.lane_pairs else None),
+                               overlap_transport=self.overlap_transport)
         return sp.memory_plan(m if m is not None else self.chunks)
 
     def _train_remat_policy(self):
@@ -720,7 +728,8 @@ class HeteroScheduledPipeline:
                                remat_policy=self._train_remat_policy(),
                                skip_lanes=(SkipLanes(lane_pairs, lane_specs)
                                            if has_lanes else None),
-                               stat_spec=stat_spec)
+                               stat_spec=stat_spec,
+                               overlap_transport=self.overlap_transport)
         # stage-sharded packed rows ARE the stacked stage params; () for
         # pre/post (packing has no weights; the loss is pure)
         if collect_stats:

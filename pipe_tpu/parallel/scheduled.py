@@ -89,7 +89,10 @@ from ..core.partition import StageCtx
 from ..core.remat import validate_mode
 from ..core.schedule import (BWD, FWD, IDLE, WGRAD, GPipeSchedule,
                              InterleavedOneFOneBSchedule, OneFOneBSchedule,
-                             Schedule, get_schedule)
+                             Schedule, get_schedule, shift_comm_tables,
+                             verify_shifted_op_tables, overlap_joint_capacity,
+                             _times_by_code)
+from .buffers import pack_words, packed_words, unpack_words
 from .mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from ..obs.telemetry import get_registry
 from ..utils.rng import make_key
@@ -186,21 +189,23 @@ def _index(tree, i):
         lambda l: jax.lax.dynamic_index_in_dim(l, i, 0, keepdims=False), tree)
 
 
-def _store_vjp(store, vjp_fn, specs, slot):
-    """Flatten ``vjp_fn`` and scatter its leaves into ``store`` at ``slot``.
-    One writer for BOTH residual stores (full and policy-shaped) so slot
-    layout and the structure-drift assert cannot diverge between them."""
+def _vjp_leaves(vjp_fn, specs):
+    """Flatten ``vjp_fn`` into its residual leaves. One flattener for BOTH
+    residual stores (full and policy-shaped) so slot layout and the
+    structure-drift assert cannot diverge between them. The actual store
+    write happens once, post-switch, in the cycle body (sentinel-masked) —
+    branches only hand back the leaves, never an updated store, so XLA can
+    alias the store across scan iterations."""
     leaves = jax.tree_util.tree_leaves(vjp_fn)
     assert [(l.shape, l.dtype) for l in leaves] == \
         [(sp_.shape, sp_.dtype) for sp_ in specs], \
         "vjp residual structure drifted from abstract spec"
-    return [jax.lax.dynamic_update_index_in_dim(st, l, slot, 0)
-            for st, l in zip(store, leaves)]
+    return leaves
 
 
 def _load_vjp(store, treedef, slot):
     """Gather ``slot``'s leaves from ``store`` and rebuild the vjp callable
-    — the read twin of :func:`_store_vjp`."""
+    — the read twin of :func:`_vjp_leaves`."""
     leaves = [jax.lax.dynamic_index_in_dim(st, slot, 0, keepdims=False)
               for st in store]
     return jax.tree_util.tree_unflatten(treedef, leaves)
@@ -315,6 +320,22 @@ class ScheduledPipeline:
     # (a BWD recompute re-computes and discards them, so recompute modes
     # cannot double-count) and are summed over the stage/data axes.
     stat_spec: Optional[Any] = None
+    # Overlapped (software-pipelined) boundary transport: each direction's
+    # boundary pytree (activations + riding skip lanes forward, cotangents
+    # + reverse lanes backward) packs into ONE flat uint32 buffer
+    # (buffers.pack_words), the scan carry double-buffers it, and the
+    # single per-direction ppermute launches at the START of each cycle —
+    # moving cycle t-1's sends while cycle t computes. Requires the comm-
+    # shifted op tables (core.schedule.shift_comm_tables): every consumer
+    # is retimed >= 2 cycles behind its producer and the shifted tables are
+    # re-verified at trace time (verify_shifted_op_tables). None = auto: ON
+    # for d > 1 on accelerator backends (async collectives overlap
+    # compute), OFF on CPU meshes (XLA:CPU's ppermute is a blocking
+    # rendezvous, so the longer shifted tables only add cycles) and always
+    # OFF at d == 1 (no transport). True/False force it for d > 1. Results
+    # are bitwise-identical to the serialized path: the retimer preserves
+    # per-device op order, and packing is a pure bitcast.
+    overlap_transport: Optional[bool] = None
 
     def __post_init__(self):
         validate_mode(self.checkpoint)
@@ -416,14 +437,24 @@ class ScheduledPipeline:
 
     # -----------------------------------------------------------------
     def memory_plan(self, m: int) -> dict:
-        """Static per-device buffer counts — the memory story, inspectable."""
+        """Static per-device buffer counts — the memory story, inspectable.
+        Reflects the ACTIVE transport: under overlapped transport the slot
+        counts come from the comm-shifted tables (stash windows widen by
+        the extra in-flight cycle; a small grad park appears)."""
         d, v = self.n_stages, self.v
-        Sg = self.schedule.stash_slots(m, d)
-        # The B->W cotangent park exists only under stored residuals; in
-        # recompute modes split-backward tables run the full backward at B
-        # and the W slots park nothing (see _device_program).
-        Wg = (self.schedule.wstash_slots(m, d)
-              if self.checkpoint == "never" else 0)
+        overlap = self._overlap_enabled()
+        if overlap:
+            (op_np, mb_np, grp_np, _, _), _, Sg, Gg, Wg_ov, _, _ = \
+                self._host_tables_overlap(m)
+            Wg = Wg_ov if self.checkpoint == "never" else 0
+        else:
+            Sg = self.schedule.stash_slots(m, d)
+            # The B->W cotangent park exists only under stored residuals;
+            # in recompute modes split-backward tables run the full
+            # backward at B and the W slots park nothing
+            # (see _device_program).
+            Wg = (self.schedule.wstash_slots(m, d)
+                  if self.checkpoint == "never" else 0)
         R = {"always": 0, "except_last": v,
              "never": v * Sg}[self.checkpoint]
         # Policy-shaped residual slots (dynamic path): recompute
@@ -437,20 +468,37 @@ class ScheduledPipeline:
                 "h_last_slots": Sg, "wstash_slots": v * Wg,
                 "taps_slots": (v * Sg if self.split_stage is not None
                                else 0),
-                "virtual_stages_per_device": v}
+                "virtual_stages_per_device": v,
+                "transport": "overlapped" if overlap else "serialized"}
+        if overlap:
+            plan["grad_park_slots"] = v * Gg
         if self.skip_lanes is not None:
-            tables = self.schedule.op_tables(m, d)
-            grp = (tables[2] if len(tables) > 2
-                   else np.zeros_like(tables[0]))
-            _, _, Kf, Kg = self._skip_tables(m, tables[0], tables[1], grp)
+            if not overlap:
+                tables = self.schedule.op_tables(m, d)
+                op_np, mb_np = tables[0], tables[1]
+                grp_np = (tables[2] if len(tables) > 2
+                          else np.zeros_like(op_np))
+            _, _, Kf, Kg = self._skip_tables(m, op_np, mb_np, grp_np,
+                                             overlap=overlap)
             plan["skip_lanes"] = len(self.skip_lanes.pairs)
             plan["skip_fwd_park_slots"] = sum(Kf)
             plan["skip_bwd_park_slots"] = sum(Kg)
         return plan
 
     def _cycles(self, m: int) -> int:
+        if self._overlap_enabled():
+            return self._host_tables_overlap(m)[1]
         tables = self.schedule.op_tables(m, self.n_stages)
         return tables[0].shape[0]
+
+    def _overlap_enabled(self) -> bool:
+        """Resolve the ``overlap_transport`` tri-state (see field comment).
+        Always False at d == 1 — there is no boundary transport to shift."""
+        if self.n_stages <= 1:
+            return False
+        if self.overlap_transport is not None:
+            return bool(self.overlap_transport)
+        return self.mesh.devices.flat[0].platform != "cpu"
 
     # -----------------------------------------------------------------
     def loss_and_grad(self, stage_params, pre_params, post_params, x, w,
@@ -476,6 +524,21 @@ class ScheduledPipeline:
         # compile-cache-miss signal.
         get_registry().counter("scheduled.loss_and_grad.lowerings").inc()
         get_registry().gauge("scheduled.cycles").set(self._cycles(m))
+        overlap = self._overlap_enabled()
+        get_registry().gauge("scheduled.transport.overlap").set(int(overlap))
+        if self.n_stages > 1:
+            # per-cycle collective count: the overlapped path packs every
+            # boundary leaf and lane into one buffer per direction;
+            # serialized adds each skip-lane perm group's own permutes
+            ncoll = 2
+            if not overlap and self.skip_lanes is not None:
+                fps, bps = self._lane_perms()
+                ncoll += len({tuple(pf) for pf in fps if pf is not None})
+                ncoll += len({tuple(pb) for pb in bps if pb is not None})
+        else:
+            ncoll = 0
+        get_registry().gauge(
+            "scheduled.transport.collectives_per_cycle").set(ncoll)
         # Total loss weight, computed OUTSIDE the device program (w is the
         # full global array here) and passed in replicated. Keeping this as
         # an in-program psum over the data axis made it the one SUBGROUP
@@ -962,7 +1025,91 @@ class ScheduledPipeline:
                 rxslot_np[t, p] = g2 * Sg + (mb_np[t - 1, q] % Sg)
         return (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel
 
-    def _skip_tables(self, m, op_np, mb_np, grp_np, *, fwd_only=False):
+    def _host_tables_overlap(self, m):
+        """Comm-shifted tables + receive/grad-park plans for overlapped
+        transport (host-side, all static).
+
+        The serialized tables are retimed by :func:`shift_comm_tables` so a
+        value produced at cycle t is permuted at the START of body t+1 and
+        parked there AFTER that body's compute — first legal read t+2 (hop
+        latency 2). Slot capacities are then re-derived from the shifted
+        timings under the park-after-compute window rule
+        (:func:`overlap_joint_capacity`): one joint ``Sg`` covers the
+        arriving-input stash, the in-branch residual/taps stores and the
+        last stage's ``h_last`` park (they share the ``g*Sg + i % Sg`` /
+        ``i % Sg`` slot arithmetic); ``Gg`` sizes the NEW grad park — under
+        serialized transport the reverse ring is rigid (a cotangent is
+        consumed the cycle it arrives), under overlap it is elastic and
+        arriving cotangents park in a small FIFO until their BWD.
+        ``verify_shifted_op_tables`` re-proves the whole contract before
+        the tables reach the executor.
+
+        ``rxslot`` keeps the serialized arithmetic unchanged: in both
+        modes the value parked at body t was produced by the upstream
+        compute at body t-1 (serialized: end-of-body permute; overlapped:
+        start-of-next-body permute). ``gxslot`` is its reverse-direction
+        twin for the grad park."""
+        d, v = self.n_stages, self.v
+        S = v * d
+        tables = self.schedule.op_tables(m, d)
+        if len(tables) == 2:
+            op0, mb0 = tables
+            grp0 = None
+        else:
+            op0, mb0, grp0 = tables
+        op_np, mb_np, grp_np = shift_comm_tables(op0, mb0, grp0,
+                                                 m=m, d=d, v=v)
+        T = op_np.shape[0]
+        t_f, t_b, t_w = _times_by_code(op_np, mb_np, grp_np, m, d, v)
+        read_last = np.maximum(t_f, np.maximum(t_b, t_w))
+        wins = [(t_f[:, s - 1] + 1, read_last[:, s]) for s in range(1, S)]
+        wins += [(t_f[:, s], read_last[:, s]) for s in range(S)]
+        wins += [(t_f[:, S - 1], t_b[:, S - 1])]        # h_last park
+        Sg = overlap_joint_capacity(wins, m)
+        gw = [(t_b[:, s + 1] + 1, t_b[:, s]) for s in range(S - 1)]
+        Gg = overlap_joint_capacity(gw, m) if gw else 1
+        has_w = bool((op_np == WGRAD).any())
+        split_dce = has_w and self.checkpoint == "never"
+        Wg = (overlap_joint_capacity(
+            [(t_b[:, s], t_w[:, s]) for s in range(S)], m)
+            if split_dce else 0)
+        verify_shifted_op_tables(
+            op_np, mb_np, grp_np if grp0 is not None else None,
+            m=m, d=d, v=v, splits_backward=has_w, stash_slots=Sg,
+            grad_slots=Gg, wstash_slots=Wg if split_dce else None)
+        sentinel = v * Sg
+        gsentinel = v * Gg
+        rxslot_np = np.full((T, d), sentinel, np.int32)
+        gxslot_np = np.full((T, d), gsentinel, np.int32)
+        for t in range(1, T):
+            for p in range(d):
+                q = (p - 1) % d
+                if not (v == 1 and p == 0) and op_np[t - 1, q] == FWD:
+                    s_up = grp_np[t - 1, q] * d + q
+                    if s_up < S - 1:
+                        g2 = (s_up + 1) // d
+                        rxslot_np[t, p] = g2 * Sg + (mb_np[t - 1, q] % Sg)
+                q = (p + 1) % d
+                if not (v == 1 and p == d - 1) and op_np[t - 1, q] == BWD:
+                    s_up = grp_np[t - 1, q] * d + q
+                    if s_up > 0:
+                        g2 = (s_up - 1) // d
+                        gxslot_np[t, p] = g2 * Gg + (mb_np[t - 1, q] % Gg)
+        return ((op_np, mb_np, grp_np, rxslot_np, gxslot_np), T, Sg, Gg,
+                Wg, sentinel, gsentinel)
+
+    def _lane_hops(self):
+        """Physical hop count per skip lane on the ring: ``(dst%d - src%d)
+        % d``. Under overlapped transport a lane with >= 1 hops rides the
+        packed carriers as an H-slot shift register (one relay hop per
+        cycle); 0-hop lanes (same device, v > 1) keep their register — a
+        permute would move them off-device."""
+        d = self.n_stages
+        return tuple(((dst % d) - (src % d)) % d
+                     for (src, dst) in self.skip_lanes.pairs)
+
+    def _skip_tables(self, m, op_np, mb_np, grp_np, *, fwd_only=False,
+                     overlap=False):
         """Host-side skip-lane plan from the op tables.
 
         Per lane ``l = (src, dst)`` (VIRTUAL stage indices; the physical
@@ -984,8 +1131,16 @@ class ScheduledPipeline:
         ``fwd_only=True`` plans for the FWD-masked eval tables: windows
         end at FWD(i, dst) (no reread — eval has no backward) and the
         reverse plan is skipped (``capg=None, Kg=()``).
+
+        ``overlap=True`` plans for the comm-shifted tables: lanes ride the
+        packed carriers as per-cycle relays, so arrival is ``max(H, 1)``
+        cycles after boarding (H = physical hops; 0-hop register lanes
+        still capture one cycle later), and because arrivals park AFTER
+        the cycle's compute the consumer must be STRICTLY later than the
+        arrival.
         """
         d = self.n_stages
+        hops = self._lane_hops() if overlap else None
         S = self.n_virtual
         T = op_np.shape[0]
         pairs = self.skip_lanes.pairs
@@ -1011,17 +1166,20 @@ class ScheduledPipeline:
         Kf, Kg = [], []
         f_events, g_events = [], []   # (t, lane, device, slot)
         for lidx, (src, dst) in enumerate(pairs):
+            lag = max(hops[lidx], 1) if overlap else 1
+            slack = 1 if overlap else 0   # park-after-compute: strict <
             wf, wg = [], []
             for i in range(m):
-                arr_f = fwd_c[i, src] + 1
+                arr_f = fwd_c[i, src] + lag
                 use_f = fwd_c[i, dst]
                 # host-side plan invariants raise (not assert: python -O
                 # must not turn a timing violation into silent corruption)
-                if not (0 <= fwd_c[i, src] and arr_f <= use_f):
+                if not (0 <= fwd_c[i, src] and arr_f + slack <= use_f):
                     raise ValueError(
                         f"skip lane ({src},{dst}): stash for micro-batch "
                         f"{i} arrives at cycle {arr_f} after its FWD "
-                        f"{use_f} — the schedule violates the direct-hop "
+                        f"{use_f} — the schedule violates the "
+                        f"{'relay' if overlap else 'direct-hop'} "
                         f"timing assumption")
                 reread = (not fwd_only
                           and self.remat_policy is None
@@ -1031,14 +1189,15 @@ class ScheduledPipeline:
                 wf.append((arr_f, bwd_c[i, dst] if reread else use_f))
                 if fwd_only:
                     continue
-                arr_g = bwd_c[i, dst] + 1
+                arr_g = bwd_c[i, dst] + lag
                 use_g = bwd_c[i, src]
-                if not (0 <= bwd_c[i, dst] and arr_g <= use_g):
+                if not (0 <= bwd_c[i, dst] and arr_g + slack <= use_g):
                     raise ValueError(
                         f"skip lane ({src},{dst}): cotangent for "
                         f"micro-batch {i} arrives at cycle {arr_g} after "
                         f"its BWD {use_g} — the schedule violates the "
-                        f"direct-hop timing assumption")
+                        f"{'relay' if overlap else 'direct-hop'} "
+                        f"timing assumption")
                 wg.append((arr_g, use_g))
             kf = fifo_depth(wf)
             Kf.append(kf)
@@ -1302,6 +1461,7 @@ class ScheduledPipeline:
             return self._device_program_static(
                 stage_params, pre_params, post_params, x, w, wsum, key, m=m)
         get_registry().counter("scheduled.program.dynamic_scan").inc()
+        overlap = self._overlap_enabled()
         j = jax.lax.axis_index(STAGE_AXIS)
         # This device's shard: [v, ...] — its interleave groups in order.
         params_dev = stage_params
@@ -1354,19 +1514,28 @@ class ScheduledPipeline:
         inv_wsum = 1.0 / wsum
 
         # --- schedule tables (static data → scan xs) ---------------------
-        (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel = \
-            self._host_tables(m)
+        if overlap:
+            ((op_np, mb_np, grp_np, rxslot_np, gxslot_np), T, Sg, Gg,
+             Wg_ov, sentinel, gsentinel) = self._host_tables_overlap(m)
+            base_xs = [jnp.asarray(op_np), jnp.asarray(mb_np),
+                       jnp.asarray(grp_np), jnp.asarray(rxslot_np),
+                       jnp.asarray(gxslot_np)]
+        else:
+            (op_np, mb_np, grp_np, rxslot_np), T, Sg, sentinel = \
+                self._host_tables(m)
+            base_xs = [jnp.asarray(op_np), jnp.asarray(mb_np),
+                       jnp.asarray(grp_np), jnp.asarray(rxslot_np)]
         if lanes is not None:
-            capf_np, capg_np, Kf, Kg = self._skip_tables(m, op_np, mb_np,
-                                                         grp_np)
+            capf_np, capg_np, Kf, Kg = self._skip_tables(
+                m, op_np, mb_np, grp_np, overlap=overlap)
             lane_fwd_perms, lane_bwd_perms = self._lane_perms()
-            xs = (jnp.asarray(op_np), jnp.asarray(mb_np),
-                  jnp.asarray(grp_np), jnp.asarray(rxslot_np),
-                  jnp.asarray(capf_np), jnp.asarray(capg_np))
+            lane_hops = self._lane_hops()
+            xs = tuple(base_xs + [jnp.asarray(capf_np),
+                                  jnp.asarray(capg_np)])
         else:
             Kf = Kg = ()
-            xs = (jnp.asarray(op_np), jnp.asarray(mb_np),
-                  jnp.asarray(grp_np), jnp.asarray(rxslot_np))
+            lane_hops = ()
+            xs = tuple(base_xs)
         # Split-backward (zero-bubble) tables carry WGRAD ops: B computes
         # the input grad only (and parks its cotangent); W consumes the
         # parked cotangent for the weight grads. Static: shapes the carry
@@ -1378,22 +1547,23 @@ class ScheduledPipeline:
         # exists once the forward re-runs at B, so the FULL backward
         # accumulates there and W is a no-op — recompute-once, no park.
         split_dce = has_w and mode == "never"
-        Wg = self.schedule.wstash_slots(m, d) if split_dce else 0
+        Wg = ((Wg_ov if overlap else self.schedule.wstash_slots(m, d))
+              if split_dce else 0)
 
         # --- carry -------------------------------------------------------
         def zeros_of(spec):
             return jnp.zeros(spec.shape, spec.dtype)
 
         def slots_of(spec, k):
-            # one extra sentinel slot so masked writes need no read-back
+            # One extra sentinel slot so masked writes need no read-back.
+            # EVERY slot store uses this form: the cycle body writes each
+            # store exactly once, unconditionally, after the op switch
+            # (non-writing ops target the sentinel). Cond-gated writes or
+            # stores returned through lax.switch defeat XLA's while-loop
+            # buffer aliasing and re-copy the whole store every cycle —
+            # one sentinel slot of extra memory buys O(stores) MB/cycle
+            # of removed copies.
             return jnp.zeros((k + 1,) + tuple(spec.shape), spec.dtype)
-
-        def exact_slots_of(spec, k):
-            # sentinel-free: writes are cond-gated, never masked-to-sentinel.
-            # This matters for the residual store, where one sentinel slot
-            # would double memory at v = Sg = 1 (and every not-saved forward
-            # would stream a full residual set into it).
-            return jnp.zeros((k,) + tuple(spec.shape), spec.dtype)
 
         h_ring = jax.tree_util.tree_map(zeros_of, h_spec)
         g_ring = jax.tree_util.tree_map(zeros_of, h_spec)
@@ -1406,24 +1576,24 @@ class ScheduledPipeline:
         # stash arrival the Sg FIFO proof bounds, and frees at the same
         # BWD(i, S-1).
         h_last = jax.tree_util.tree_map(
-            lambda s_: exact_slots_of(s_, Sg), h_spec)
+            lambda s_: slots_of(s_, Sg), h_spec)
         # Deferred-W park (B -> W window), activation-scale slots: the
         # downstream cotangent seed (legacy stored-vjp split) or the
         # per-op output cotangents g_zs (structural split).
         wpark_spec = zs_spec if self.split_stage is not None else h_spec
         wstash = (jax.tree_util.tree_map(
-            lambda s_: exact_slots_of(s_, v * Wg), wpark_spec)
+            lambda s_: slots_of(s_, v * Wg), wpark_spec)
             if split_dce else ())
         # Structural split: per-op input taps, FWD -> W FIFO window.
         taps_store = (jax.tree_util.tree_map(
-            lambda s_: exact_slots_of(s_, v * Sg), taps_spec)
+            lambda s_: slots_of(s_, v * Sg), taps_spec)
             if self.split_stage is not None else ())
         n_res = self.memory_plan(m)["residual_slots"]
-        res_store = ([exact_slots_of(s_, n_res) for s_ in res_specs]
+        res_store = ([slots_of(s_, n_res) for s_ in res_specs]
                      if mode != "always" else [])
         # Recompute micro-batches' policy-saved residuals: FWD -> BWD FIFO,
         # same window as the stash (slot g*Sg + i % Sg).
-        pres_store = ([exact_slots_of(s_, v * Sg) for s_ in pres_specs]
+        pres_store = ([slots_of(s_, v * Sg) for s_ in pres_specs]
                       if use_policy else [])
         # Skip lanes: one forward + one reverse ring register per lane and
         # a sentinel-slotted FIFO park at each end (capture writes use the
@@ -1443,6 +1613,45 @@ class ScheduledPipeline:
                 for sp_, k in zip(lanes.specs, Kg))
         else:
             sk_ring = gk_ring = sk_park = gk_park = ()
+        if overlap:
+            # Packed double-buffered boundary carriers: ONE uint32 vector
+            # per direction holds the in-flight boundary pytree — the h
+            # ring value plus, per riding skip lane (>= 1 physical hops),
+            # an H-slot shift register relaying the lane value one hop per
+            # cycle (slot 0 = freshly boarded, slot H-1 = arriving). 0-hop
+            # lanes (same device, v > 1) keep their flat register carry —
+            # a permute would move them off-device.
+            ride = tuple(h >= 1 for h in lane_hops)
+            reg_idx = tuple(l for l in range(len(lane_hops))
+                            if not ride[l])
+
+            def lane_stack_spec(l):
+                if not ride[l]:
+                    return ()
+                return jax.tree_util.tree_map(
+                    lambda sp_: jax.ShapeDtypeStruct(
+                        (lane_hops[l],) + tuple(sp_.shape), sp_.dtype),
+                    lanes.specs[l])
+
+            lane_stacks_spec = tuple(lane_stack_spec(l)
+                                     for l in range(len(lane_hops)))
+            pend_spec = (h_spec, lane_stacks_spec)
+            pend_words = packed_words(pend_spec)
+            pend_f0 = jnp.zeros((pend_words,), jnp.uint32)
+            pend_g0 = jnp.zeros((pend_words,), jnp.uint32)
+            # Elastic reverse ring: arriving cotangents park here until
+            # their BWD (serialized transport consumes them on arrival —
+            # its reverse ring is rigid and needs no park).
+            gpark = jax.tree_util.tree_map(
+                lambda s_: slots_of(s_, v * Gg), h_spec)
+            sk_reg = tuple(jax.tree_util.tree_map(zeros_of, lanes.specs[l])
+                           for l in reg_idx)
+            gk_reg = tuple(jax.tree_util.tree_map(zeros_of, lanes.specs[l])
+                           for l in reg_idx)
+            reg_pos = {l: k for k, l in enumerate(reg_idx)}
+            get_registry().gauge(
+                "scheduled.transport.packed_words_per_direction").set(
+                pend_words)
         g_sp = jax.tree_util.tree_map(jnp.zeros_like, params_dev)
         g_pre = jax.tree_util.tree_map(jnp.zeros_like, pre_params)
         g_post = jax.tree_util.tree_map(jnp.zeros_like, post_params)
@@ -1456,32 +1665,49 @@ class ScheduledPipeline:
             bwd_perm = [(q, (q - 1) % d) for q in range(d)]
 
         def res_slot_for(i, g):
-            """Where (micro-batch i, group g)'s residuals live. Saves are
-            cond-gated, so this is only consulted for saved micro-batches."""
+            """Where (micro-batch i, group g)'s residuals live. Non-saving
+            forwards route their (zero) values to the sentinel slot, so
+            this is only consulted for saved micro-batches."""
             if mode == "never":
                 return g * Sg + i % Sg
             return g  # except_last: slot g holds micro-batch m-1
 
+        # Zero write-values for ops that do not store into a given slot
+        # store this cycle. The post-switch writer is unconditional — one
+        # masked write per store per cycle, sentinel slot when inactive —
+        # so every branch hands back a full (values, slot) set. Streaming
+        # one zero value-set into a sentinel slot is the price of XLA
+        # aliasing every store in place across the scan; cond-gated writes
+        # and stores returned through lax.switch measurably re-copy the
+        # whole store every cycle instead.
+        res_zero = ([zeros_of(s_) for s_ in res_specs]
+                    if mode != "always" else [])
+        pres_zero = [zeros_of(s_) for s_ in pres_specs]
+        taps_zero = (jax.tree_util.tree_map(zeros_of, taps_spec)
+                     if self.split_stage is not None else ())
+        w_zero = (jax.tree_util.tree_map(zeros_of, wpark_spec)
+                  if split_dce else ())
+
         def cycle(carry, row):
-            (h_ring, g_ring, stash, h_last, wstash, taps_store, res_store,
-             pres_store, sk_ring, gk_ring, sk_park, gk_park, stats_acc,
-             g_sp, g_pre, g_post, loss) = carry
-            if lanes is not None:
-                op_r, mb_r, grp_r, rx_r, capf_r, capg_r = row
+            if overlap:
+                (pend_f, pend_g, stash, gpark, h_last, wstash, taps_store,
+                 res_store, pres_store, sk_reg, gk_reg, sk_park, gk_park,
+                 stats_acc, g_sp, g_pre, g_post, loss) = carry
             else:
-                op_r, mb_r, grp_r, rx_r = row
+                (h_ring, g_ring, stash, h_last, wstash, taps_store,
+                 res_store, pres_store, sk_ring, gk_ring, sk_park, gk_park,
+                 stats_acc, g_sp, g_pre, g_post, loss) = carry
+            cols = list(row)
+            op_r, mb_r, grp_r, rx_r = cols[:4]
+            if overlap:
+                gx_r = cols[4]
+            if lanes is not None:
+                capf_r, capg_r = cols[-2], cols[-1]
             opj = jax.lax.dynamic_index_in_dim(op_r, j, 0, keepdims=False)
             i = jax.lax.dynamic_index_in_dim(mb_r, j, 0, keepdims=False)
             g = jax.lax.dynamic_index_in_dim(grp_r, j, 0, keepdims=False)
             rslot = jax.lax.dynamic_index_in_dim(rx_r, j, 0, keepdims=False)
             s = g * d + j                 # this cycle's virtual stage
-
-            # 1) park the arriving activation (sentinel slot when not real)
-            stash = jax.tree_util.tree_map(
-                lambda st, hr: jax.lax.dynamic_update_index_in_dim(
-                    st, hr, rslot, 0), stash, h_ring)
-            # 1b) park arriving skip values / pop cotangents (host tables
-            # mark the exact arrival cycles; sentinel slot otherwise)
             if lanes is not None:
                 fslots = [jax.lax.dynamic_index_in_dim(
                     capf_r[l], j, 0, keepdims=False)
@@ -1489,18 +1715,61 @@ class ScheduledPipeline:
                 gslots = [jax.lax.dynamic_index_in_dim(
                     capg_r[l], j, 0, keepdims=False)
                     for l in range(len(lanes.pairs))]
-                sk_park = tuple(
-                    jax.tree_util.tree_map(
-                        lambda st, reg, sl=sl:
-                        jax.lax.dynamic_update_index_in_dim(st, reg, sl, 0),
-                        pk, rg)
-                    for pk, rg, sl in zip(sk_park, sk_ring, fslots))
-                gk_park = tuple(
-                    jax.tree_util.tree_map(
-                        lambda st, reg, sl=sl:
-                        jax.lax.dynamic_update_index_in_dim(st, reg, sl, 0),
-                        pk, rg)
-                    for pk, rg, sl in zip(gk_park, gk_ring, gslots))
+
+            if overlap:
+                # Software pipeline: launch the collectives moving the
+                # PREVIOUS cycle's packed sends NOW — nothing below this
+                # cycle's switch reads them (the shifted tables prove every
+                # consumer is >= 1 body behind the park), so the permutes
+                # run alongside the compute instead of gating it.
+                gslot = jax.lax.dynamic_index_in_dim(gx_r, j, 0,
+                                                     keepdims=False)
+                rx_f = jax.lax.ppermute(pend_f, STAGE_AXIS, fwd_perm)
+                rx_g = jax.lax.ppermute(pend_g, STAGE_AXIS, bwd_perm)
+                rx_h, rx_sks = unpack_words(rx_f, pend_spec)
+                rx_gh, rx_gks = unpack_words(rx_g, pend_spec)
+                # the names the shared branch code consumes: h_ring is
+                # only a garbage filler for non-FWD tx_h; g_ring is the
+                # parked cotangent seed for this (i, s)'s BWD; lane rings
+                # are the arriving slot (riding lanes) or the register
+                h_ring = rx_h
+                g_ring = jax.tree_util.tree_map(
+                    lambda st: jax.lax.dynamic_index_in_dim(
+                        st, g * Gg + i % Gg, 0, keepdims=False), gpark)
+                sk_ring = tuple(
+                    (sk_reg[reg_pos[l]] if not ride[l]
+                     else jax.tree_util.tree_map(lambda a: a[-1],
+                                                 rx_sks[l]))
+                    for l in range(len(lane_hops)))
+                gk_ring = tuple(
+                    (gk_reg[reg_pos[l]] if not ride[l]
+                     else jax.tree_util.tree_map(lambda a: a[-1],
+                                                 rx_gks[l]))
+                    for l in range(len(lane_hops)))
+            else:
+                # 1) park the arriving activation (sentinel slot when not
+                # real)
+                stash = jax.tree_util.tree_map(
+                    lambda st, hr: jax.lax.dynamic_update_index_in_dim(
+                        st, hr, rslot, 0), stash, h_ring)
+                # 1b) park arriving skip values / pop cotangents (host
+                # tables mark the exact arrival cycles; sentinel slot
+                # otherwise)
+                if lanes is not None:
+                    sk_park = tuple(
+                        jax.tree_util.tree_map(
+                            lambda st, reg, sl=sl:
+                            jax.lax.dynamic_update_index_in_dim(
+                                st, reg, sl, 0),
+                            pk, rg)
+                        for pk, rg, sl in zip(sk_park, sk_ring, fslots))
+                    gk_park = tuple(
+                        jax.tree_util.tree_map(
+                            lambda st, reg, sl=sl:
+                            jax.lax.dynamic_update_index_in_dim(
+                                st, reg, sl, 0),
+                            pk, rg)
+                        for pk, rg, sl in zip(gk_park, gk_ring, gslots))
 
             kis = jax.random.fold_in(jax.random.fold_in(key, i), s)
             x_mb = _index(x, i)
@@ -1522,6 +1791,15 @@ class ScheduledPipeline:
                         st, i % k, 0, keepdims=False), pk)
                 for pk, k in zip(sk_park, Kf))
                 if lanes is not None else None)
+
+            # Sentinel-routed (values, slot) pairs for branches that skip
+            # a given store this cycle (full_like keeps the slot dtype
+            # uniform across branches so lax.switch avals agree).
+            no_res = (res_zero, jnp.full_like(i, n_res))
+            no_pres = (pres_zero, jnp.full_like(i, v * Sg))
+            no_taps = (taps_zero, jnp.full_like(i, v * Sg))
+            no_w = (w_zero, jnp.full_like(i, v * Wg))
+            hl_none = jnp.full_like(i, Sg)
 
             def apply_vjp(seed):
                 """Cotangents from the stored or recomputed vjp per the
@@ -1566,53 +1844,47 @@ class ScheduledPipeline:
                 def vjp_and_store():
                     out, vjp_fn = self._vjp_wrt(
                         params_g, pre_params, h_in, x_mb, kis, s, pops)
-                    return out, _store_vjp(res_store, vjp_fn, res_specs,
-                                           res_slot_for(i, g)), \
-                        pres_store, taps_store
+                    return (out, (_vjp_leaves(vjp_fn, res_specs),
+                                  res_slot_for(i, g)), no_pres, no_taps)
 
                 def split_vjp_and_store():
-                    # structural split: params-constant vjp + taps store
+                    # structural split: params-constant vjp + taps values
                     out, vjp_fn, taps = self._vjp_wrt_split(
                         params_g, pre_params, h_in, x_mb, kis, s)
-                    new_res = _store_vjp(res_store, vjp_fn, res_specs,
-                                         res_slot_for(i, g))
-                    new_taps = jax.tree_util.tree_map(
-                        lambda st, l: jax.lax.dynamic_update_index_in_dim(
-                            st, l, g * Sg + i % Sg, 0), taps_store, taps)
-                    return out, new_res, pres_store, new_taps
+                    return (out, (_vjp_leaves(vjp_fn, res_specs),
+                                  res_slot_for(i, g)), no_pres,
+                            (taps, g * Sg + i % Sg))
 
                 def policy_vjp_and_store():
-                    # selective remat: forward stores the policy-saved
+                    # selective remat: forward hands back the policy-saved
                     # residual subset (its own uniform slot structure);
                     # backward recomputes only the cheap remainder
                     out, vjp_fn = self._vjp_wrt_policy(
                         params_g, pre_params, h_in, x_mb, kis, s, pops)
-                    return out, res_store, \
-                        _store_vjp(pres_store, vjp_fn, pres_specs,
-                                   g * Sg + i % Sg), taps_store
+                    return (out, no_res,
+                            (_vjp_leaves(vjp_fn, pres_specs),
+                             g * Sg + i % Sg), no_taps)
 
                 def body_only():
                     return (self._f_body(params_g, pre_params, h_in, x_mb,
-                                         kis, s, pops), res_store,
-                            pres_store, taps_store)
+                                         kis, s, pops), no_res, no_pres,
+                            no_taps)
 
                 recompute_fwd = (policy_vjp_and_store if use_policy
                                  else body_only)
                 if self.split_stage is not None:   # never mode guaranteed
-                    out, new_res, new_pres, new_taps = split_vjp_and_store()
+                    out, res_w, pres_w, taps_w = split_vjp_and_store()
                 elif mode == "always":
-                    out, new_res, new_pres, new_taps = recompute_fwd()
+                    out, res_w, pres_w, taps_w = recompute_fwd()
                 elif mode == "never":
-                    out, new_res, new_pres, new_taps = vjp_and_store()
+                    out, res_w, pres_w, taps_w = vjp_and_store()
                 else:
                     # except_last: ONLY micro-batch m-1 pays the residual
-                    # capture and store; the rest run the plain body (they
-                    # recompute at BWD) or, under remat_policy, store just
-                    # the policy-saved subset. Without the gate every
-                    # forward would stream a full residual set into a
-                    # sentinel slot — wasted HBM traffic and a doubled
-                    # store.
-                    out, new_res, new_pres, new_taps = jax.lax.cond(
+                    # capture; the rest run the plain body (they recompute
+                    # at BWD) or, under remat_policy, hand back just the
+                    # policy-saved subset — their full-residual values are
+                    # zeros bound for the sentinel slot.
+                    out, res_w, pres_w, taps_w = jax.lax.cond(
                         i == m - 1, vjp_and_store, recompute_fwd)
                 h1, stashes, stats_t = self._split_out(out)
                 if lanes is not None:
@@ -1640,13 +1912,10 @@ class ScheduledPipeline:
                     lambda: self._post_contrib(post_params, h1, x_mb, w_mb,
                                                kis),
                     lambda: jnp.zeros((), jnp.float32))
-                new_h_last = jax.lax.cond(
-                    is_last,
-                    lambda: jax.tree_util.tree_map(
-                        lambda st, l: jax.lax.dynamic_update_index_in_dim(
-                            st, l, i % Sg, 0), h_last, h1),
-                    lambda: h_last)
-                return (new_h_last, wstash, new_taps, new_res, new_pres,
+                # h1 doubles as the h_last write value (tx_h); non-last
+                # stages stream it into the sentinel slot
+                hl_slot = jnp.where(is_last, i % Sg, Sg)
+                return (hl_slot, no_w, taps_w, res_w, pres_w,
                         new_stats, g_sp, g_pre, g_post, loss + contrib, h1,
                         g_ring, tx_sk, gk_ring)
 
@@ -1708,13 +1977,10 @@ class ScheduledPipeline:
                     gpre, gh, gzs = _load_vjp(res_store, res_treedef,
                                               res_slot_for(i, g))(seed_f0)
                     gh = _vjp_to_ring(gh, h_spec)
-                    new_wstash = jax.tree_util.tree_map(
-                        lambda st, l: jax.lax.dynamic_update_index_in_dim(
-                            st, l, g * Wg + i % Wg, 0), wstash, gzs)
-                    return (h_last, new_wstash, taps_store, res_store,
-                            pres_store, stats_acc, g_sp, add(g_pre, gpre),
-                            add(g_post, gpost), loss, h_ring, gh,
-                            sk_ring, gk_ring)
+                    return (hl_none, (gzs, g * Wg + i % Wg), no_taps,
+                            no_res, no_pres, stats_acc, g_sp,
+                            add(g_pre, gpre), add(g_post, gpost), loss,
+                            h_ring, gh, sk_ring, gk_ring)
 
                 if lanes is not None:
                     gp, gpre, gh, g_pops = apply_vjp(seed)
@@ -1735,18 +2001,15 @@ class ScheduledPipeline:
                     # input grad (XLA DCE prunes the unused weight-grad
                     # matmuls from the stored-residual call); the cotangent
                     # parks for the W op.
-                    new_wstash = jax.tree_util.tree_map(
-                        lambda st, l: jax.lax.dynamic_update_index_in_dim(
-                            st, l, g * Wg + i % Wg, 0), wstash, seed_h)
-                    return (h_last, new_wstash, taps_store, res_store,
-                            pres_store, stats_acc, g_sp, g_pre,
+                    return (hl_none, (seed_h, g * Wg + i % Wg), no_taps,
+                            no_res, no_pres, stats_acc, g_sp, g_pre,
                             add(g_post, gpost), loss, h_ring, gh,
                             sk_ring, tx_gk)
                 # combined backward (non-split tables), or a split table
                 # under a recompute mode — the vjp was just built from the
                 # single forward recompute, so weight grads accumulate here
                 # and the table's W slot (if any) is a no-op.
-                return (h_last, wstash, taps_store, res_store, pres_store,
+                return (hl_none, no_w, no_taps, no_res, no_pres,
                         stats_acc, scatter_gp(g_sp, gp), add(g_pre, gpre),
                         add(g_post, gpost), loss, h_ring, gh,
                         sk_ring, tx_gk)
@@ -1764,8 +2027,8 @@ class ScheduledPipeline:
                         lambda st: jax.lax.dynamic_index_in_dim(
                             st, g * Wg + i % Wg, 0, keepdims=False), wstash)
                     gp = self.split_stage.wgrad_fn(taps, gzs)
-                    return (h_last, wstash, taps_store, res_store,
-                            pres_store, stats_acc, scatter_gp(g_sp, gp),
+                    return (hl_none, no_w, no_taps, no_res, no_pres,
+                            stats_acc, scatter_gp(g_sp, gp),
                             g_pre, g_post, loss, h_ring, g_ring,
                             sk_ring, gk_ring)
                 if not split_dce:
@@ -1775,21 +2038,99 @@ class ScheduledPipeline:
                     lambda st: jax.lax.dynamic_index_in_dim(
                         st, g * Wg + i % Wg, 0, keepdims=False), wstash)
                 gp, gpre, _ = apply_vjp(_ring_to_seed(seed_h, h_spec))
-                return (h_last, wstash, taps_store, res_store, pres_store,
+                return (hl_none, no_w, no_taps, no_res, no_pres,
                         stats_acc, scatter_gp(g_sp, gp), add(g_pre, gpre),
                         g_post, loss, h_ring, g_ring, sk_ring, gk_ring)
 
             def idle_branch():
-                return (h_last, wstash, taps_store, res_store, pres_store,
+                return (hl_none, no_w, no_taps, no_res, no_pres,
                         stats_acc, g_sp, g_pre, g_post, loss, h_ring,
                         g_ring, sk_ring, gk_ring)
 
             branches = [idle_branch, fwd_branch, bwd_branch]
             if has_w:
                 branches.append(wgrad_branch)
-            (h_last2, wstash2, taps2, res_store2, pres_store2, stats2,
-             g_sp2, g_pre2, g_post2, loss2, tx_h, tx_g, tx_sk, tx_gk) = \
-                jax.lax.switch(opj, branches)
+            (hl_slot, (w_v, w_s), (taps_v, taps_s), (res_v, res_s),
+             (pres_v, pres_s), stats2, g_sp2, g_pre2, g_post2, loss2,
+             tx_h, tx_g, tx_sk, tx_gk) = jax.lax.switch(opj, branches)
+
+            # THE slot-store writers: branches return (values, slot), and
+            # each store takes exactly one unconditional masked write per
+            # cycle here — never a whole updated store through the switch
+            # — so XLA aliases every store in place across the scan
+            # instead of re-copying it each cycle. tx_h doubles as the
+            # h_last write value (h1 on FWD cycles; sentinel otherwise).
+            h_last2 = jax.tree_util.tree_map(
+                lambda st, l: jax.lax.dynamic_update_index_in_dim(
+                    st, l, hl_slot, 0), h_last, tx_h)
+            wstash2 = (jax.tree_util.tree_map(
+                lambda st, l: jax.lax.dynamic_update_index_in_dim(
+                    st, l, w_s, 0), wstash, w_v) if split_dce else ())
+            taps2 = (jax.tree_util.tree_map(
+                lambda st, l: jax.lax.dynamic_update_index_in_dim(
+                    st, l, taps_s, 0), taps_store, taps_v)
+                if self.split_stage is not None else ())
+            res_store2 = [
+                jax.lax.dynamic_update_index_in_dim(st, l, res_s, 0)
+                for st, l in zip(res_store, res_v)]
+            pres_store2 = [
+                jax.lax.dynamic_update_index_in_dim(st, l, pres_s, 0)
+                for st, l in zip(pres_store, pres_v)]
+
+            if overlap:
+                # Park this cycle's ARRIVALS only now — the compute above
+                # read the pre-park carry, so the unpacked receives never
+                # gate the switch (first legal read is the next body).
+                stash2 = jax.tree_util.tree_map(
+                    lambda st, hr: jax.lax.dynamic_update_index_in_dim(
+                        st, hr, rslot, 0), stash, rx_h)
+                gpark2 = jax.tree_util.tree_map(
+                    lambda st, gr: jax.lax.dynamic_update_index_in_dim(
+                        st, gr, gslot, 0), gpark, rx_gh)
+                if lanes is not None:
+                    # lane captures: riding lanes park their expiring
+                    # shift-register slot, register lanes the register —
+                    # both are what sk_ring/gk_ring already name
+                    sk_park2 = tuple(
+                        jax.tree_util.tree_map(
+                            lambda st, reg, sl=sl:
+                            jax.lax.dynamic_update_index_in_dim(
+                                st, reg, sl, 0),
+                            pk, rg)
+                        for pk, rg, sl in zip(sk_park, sk_ring, fslots))
+                    gk_park2 = tuple(
+                        jax.tree_util.tree_map(
+                            lambda st, reg, sl=sl:
+                            jax.lax.dynamic_update_index_in_dim(
+                                st, reg, sl, 0),
+                            pk, rg)
+                        for pk, rg, sl in zip(gk_park, gk_ring, gslots))
+                    # relay: the freshly boarded value enters slot 0,
+                    # everything in flight advances one hop
+                    tx_stacks = tuple(
+                        (() if not ride[l] else jax.tree_util.tree_map(
+                            lambda bv, stk: jnp.concatenate(
+                                [bv[None], stk[:-1]], axis=0),
+                            tx_sk[l], rx_sks[l]))
+                        for l in range(len(lane_hops)))
+                    tg_stacks = tuple(
+                        (() if not ride[l] else jax.tree_util.tree_map(
+                            lambda bv, stk: jnp.concatenate(
+                                [bv[None], stk[:-1]], axis=0),
+                            tx_gk[l], rx_gks[l]))
+                        for l in range(len(lane_hops)))
+                    sk_reg2 = tuple(tx_sk[l] for l in reg_idx)
+                    gk_reg2 = tuple(tx_gk[l] for l in reg_idx)
+                else:
+                    sk_park2, gk_park2 = sk_park, gk_park
+                    tx_stacks = tg_stacks = ()
+                    sk_reg2 = gk_reg2 = ()
+                pend_f2 = pack_words((tx_h, tx_stacks))
+                pend_g2 = pack_words((tx_g, tg_stacks))
+                return (pend_f2, pend_g2, stash2, gpark2, h_last2, wstash2,
+                        taps2, res_store2, pres_store2, sk_reg2, gk_reg2,
+                        sk_park2, gk_park2, stats2, g_sp2, g_pre2, g_post2,
+                        loss2), None
 
             if d > 1:
                 tx_h = jax.tree_util.tree_map(
@@ -1817,11 +2158,16 @@ class ScheduledPipeline:
 
         stats0 = (self._zero_seed_like(self.stat_spec)
                   if self.stat_spec is not None else ())
-        carry0 = (h_ring, g_ring, stash, h_last, wstash, taps_store,
-                  res_store, pres_store, sk_ring, gk_ring, sk_park, gk_park,
-                  stats0, g_sp, g_pre, g_post, loss0)
-        (_, _, _, _, _, _, _, _, _, _, _, _, stats_out, g_sp, g_pre,
-         g_post, loss), _ = jax.lax.scan(cycle, carry0, xs)
+        if overlap:
+            carry0 = (pend_f0, pend_g0, stash, gpark, h_last, wstash,
+                      taps_store, res_store, pres_store, sk_reg, gk_reg,
+                      sk_park, gk_park, stats0, g_sp, g_pre, g_post, loss0)
+        else:
+            carry0 = (h_ring, g_ring, stash, h_last, wstash, taps_store,
+                      res_store, pres_store, sk_ring, gk_ring, sk_park,
+                      gk_park, stats0, g_sp, g_pre, g_post, loss0)
+        final_carry, _ = jax.lax.scan(cycle, carry0, xs)
+        stats_out, g_sp, g_pre, g_post, loss = final_carry[-5:]
 
         # --- cross-device reductions ------------------------------------
         # stage grads: per-device shards stay put; replicas over other axes

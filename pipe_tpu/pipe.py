@@ -68,7 +68,8 @@ class Pipe:
                  balance: Optional[Sequence[int]] = None,
                  schedule: str = "gpipe",
                  deferred_batch_norm: bool = False,
-                 remat_policy=None):
+                 remat_policy=None,
+                 overlap_transport: Optional[bool] = None):
         # --- fail-fast validation (reference pipe.py:324-345) ---
         if not isinstance(chunks, int) or isinstance(chunks, bool):
             raise TypeError("chunks must be an integer")
@@ -90,6 +91,10 @@ class Pipe:
         # for the RECOMPUTE micro-batches — flows to the training executor;
         # the forward path takes it per-call (and falls back to this).
         self.remat_policy = remat_policy
+        # Overlapped (software-pipelined, packed) boundary transport for
+        # the training executor — tri-state, resolved per backend; see
+        # ScheduledPipeline.overlap_transport.
+        self.overlap_transport = overlap_transport
 
         if deferred_batch_norm:
             from .extras.norm import convert_deferred_batch_norm
@@ -181,7 +186,8 @@ class Pipe:
             from .parallel.hetero_scheduled import HeteroScheduledPipeline
             self._train_executor = HeteroScheduledPipeline(
                 mesh, self.partitions, self.skip_layout, chunks,
-                checkpoint, sched_obj, remat_policy=remat_policy)
+                checkpoint, sched_obj, remat_policy=remat_policy,
+                overlap_transport=overlap_transport)
 
     # --- container protocol (reference pipe.py:358-386) ---
 

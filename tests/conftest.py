@@ -53,6 +53,18 @@ _SMOKE = {
     "[never-1f1b]",
     "test_norm.py::test_table_executor_bn_matches_emulator"
     "[except_last-1f1b]",
+    # overlapped packed transport: one bitwise-parity case + the shifted-
+    # table proof that backs every overlapped run
+    "test_overlap_transport.py::test_overlap_transparency"
+    "[1f1b-except_last]",
+    "test_overlap_transport.py::"
+    "test_verify_op_tables_rejects_misshifted_comm_slot",
+    # the bench-side probes: quick cpu8 transport comparison + the
+    # zero-cost-telemetry HLO pin behind the headline timing
+    "test_overlap_transport.py::"
+    "test_quick_probe_reports_transport_side_by_side",
+    "test_overlap_transport.py::"
+    "test_disabled_telemetry_is_zero_cost_on_hot_path",
     # interleaved (train + the forward/eval executor)
     "test_interleaved.py::test_interleaved_pipe_forward_matches_emulator",
     "test_pipe_1f1b.py::test_interleaved_1f1b_through_pipe",

@@ -10,6 +10,11 @@ full stash/residual/cotangent machinery — the same math as the single-stage
 program, so the measured delta IS the table machinery + stash traffic
 (no ICI, granted: at d=1 the ring hop is a self-permute).
 
+``python tools/multistage_probe.py --quick [n_stages chunks]`` instead runs
+the cpu8 bubble probe with the schedule + transport (serialized vs packed
+overlapped ppermute) comparison — no TPU needed; this is the subprocess
+bench.py embeds as ``measured_bubble_multistage``.
+
 ``python tools/multistage_probe.py [v ...]`` (default: 1 2 4) — one JSON
 line per variant:
 
@@ -30,12 +35,19 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if "--quick" in sys.argv:
+    # --quick cpu8 mode (the bench.py multistage hook): no TPU required.
+    # The platform MUST be forced before the jax import below binds a
+    # backend — this is why the block sits mid-imports.
+    from pipe_tpu.utils.platform import force_cpu_platform
+    force_cpu_platform(8)
+
 import jax
 import jax.numpy as jnp
 import optax
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
 
 from bench import (BATCH, CHUNKS, make_step, peak_flops_per_chip,
                    time_steps, train_flops_per_token, tutorial_config,
@@ -142,6 +154,22 @@ def main(vs):
             print(json.dumps(r), flush=True)
 
 
+def quick_main(n_stages: int = 4, chunks: int = 8):
+    """cpu8 quick probe: the standing 4-stage/8-chunk bubble measurement
+    plus the schedule AND transport (serialized vs packed-overlapped)
+    comparison, one JSON line — what bench.py embeds as
+    ``measured_bubble_multistage`` each round."""
+    from pipe_tpu.obs.bubble_probe import main as bubble_main
+    out = bubble_main(n_stages, chunks, compare_schedules=True,
+                      compare_transport=True)
+    out["mode"] = "quick-cpu8"
+    print(json.dumps(out), flush=True)
+
+
 if __name__ == "__main__":
-    args = [int(a) for a in sys.argv[1:]] or [1, 2, 4]
-    main(args)
+    if "--quick" in sys.argv:
+        pos = [int(a) for a in sys.argv[1:] if not a.startswith("--")]
+        quick_main(*pos[:2])
+    else:
+        args = [int(a) for a in sys.argv[1:]] or [1, 2, 4]
+        main(args)
