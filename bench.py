@@ -218,6 +218,51 @@ def time_steps(step_fn, params, opt_state, args, warmup=2, iters=8):
     return (time.perf_counter() - t0) / iters, last
 
 
+def trend_vs_prior_round(here, bubble_multistage):
+    """Trend vs the prior committed round: load the newest BENCH_r*.json
+    and put the head-to-head cpu8-probe deltas IN the output, so a
+    regression has to be explained in the artifact rather than noticed by
+    a diff-reader. Known history: the r4->r5 cpu8 probe slowdown (1f1b
+    1.300 -> 1.630 s/step) happened on an unchanged executor path and
+    reversed to 0.985 s/step in the round-7 quiet-host run
+    (MULTISTAGE_r07.json) — measurement-host contention, not a code
+    regression (FRONTDOOR_r07 records the same effect inflating
+    co-resident compiled programs up to ~1.8x, which is why that probe now
+    isolates each program in its own subprocess)."""
+    import glob
+
+    rounds = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if not rounds:
+        return None
+    prior_path = rounds[-1]
+    with open(prior_path) as f:
+        prior = json.load(f)
+    prior = prior.get("parsed", prior)
+    prior_ms = ((prior.get("measured_bubble_multistage") or {})
+                .get("schedules") or {})
+    cur_ms = ((bubble_multistage or {}).get("schedules") or {})
+    sched_trend = {}
+    for name in sorted(set(prior_ms) & set(cur_ms)):
+        p_sec = prior_ms[name].get("sec_per_step")
+        c_sec = cur_ms[name].get("sec_per_step")
+        if p_sec and c_sec:
+            sched_trend[name] = {"prior_sec": p_sec, "sec": c_sec,
+                                 "speedup": round(p_sec / c_sec, 4)}
+    trend = {
+        "prior": os.path.basename(prior_path)[:-len(".json")],
+        "tokens_per_sec_prior": prior.get("value"),
+        "cpu8_probe": sched_trend,
+    }
+    print(f"trend vs {trend['prior']} (cpu8 probe)", file=sys.stderr)
+    print(f"  {'schedule':<14} {'prior':>9} {'now':>9} {'speedup':>8}",
+          file=sys.stderr)
+    for name, row in sched_trend.items():
+        print(f"  {name:<14} {row['prior_sec']:>9.5f} "
+              f"{row['sec']:>9.5f} {row['speedup']:>7.2f}x",
+              file=sys.stderr)
+    return trend
+
+
 def main():
     # Hard-disable telemetry for every program this process times: the
     # null registry hands back shared no-op instruments, so not even
@@ -390,6 +435,8 @@ def main():
             summary = json.loads(out.stdout.strip().splitlines()[-1])
             front_door_tax = {
                 "tax_uniform_vs_raw": summary["tax_uniform_vs_raw"],
+                "tax_phase_vs_raw_phase":
+                    summary.get("tax_phase_vs_raw_phase"),
                 "tax_switch_vs_raw": summary["tax_switch_vs_raw"],
                 "raw_sec_per_step":
                     summary["results"]["raw"]["sec_per_step"],
@@ -399,6 +446,12 @@ def main():
                   f"{out.stderr[-2000:]}", file=sys.stderr)
     except Exception as e:
         print(f"front-door probe failed: {e}", file=sys.stderr)
+
+    trend_vs_prior = None
+    try:
+        trend_vs_prior = trend_vs_prior_round(here, bubble_multistage)
+    except Exception as e:
+        print(f"trend table failed: {e}", file=sys.stderr)
 
     # vs_baseline denominator = the FASTER of the two honest accumulation
     # programs (see make_plain_step), so the ratio never flatters the
@@ -475,6 +528,7 @@ def main():
         "measured_bubble_method": bubble_method,
         "measured_bubble_multistage": bubble_multistage,
         "front_door_tax": front_door_tax,
+        "trend_vs_prior": trend_vs_prior,
         "final_loss": round(loss, 4),
         "step_report": report.to_json(),
         "config": dataclasses.asdict(

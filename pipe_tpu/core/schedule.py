@@ -40,6 +40,14 @@ __all__ = [
     "shift_comm_tables",
     "verify_shifted_op_tables",
     "overlap_fifo_capacity",
+    "align_phase_tables",
+    "segment_phases",
+    "compile_phases",
+    "PhaseSegment",
+    "PhaseProgram",
+    "PhaseVerdict",
+    "PHASE_KLASS_FB",
+    "PHASE_KLASS_FBW",
 ]
 
 # Op codes for the (cycle, stage) tables driving the manual fwd+bwd executor
@@ -863,6 +871,321 @@ def verify_shifted_op_tables(op, mbi, grp=None, *, m: int, d: int,
         if wstash_slots is not None and splits_backward:
             _check_overlap_windows(t_b[:, s], t_w[:, s],
                                    wstash_slots, f"wstash (stage {s})")
+
+
+# ---------------------------------------------------------------------------
+# Phase compiler: warmup / steady-state / cooldown segmentation of op tables
+# ---------------------------------------------------------------------------
+#
+# The scan-based executors interpret the op tables per cycle: every body
+# carries a lax.switch over the op code plus sentinel-masked stores for the
+# branches not taken. The phase compiler removes that interpreter overhead
+# by compiling the table's STRUCTURE into the program: it re-times the
+# serialized table so that at every cycle all devices run the SAME op code
+# (cycle-uniformity — the only form of per-cycle specialization a single
+# shard_map trace can express without dynamic dispatch), then segments the
+# result into short warmup/cooldown ramps (unrolled straight-line, partial
+# idles masked by data selects) and maximal dense periodic steady-state
+# windows (a fixed-body lax.scan whose body is the period's concrete op
+# sequence — no switch, no masked no-ops: every device is busy every cycle).
+#
+# Bitwise contract: the aligner may change how F and B ops INTERLEAVE on a
+# device (the serialized 1F1B in-flight window is provably too small for
+# the hop-2 transport latency — keeping its total order forces steady-state
+# stalls), but it preserves each (stage, op-code) stream's order. F ops and
+# B/W ops touch disjoint accumulators (loss/stats vs grads), so preserving
+# per-code order per stage preserves every accumulation order — results
+# stay bitwise identical to the interpreted executor on the original table.
+
+#: Residue classes per op code used by the alignment retimer: scheduling
+#: each code only on its own residue (mod the class modulus) makes steady
+#: state cycle-uniform by construction. ``PHASE_KLASS_FB`` alternates
+#: all-F / all-B cycles (period 2: 1f1b lineage); ``PHASE_KLASS_FBW``
+#: rotates all-F / all-B / all-W (period 3: split-backward lineage).
+PHASE_KLASS_FB = {FWD: (0, 2), BWD: (1, 2)}
+PHASE_KLASS_FBW = {FWD: (0, 3), BWD: (1, 3), WGRAD: (2, 3)}
+
+
+def align_phase_tables(op, mbi, grp=None, *, m: int, d: int, v: int = 1,
+                       hop: int = 2, klass=None,
+                       priority=(BWD, WGRAD, FWD)):
+    """Re-time a serialized table for the overlapped-transport contract via
+    time-stepped list scheduling; returns ``(op, mb, grp)`` device tables.
+
+    Unlike :func:`shift_comm_tables` (which preserves each device's TOTAL
+    op order and therefore inherits the serialized schedule's in-flight
+    window — too small for hop-2 latency, leaving idle holes in steady
+    state), this pass preserves only each device's PER-CODE op order (the
+    bitwise-parity invariant, see module comment above) and re-derives the
+    interleaving: at each cycle every device issues the highest-priority
+    code whose residue class (``klass``) admits the cycle and whose queue
+    head is dependency-ready under the hop-latency contract
+
+    * ``FWD(i, s)  >= FWD(i, s-1) + hop``
+    * ``BWD(i, s)  >= BWD(i, s+1) + hop`` and ``> FWD(i, s)``
+    * ``WGRAD(i, s) > BWD(i, s)``
+
+    Default ``priority`` drains backwards eagerly, which caps the live
+    stash window at O(d·v·hop) without an explicit in-flight limit.
+    """
+    grp_in = grp if grp is not None else np.zeros_like(op)
+    S = v * d
+    q = {c: [[] for _ in range(d)] for c in (FWD, BWD, WGRAD)}
+    for t in range(op.shape[0]):
+        for p in range(op.shape[1]):
+            c = int(op[t, p])
+            if c == IDLE:
+                continue
+            q[c][p].append((int(mbi[t, p]), int(grp_in[t, p]) * d + p))
+    times = {FWD: np.full((m, S), -1), BWD: np.full((m, S), -1),
+             WGRAD: np.full((m, S), -1)}
+    head = {c: [0] * d for c in (FWD, BWD, WGRAD)}
+    events = []
+    n_total = sum(len(q[c][p]) for c in q for p in range(d))
+    n_done = 0
+    max_T = (hop + 1) * (op.shape[0] + 4) + 8
+    for t in range(max_T):
+        if n_done == n_total:
+            break
+        for p in range(d):
+            for c in priority:
+                h = head[c][p]
+                if h >= len(q[c][p]):
+                    continue
+                if klass is not None:
+                    r, M = klass.get(c, (0, 1))
+                    if (t % M) != r:
+                        continue
+                i, s = q[c][p][h]
+                if c == FWD:
+                    if s > 0 and not (0 <= times[FWD][i, s - 1] <= t - hop):
+                        continue
+                elif c == BWD:
+                    if not (0 <= times[FWD][i, s] < t):
+                        continue
+                    if s + 1 < S and not (0 <= times[BWD][i, s + 1]
+                                          <= t - hop):
+                        continue
+                else:
+                    if not (0 <= times[BWD][i, s] < t):
+                        continue
+                times[c][i, s] = t
+                head[c][p] = h + 1
+                events.append((t, p, c, i, s // d))
+                n_done += 1
+                break
+    if n_done != n_total:
+        raise AssertionError(
+            f"phase alignment did not converge ({n_done}/{n_total} ops "
+            f"placed in {max_T} cycles; klass={klass})")
+    T2 = max(e[0] for e in events) + 1
+    op2 = np.full((T2, d), IDLE, np.int32)
+    mb2 = np.zeros((T2, d), np.int32)
+    gr2 = np.zeros((T2, d), np.int32)
+    for t2, p, c, i, g in events:
+        op2[t2, p], mb2[t2, p], gr2[t2, p] = c, i, g
+    return op2, mb2, gr2
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseSegment:
+    """One compiled phase: cycles ``[t0, t1)`` of the aligned table.
+
+    ``kind == 'unroll'``: ramp cycles, lowered to straight-line code (each
+    cycle's single op code is a trace-time constant; devices idle at a
+    cycle are masked by data selects into sentinel slots). ``kind ==
+    'scan'``: a dense periodic steady-state window — ``(t1 - t0) //
+    period`` iterations of the fixed ``codes`` body, every device busy
+    every cycle."""
+    kind: str
+    t0: int
+    t1: int
+    period: int = 0
+    codes: Tuple[int, ...] = ()
+
+    @property
+    def cycles(self) -> int:
+        return self.t1 - self.t0
+
+    @property
+    def iters(self) -> int:
+        return (self.t1 - self.t0) // self.period if self.period else 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseProgram:
+    """Accepted phase compilation: aligned tables + segmentation."""
+    op: np.ndarray
+    mbi: np.ndarray
+    grp: np.ndarray
+    segments: Tuple[PhaseSegment, ...]
+    policy: str                    # which klass/priority candidate won
+    cycle_codes: Tuple[int, ...]   # per-cycle uniform op code (IDLE ok)
+    dense: Tuple[bool, ...]        # per-cycle: True = no device idles
+
+    @property
+    def cycles(self) -> int:
+        return int(self.op.shape[0])
+
+    @property
+    def unrolled_cycles(self) -> int:
+        return sum(s.cycles for s in self.segments if s.kind == "unroll")
+
+    @property
+    def scan_cycles(self) -> int:
+        return sum(s.cycles for s in self.segments if s.kind == "scan")
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseVerdict:
+    """Accept/reject result of :func:`compile_phases`. Rejected tables run
+    on the interpreted executor (the caller falls back loudly)."""
+    accepted: bool
+    reason: str
+    program: Optional[PhaseProgram] = None
+
+
+def _phase_cycle_summary(op, d):
+    """Per-cycle ``(code, dense)``: the single non-idle op code (None when
+    codes are mixed — not phase-compilable) and whether no device idles."""
+    out = []
+    for t in range(op.shape[0]):
+        codes = {int(op[t, p]) for p in range(d)}
+        nonidle = codes - {IDLE}
+        if len(nonidle) > 1:
+            out.append((None, False))
+        elif not nonidle:
+            out.append((IDLE, False))
+        else:
+            out.append((nonidle.pop(), IDLE not in codes))
+    return out
+
+
+def segment_phases(op, d, *, min_reps: int = 2,
+                   max_period: int = 6) -> Optional[Tuple[PhaseSegment, ...]]:
+    """Segment an aligned table into unroll ramps and dense periodic scan
+    windows. Returns None when any cycle mixes op codes across devices
+    (no single shard_map trace can specialize it without dispatch)."""
+    summary = _phase_cycle_summary(op, d)
+    if any(c is None for c, _ in summary):
+        return None
+    T = len(summary)
+    segments: List[PhaseSegment] = []
+    t = 0
+    pend_unroll = 0
+    while t < T:
+        if not summary[t][1]:
+            pend_unroll += 1
+            t += 1
+            continue
+        t1 = t
+        while t1 < T and summary[t1][1]:
+            t1 += 1
+        codes = [summary[k][0] for k in range(t, t1)]
+        best = None
+        for P in range(1, min(max_period, len(codes)) + 1):
+            if len(codes) // P < min_reps:
+                break
+            if all(codes[k] == codes[k % P] for k in range(len(codes))):
+                best = P
+                break
+        if best is None:
+            pend_unroll += t1 - t
+            t = t1
+            continue
+        n_iters = len(codes) // best
+        t_scan_end = t + n_iters * best
+        if pend_unroll:
+            segments.append(PhaseSegment("unroll", t - pend_unroll, t))
+            pend_unroll = 0
+        segments.append(PhaseSegment("scan", t, t_scan_end, period=best,
+                                     codes=tuple(codes[:best])))
+        pend_unroll = t1 - t_scan_end
+        t = t1
+    if pend_unroll:
+        segments.append(PhaseSegment("unroll", T - pend_unroll, T))
+    return tuple(segments)
+
+
+def _per_code_stage_order(op, mbi, grp, d):
+    """Per (virtual stage, code) micro-batch order — the accumulation
+    orders that must survive alignment for bitwise parity."""
+    order: dict = {}
+    for t in range(op.shape[0]):
+        for p in range(op.shape[1]):
+            c = int(op[t, p])
+            if c == IDLE:
+                continue
+            g = int(grp[t, p]) if grp is not None else 0
+            order.setdefault((g * d + p, c), []).append(int(mbi[t, p]))
+    return order
+
+
+def compile_phases(op, mbi, grp=None, *, m: int, d: int, v: int = 1,
+                   hop: int = 2, max_unroll: Optional[int] = None,
+                   max_period: int = 6) -> PhaseVerdict:
+    """Phase-compile a SERIALIZED op table (the universal schedule
+    currency, see :func:`verify_op_tables`): try the alignment policies,
+    verify each result against the overlapped-transport invariants
+    (:func:`verify_shifted_op_tables` — the ``comm_shift`` contract) and
+    the per-code order-preservation guarantee, segment it, and return the
+    best accepted :class:`PhaseVerdict`.
+
+    Acceptance requires the unrolled ramps to stay short (``max_unroll``,
+    default ``8·d·v + 4·hop + 8`` — O(stages), so trace size does not grow
+    with m) — a table with no usable steady window on a large m rejects
+    rather than unrolling unboundedly. ``d == 1`` rejects (the static
+    unroll path already specializes single-device tables at trace time)."""
+    if d <= 1:
+        return PhaseVerdict(False, "d == 1: no transport to phase "
+                            "(static unroll already specializes)")
+    if max_unroll is None:
+        max_unroll = 8 * d * v + 4 * hop + 8
+    splits = bool((np.asarray(op) == WGRAD).any())
+    candidates = []
+    if splits:
+        candidates.append(("fbw3", PHASE_KLASS_FBW, (BWD, WGRAD, FWD)))
+        candidates.append(("none", None, (BWD, WGRAD, FWD)))
+    else:
+        candidates.append(("fb2", PHASE_KLASS_FB, (BWD, WGRAD, FWD)))
+        candidates.append(("none-ffirst", None, (FWD, BWD, WGRAD)))
+        candidates.append(("none", None, (BWD, WGRAD, FWD)))
+    want_order = _per_code_stage_order(op, mbi, grp, d)
+    best = None
+    reasons = []
+    for name, klass, prio in candidates:
+        try:
+            op2, mb2, gr2 = align_phase_tables(
+                op, mbi, grp, m=m, d=d, v=v, hop=hop, klass=klass,
+                priority=prio)
+            verify_shifted_op_tables(
+                op2, mb2, gr2 if (grp is not None or v > 1) else None,
+                m=m, d=d, v=v, hop=hop, splits_backward=splits)
+            got_order = _per_code_stage_order(op2, mb2, gr2, d)
+            if got_order != want_order:
+                raise AssertionError("per-code stage order changed")
+        except AssertionError as e:
+            reasons.append(f"{name}: {e}")
+            continue
+        segments = segment_phases(op2, d, max_period=max_period)
+        if segments is None:
+            reasons.append(f"{name}: mixed-code cycles survive alignment")
+            continue
+        prog = PhaseProgram(
+            op2, mb2, gr2, segments, name,
+            tuple(c for c, _ in _phase_cycle_summary(op2, d)),
+            tuple(dn for _, dn in _phase_cycle_summary(op2, d)))
+        if prog.unrolled_cycles > max_unroll:
+            reasons.append(
+                f"{name}: {prog.unrolled_cycles} unrolled cycles exceed "
+                f"the {max_unroll}-cycle ramp budget")
+            continue
+        score = (prog.scan_cycles / max(prog.cycles, 1), -prog.cycles)
+        if best is None or score > best[0]:
+            best = (score, prog)
+    if best is None:
+        return PhaseVerdict(False, "; ".join(reasons) or "no candidates")
+    return PhaseVerdict(True, f"policy {best[1].policy}", best[1])
 
 
 _SCHEDULES = {

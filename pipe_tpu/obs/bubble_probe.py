@@ -124,6 +124,20 @@ def main(n_stages: int = 4, chunks: int = 8,
             configs.insert(1, ("1f1b-overlap",
                                dict(checkpoint="never", schedule="1f1b",
                                     overlap_transport=True)))
+            # Phase-compiled rows (forced: auto keeps phased off on cpu).
+            # CAVEAT for reading these on cpu8: the virtual mesh serializes
+            # all devices onto one host core, so the phased ramps' masked
+            # cycles — where an idle device executes the cycle's op on
+            # garbage and discards it, free on real parallel hardware —
+            # show up as REAL extra host work. The cpu8 phased rows
+            # therefore upper-bound the phased program's cost; the
+            # switch-free steady state is the part that transfers.
+            configs += [
+                ("1f1b-phase", dict(checkpoint="never", schedule="1f1b",
+                                    phase_compile=True)),
+                ("zb-h1-phase", dict(checkpoint="never", schedule="zb-h1",
+                                     phase_compile=True)),
+            ]
 
         def step_time_sched(pipe, mm: int) -> float:
             xx, nr = make_batch(mm)
@@ -148,6 +162,12 @@ def main(n_stages: int = 4, chunks: int = 8,
                 "analytic_bubble": round(
                     pipe.schedule.bubble(m, n_stages), 4),
             }
+            if kw_s.get("phase_compile"):
+                prog = pipe._phase_program(m)
+                scheds[name]["phase"] = (
+                    {"unrolled_cycles": prog.unrolled_cycles,
+                     "scan_cycles": prog.scan_cycles}
+                    if prog is not None else "rejected")
             if compare_transport and name in ("1f1b", "1f1b-overlap"):
                 # per-transport measured bubble from the same m/2m slope
                 # the headline probe uses, but through the TABLE executor

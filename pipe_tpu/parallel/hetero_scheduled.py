@@ -60,7 +60,7 @@ class HeteroScheduledPipeline:
 
     def __init__(self, mesh, partitions, skip_layout, chunks: int,
                  checkpoint: str, schedule, remat_policy=None,
-                 overlap_transport=None):
+                 overlap_transport=None, phase_compile=None):
         self.mesh = mesh
         self.d = mesh.shape[STAGE_AXIS]
         self.remat_policy = remat_policy
@@ -70,6 +70,11 @@ class HeteroScheduledPipeline:
         # per-direction engine. The eval forward() path is unaffected
         # (its FWD-masked tables always run serialized).
         self.overlap_transport = overlap_transport
+        # Phase-compiled table lowering (unrolled ramps + switch-free
+        # steady-state scan), forwarded verbatim to the inner
+        # ScheduledPipeline — tri-state, same contract as its
+        # ``phase_compile`` field.
+        self.phase_compile = phase_compile
         self.schedule: Schedule = (get_schedule(schedule)
                                    if isinstance(schedule, str) else schedule)
         self.v = self.schedule.v
@@ -145,6 +150,48 @@ class HeteroScheduledPipeline:
         rows = self.param_pack.unshard(packed)
         return [rows[self.row_of(s)] for s in range(self.S)]
 
+    # -- uniform fast-path param views (traced) ----------------------------
+    def _unpacked_param_tree(self, packed):
+        """Packed ``{dtype: [S, cap]}`` rows → the natural stage-stacked
+        tree (leaf ``[S, ...]``) the raw homogeneous executor takes. Static
+        per-row slices + reshapes, so the stage-axis sharding propagates
+        untouched. Only valid under the uniform fast path (every row shares
+        one layout/treedef); removes the per-cycle ``unpack_stage``
+        slice/reshape chain from the hot loop."""
+        pack = self.param_pack
+        plan = pack.plans[0]
+        offsets = {dt: 0 for dt in pack.capacities}
+        leaves = []
+        for spec, size, dt in zip(plan.specs, plan.sizes, plan.dtypes):
+            off = offsets[dt]
+            flat = jax.lax.slice_in_dim(packed[dt], off, off + size, axis=1)
+            offsets[dt] = off + size
+            leaves.append(jnp.reshape(flat, (self.S,) + tuple(spec.shape)))
+        return jax.tree_util.tree_unflatten(pack.treedefs[0], leaves)
+
+    def _repack_param_tree(self, tree):
+        """Inverse of :meth:`_unpacked_param_tree`, applied to the GRADS so
+        the fast path still returns cotangents in the packed layout the
+        caller's optimizer state is keyed on. Pure reshape/concat/pad —
+        value-preserving, zero cotangent in the padding."""
+        pack = self.param_pack
+        plan = pack.plans[0]
+        leaves = jax.tree_util.tree_leaves(tree)
+        chunks: Dict[str, list] = {dt: [] for dt in pack.capacities}
+        for leaf, size, dt in zip(leaves, plan.sizes, plan.dtypes):
+            chunks[dt].append(jnp.reshape(leaf, (self.S, size)))
+        out = {}
+        for dt, cap in pack.capacities.items():
+            if chunks[dt]:
+                flat = (jnp.concatenate(chunks[dt], axis=1)
+                        if len(chunks[dt]) > 1 else chunks[dt][0])
+                pad = cap - flat.shape[1]
+                out[dt] = (jnp.pad(flat, ((0, 0), (0, pad)))
+                           if pad else flat)
+            else:
+                out[dt] = jnp.zeros((self.S, cap), dtype=np.dtype(dt))
+        return out
+
     def memory_plan(self, m: Optional[int] = None) -> dict:
         from .scheduled import SkipLanes
         # lane specs are per-call (they depend on input shapes), but the
@@ -156,7 +203,8 @@ class HeteroScheduledPipeline:
                                remat_policy=self._train_remat_policy(),
                                skip_lanes=(SkipLanes(self.lane_pairs, ())
                                            if self.lane_pairs else None),
-                               overlap_transport=self.overlap_transport)
+                               overlap_transport=self.overlap_transport,
+                               phase_compile=self.phase_compile)
         return sp.memory_plan(m if m is not None else self.chunks)
 
     def _train_remat_policy(self):
@@ -263,8 +311,17 @@ class HeteroScheduledPipeline:
                             or jnp.result_type(a) != jnp.result_type(b)
                             or not bool(jnp.all(jnp.equal(a, b)))):
                         return False
-        except Exception:
-            return False        # tracing hiccup: keep the general switch
+        except Exception as e:
+            # Tracing hiccup: keep the general switch — correct, but ~2x
+            # slower, so say WHY out loud instead of degrading silently
+            # (VERDICT r5 #3: any probe failure used to disable the fast
+            # path forever with no signal).
+            import warnings
+            warnings.warn(
+                "uniform-partition fast-path probe failed while tracing "
+                f"stage {s_idx} ({type(e).__name__}: {e}); falling back "
+                "to the per-cycle lax.switch executor", stacklevel=3)
+            return False
         return True
 
     def _record_fastpath(self, surface: str) -> None:
@@ -514,10 +571,19 @@ class HeteroScheduledPipeline:
         self.uniform_fastpath = self._branches_uniform(low, train=train)
         self._record_fastpath("forward")
         if self.uniform_fastpath:
-            def stage_fn(params_g, h, ctx, pops=None):
-                # uniform partitions: one shared branch, no lax.switch —
-                # the raw homogeneous executor's program
-                return branches[0](params_g, h, ctx, pops)
+            # Identity lowering (see loss_and_grad): native boundary-value
+            # carrier + natural stage-stacked params — the interleaved
+            # (v > 1) eval front door emits the raw executor's program too.
+            part0 = self.partitions[0]
+
+            def pre_fn(prep, x_mb, ctx):  # noqa: F811 — fast-path override
+                del prep
+                return tuple(x_mb[str(p)] for p in dyn_pos)
+
+            def stage_fn(params_g, h, ctx):
+                out = part0.apply(params_g, *h, ctx=ctx)
+                return (tuple(out) if isinstance(out, (tuple, list))
+                        else (out,))
         else:
             def stage_fn(params_g, h, ctx, pops=None):
                 s = ctx.stage
@@ -537,9 +603,17 @@ class HeteroScheduledPipeline:
                                stat_spec=stat_spec)
         # out_fn unpacks the final-boundary carrier into row-major values
         # INSIDE the device program, so the data axis lands on the rows
-        # dim of the collected outputs
-        res = sp.forward(params, (), low["stacked"], key=key, train=train,
-                         out_fn=lambda h: tuple(plans[self.S].unpack(h)))
+        # dim of the collected outputs (the fast path's carrier IS the
+        # value tuple — nothing to unpack)
+        if self.uniform_fastpath:
+            res = sp.forward(self._unpacked_param_tree(params), (),
+                             low["stacked"], key=key, train=train,
+                             out_fn=lambda h: h)
+        else:
+            res = sp.forward(params, (), low["stacked"], key=key,
+                             train=train,
+                             out_fn=lambda h: tuple(
+                                 plans[self.S].unpack(h)))
         outs, stats_t = res if collect_stats else (res, None)
         n_out = len(boundaries[self.S])
         gathered = []
@@ -690,10 +764,37 @@ class HeteroScheduledPipeline:
         self.uniform_fastpath = self._branches_uniform(low, train=True)
         self._record_fastpath("loss_and_grad")
         if self.uniform_fastpath:
-            def stage_fn(params_g, h, ctx, pops=None):
-                # uniform partitions: one shared branch, no lax.switch —
-                # the raw homogeneous executor's program
-                return branches[0](params_g, h, ctx, pops)
+            # Uniform partitions: identity lowering. The switch is gone AND
+            # the adapter machinery goes with it — the carrier is the raw
+            # boundary value tuple (every boundary spec is identical, so the
+            # ring is uniform without PackPlan's flatten/pad/slice per
+            # cycle), and params flow as the natural stage-stacked tree
+            # (one slice/reshape per step via _unpacked_param_tree, not one
+            # unpack_stage chain per cycle). This is the program the raw
+            # homogeneous ScheduledPipeline emits — the front-door tax is
+            # the jaxpr-equality probe, paid once per configuration.
+            part0 = self.partitions[0]
+
+            def pre_fn(prep, x_mb, ctx):
+                del prep
+                return tuple(x_mb["in"][str(p)] for p in dyn_pos)
+
+            def stage_fn(params_g, h, ctx):
+                out = part0.apply(params_g, *h, ctx=ctx)
+                return (tuple(out) if isinstance(out, (tuple, list))
+                        else (out,))
+
+            def post_fn(postp, h, x_mb, ctx):
+                del postp
+                args = list(h)
+                if targets is not None:
+                    args.append(x_mb["tgt"])
+                per_row = loss_fn(*args)
+                if jnp.ndim(per_row) != 1:
+                    raise ValueError(
+                        f"loss_fn must return per-row losses [rows]; got "
+                        f"shape {jnp.shape(per_row)}")
+                return per_row
         else:
             def stage_fn(params_g, h, ctx, pops=None):
                 s = ctx.stage
@@ -704,18 +805,18 @@ class HeteroScheduledPipeline:
                         b(pg, hh, c, pp)
                         for b in branches])
 
-        def post_fn(postp, h, x_mb, ctx):
-            del postp
-            outs = plans[self.S].unpack(h)
-            args = list(outs)
-            if targets is not None:
-                args.append(x_mb["tgt"])
-            per_row = loss_fn(*args)
-            if jnp.ndim(per_row) != 1:
-                raise ValueError(
-                    f"loss_fn must return per-row losses [rows]; got shape "
-                    f"{jnp.shape(per_row)}")
-            return per_row
+            def post_fn(postp, h, x_mb, ctx):
+                del postp
+                outs = plans[self.S].unpack(h)
+                args = list(outs)
+                if targets is not None:
+                    args.append(x_mb["tgt"])
+                per_row = loss_fn(*args)
+                if jnp.ndim(per_row) != 1:
+                    raise ValueError(
+                        f"loss_fn must return per-row losses [rows]; got "
+                        f"shape {jnp.shape(per_row)}")
+                return per_row
 
         x = {"in": stacked}
         if tgt_stacked is not None:
@@ -729,7 +830,8 @@ class HeteroScheduledPipeline:
                                skip_lanes=(SkipLanes(lane_pairs, lane_specs)
                                            if has_lanes else None),
                                stat_spec=stat_spec,
-                               overlap_transport=self.overlap_transport)
+                               overlap_transport=self.overlap_transport,
+                               phase_compile=self.phase_compile)
         # stage-sharded packed rows ARE the stacked stage params; () for
         # pre/post (packing has no weights; the loss is pure)
         if collect_stats:
@@ -740,6 +842,12 @@ class HeteroScheduledPipeline:
                 for k_, stv in zip(stat_keys[s_idx], stats_t[s_idx]):
                     stats[k_] = stv
             return loss, g_packed, stats
+        if self.uniform_fastpath:
+            # grads come back against the natural stacked tree; repack so
+            # the caller's optimizer state stays keyed on the packed layout
+            loss, (g_tree, _, _) = sp.loss_and_grad(
+                self._unpacked_param_tree(params), (), (), x, w, key=key)
+            return loss, self._repack_param_tree(g_tree)
         loss, (g_packed, _, _) = sp.loss_and_grad(params, (), (), x, w,
                                                   key=key)
         return loss, g_packed

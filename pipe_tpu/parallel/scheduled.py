@@ -89,9 +89,9 @@ from ..core.partition import StageCtx
 from ..core.remat import validate_mode
 from ..core.schedule import (BWD, FWD, IDLE, WGRAD, GPipeSchedule,
                              InterleavedOneFOneBSchedule, OneFOneBSchedule,
-                             Schedule, get_schedule, shift_comm_tables,
-                             verify_shifted_op_tables, overlap_joint_capacity,
-                             _times_by_code)
+                             Schedule, compile_phases, get_schedule,
+                             shift_comm_tables, verify_shifted_op_tables,
+                             overlap_joint_capacity, _times_by_code)
 from .buffers import pack_words, packed_words, unpack_words
 from .mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from ..obs.telemetry import get_registry
@@ -336,6 +336,27 @@ class ScheduledPipeline:
     # are bitwise-identical to the serialized path: the retimer preserves
     # per-device op order, and packing is a pure bitcast.
     overlap_transport: Optional[bool] = None
+    # Phase-compiled execution (core.schedule.compile_phases): the op table
+    # is re-timed into cycle-uniform phases and each phase lowers
+    # separately — warmup/cooldown ramps unroll to straight-line code
+    # (each cycle's single op code is a trace-time constant; partially
+    # idle cycles mask their stores/accumulators by data selects), and the
+    # dense periodic steady state lowers to a fixed-body ``lax.scan``
+    # whose body is the period's concrete (fwd, bwd[, wgrad]) sequence —
+    # NO ``lax.switch`` dispatch and NO sentinel-masked no-op branches:
+    # every device runs real work every steady cycle. Rides the packed
+    # double-buffered overlap transport (the aligner emits hop-2 tables)
+    # and the (values, slot) store discipline, so XLA buffer aliasing
+    # survives. None = auto: ON for d > 1 on accelerator backends when the
+    # compiler accepts the table, OFF on CPU meshes (explicit True forces
+    # it anywhere, which is how the cpu8 probes run it). Tables the
+    # compiler rejects fall back loudly to the interpreted executor
+    # (warnings.warn + the scheduled.phase.rejected counter). Bitwise
+    # parity with the interpreted executor: the aligner preserves each
+    # (stage, op-code) stream's order — F ops feed loss/stats and B/W ops
+    # feed the grad accumulators, disjoint state — so every accumulation
+    # order is preserved even though F/B interleaving changes.
+    phase_compile: Optional[bool] = None
 
     def __post_init__(self):
         validate_mode(self.checkpoint)
@@ -434,6 +455,10 @@ class ScheduledPipeline:
         if self.context_axis and self.context_axis not in self.mesh.axis_names:
             raise ValueError(
                 f"mesh has no {self.context_axis!r} axis for context_axis")
+        # per-m phase-compiler verdicts (host-side analysis, ms-scale, but
+        # the reject warning must fire once per (pipeline, m), not per
+        # retrace)
+        self._phase_cache = {}
 
     # -----------------------------------------------------------------
     def memory_plan(self, m: int) -> dict:
@@ -442,8 +467,13 @@ class ScheduledPipeline:
         counts come from the comm-shifted tables (stash windows widen by
         the extra in-flight cycle; a small grad park appears)."""
         d, v = self.n_stages, self.v
-        overlap = self._overlap_enabled()
-        if overlap:
+        phased = self._phase_program(m)
+        overlap = phased is not None or self._overlap_enabled()
+        if phased is not None:
+            (op_np, mb_np, grp_np, _, _), _, Sg, Gg, Wg_ov, _, _ = \
+                self._host_tables_phased(m)
+            Wg = Wg_ov if self.checkpoint == "never" else 0
+        elif overlap:
             (op_np, mb_np, grp_np, _, _), _, Sg, Gg, Wg_ov, _, _ = \
                 self._host_tables_overlap(m)
             Wg = Wg_ov if self.checkpoint == "never" else 0
@@ -469,9 +499,17 @@ class ScheduledPipeline:
                 "taps_slots": (v * Sg if self.split_stage is not None
                                else 0),
                 "virtual_stages_per_device": v,
-                "transport": "overlapped" if overlap else "serialized"}
+                "transport": ("phase-compiled" if phased is not None
+                              else "overlapped" if overlap
+                              else "serialized")}
         if overlap:
             plan["grad_park_slots"] = v * Gg
+        if phased is not None:
+            plan["phase_segments"] = tuple(
+                (s_.kind, s_.t0, s_.t1, s_.period)
+                for s_ in phased.segments)
+            plan["phase_unrolled_cycles"] = phased.unrolled_cycles
+            plan["phase_scan_cycles"] = phased.scan_cycles
         if self.skip_lanes is not None:
             if not overlap:
                 tables = self.schedule.op_tables(m, d)
@@ -486,6 +524,8 @@ class ScheduledPipeline:
         return plan
 
     def _cycles(self, m: int) -> int:
+        if self._phase_program(m) is not None:
+            return self._phase_program(m).cycles
         if self._overlap_enabled():
             return self._host_tables_overlap(m)[1]
         tables = self.schedule.op_tables(m, self.n_stages)
@@ -499,6 +539,42 @@ class ScheduledPipeline:
         if self.overlap_transport is not None:
             return bool(self.overlap_transport)
         return self.mesh.devices.flat[0].platform != "cpu"
+
+    def _phase_verdict(self, m):
+        """Phase-compile this pipeline's table at m (cached per m). On
+        rejection: bump the fallback counter and — when the user explicitly
+        asked for phase compilation — warn once, naming the reason."""
+        if m not in self._phase_cache:
+            tables = self.schedule.op_tables(m, self.n_stages)
+            op0, mb0 = tables[0], tables[1]
+            grp0 = tables[2] if len(tables) > 2 else None
+            verdict = compile_phases(op0, mb0, grp0, m=m, d=self.n_stages,
+                                     v=self.v)
+            if verdict.accepted:
+                get_registry().counter("scheduled.phase.compiled").inc()
+            else:
+                get_registry().counter("scheduled.phase.rejected").inc()
+                if self.phase_compile:
+                    warnings.warn(
+                        f"phase_compile=True but the phase compiler "
+                        f"rejected the {self.schedule.name!r} op table at "
+                        f"m={m} ({verdict.reason}); falling back to the "
+                        f"interpreted table executor", stacklevel=3)
+            self._phase_cache[m] = verdict
+        return self._phase_cache[m]
+
+    def _phase_program(self, m):
+        """Resolve the ``phase_compile`` tri-state to an accepted
+        :class:`~pipe_tpu.core.schedule.PhaseProgram`, or None for the
+        interpreted executor (disabled, d == 1, auto-off on CPU, or the
+        compiler rejected the table — the loud path in _phase_verdict)."""
+        if self.n_stages <= 1 or self.phase_compile is False:
+            return None
+        if (self.phase_compile is None
+                and self.mesh.devices.flat[0].platform == "cpu"):
+            return None
+        verdict = self._phase_verdict(m)
+        return verdict.program if verdict.accepted else None
 
     # -----------------------------------------------------------------
     def loss_and_grad(self, stage_params, pre_params, post_params, x, w,
@@ -524,8 +600,16 @@ class ScheduledPipeline:
         # compile-cache-miss signal.
         get_registry().counter("scheduled.loss_and_grad.lowerings").inc()
         get_registry().gauge("scheduled.cycles").set(self._cycles(m))
-        overlap = self._overlap_enabled()
+        phased = self._phase_program(m)
+        overlap = phased is not None or self._overlap_enabled()
         get_registry().gauge("scheduled.transport.overlap").set(int(overlap))
+        get_registry().gauge("scheduled.phase.active").set(
+            int(phased is not None))
+        if phased is not None:
+            get_registry().gauge("scheduled.phase.scan_cycles").set(
+                phased.scan_cycles)
+            get_registry().gauge("scheduled.phase.unrolled_cycles").set(
+                phased.unrolled_cycles)
         if self.n_stages > 1:
             # per-cycle collective count: the overlapped path packs every
             # boundary leaf and lane into one buffer per direction;
@@ -1049,16 +1133,37 @@ class ScheduledPipeline:
         compute at body t-1 (serialized: end-of-body permute; overlapped:
         start-of-next-body permute). ``gxslot`` is its reverse-direction
         twin for the grad park."""
-        d, v = self.n_stages, self.v
-        S = v * d
-        tables = self.schedule.op_tables(m, d)
+        tables = self.schedule.op_tables(m, self.n_stages)
         if len(tables) == 2:
             op0, mb0 = tables
             grp0 = None
         else:
             op0, mb0, grp0 = tables
-        op_np, mb_np, grp_np = shift_comm_tables(op0, mb0, grp0,
-                                                 m=m, d=d, v=v)
+        op_np, mb_np, grp_np = shift_comm_tables(
+            op0, mb0, grp0, m=m, d=self.n_stages, v=self.v)
+        return self._overlap_plans(op_np, mb_np, grp_np, m,
+                                   has_grp=grp0 is not None)
+
+    def _host_tables_phased(self, m):
+        """Plans for the phase-compiled executor: identical structure to
+        :meth:`_host_tables_overlap` (the aligner emits hop-2 tables that
+        honor the same park-after-compute transport contract), but the
+        tables come from :func:`~pipe_tpu.core.schedule.compile_phases` —
+        cycle-uniform, segmented into ramps and dense periodic windows.
+        Callers must only reach here with an accepted verdict."""
+        prog = self._phase_program(m)
+        if prog is None:
+            raise AssertionError(
+                "_host_tables_phased called without an accepted phase "
+                "program — the caller must fall back to the interpreter")
+        return self._overlap_plans(prog.op, prog.mbi, prog.grp, m,
+                                   has_grp=self.v > 1)
+
+    def _overlap_plans(self, op_np, mb_np, grp_np, m, *, has_grp):
+        """Capacity + park plans for hop-2 (overlapped-transport) tables —
+        shared by the comm-shifted and phase-aligned paths."""
+        d, v = self.n_stages, self.v
+        S = v * d
         T = op_np.shape[0]
         t_f, t_b, t_w = _times_by_code(op_np, mb_np, grp_np, m, d, v)
         read_last = np.maximum(t_f, np.maximum(t_b, t_w))
@@ -1074,7 +1179,7 @@ class ScheduledPipeline:
             [(t_b[:, s], t_w[:, s]) for s in range(S)], m)
             if split_dce else 0)
         verify_shifted_op_tables(
-            op_np, mb_np, grp_np if grp0 is not None else None,
+            op_np, mb_np, grp_np if has_grp else None,
             m=m, d=d, v=v, splits_backward=has_w, stash_slots=Sg,
             grad_slots=Gg, wstash_slots=Wg if split_dce else None)
         sentinel = v * Sg
@@ -1460,8 +1565,15 @@ class ScheduledPipeline:
             get_registry().counter("scheduled.program.static_unroll").inc()
             return self._device_program_static(
                 stage_params, pre_params, post_params, x, w, wsum, key, m=m)
-        get_registry().counter("scheduled.program.dynamic_scan").inc()
-        overlap = self._overlap_enabled()
+        phased_prog = self._phase_program(m)
+        if phased_prog is not None:
+            get_registry().counter("scheduled.program.phase_compiled").inc()
+        else:
+            get_registry().counter("scheduled.program.dynamic_scan").inc()
+        # The phased path IS an overlap-transport program: the aligner
+        # emits hop-2 tables and the body reuses the packed double-buffered
+        # carriers, parks and capacities unchanged.
+        overlap = phased_prog is not None or self._overlap_enabled()
         j = jax.lax.axis_index(STAGE_AXIS)
         # This device's shard: [v, ...] — its interleave groups in order.
         params_dev = stage_params
@@ -1516,7 +1628,9 @@ class ScheduledPipeline:
         # --- schedule tables (static data → scan xs) ---------------------
         if overlap:
             ((op_np, mb_np, grp_np, rxslot_np, gxslot_np), T, Sg, Gg,
-             Wg_ov, sentinel, gsentinel) = self._host_tables_overlap(m)
+             Wg_ov, sentinel, gsentinel) = (
+                 self._host_tables_phased(m) if phased_prog is not None
+                 else self._host_tables_overlap(m))
             base_xs = [jnp.asarray(op_np), jnp.asarray(mb_np),
                        jnp.asarray(grp_np), jnp.asarray(rxslot_np),
                        jnp.asarray(gxslot_np)]
@@ -1536,6 +1650,13 @@ class ScheduledPipeline:
             Kf = Kg = ()
             lane_hops = ()
             xs = tuple(base_xs)
+        if phased_prog is not None:
+            # host-side row columns for the per-phase lowering: unrolled
+            # cycles slice single rows, scan segments reshape to
+            # (iterations, period, ...) stacks
+            cols_np = [op_np, mb_np, grp_np, rxslot_np, gxslot_np]
+            if lanes is not None:
+                cols_np += [capf_np, capg_np]
         # Split-backward (zero-bubble) tables carry WGRAD ops: B computes
         # the input grad only (and parks its cotangent); W consumes the
         # parked cotangent for the weight grads. Static: shapes the carry
@@ -1688,7 +1809,19 @@ class ScheduledPipeline:
         w_zero = (jax.tree_util.tree_map(zeros_of, wpark_spec)
                   if split_dce else ())
 
-        def cycle(carry, row):
+        def cycle(carry, row, concrete=None, masked=False):
+            """One table cycle. ``concrete=None``: interpreted — the op
+            code is read from the row and dispatched via ``lax.switch``.
+            ``concrete=<op code>`` (phase-compiled lowering): the branch is
+            picked at TRACE time — no dispatch in the lowered body. Dense
+            cycles (``masked=False``) run it as-is; ramp cycles with idle
+            devices (``masked=True``) run the branch on garbage for the
+            idle devices and mask the damage by data selects — store slots
+            route to the sentinel, accumulators keep their prior value,
+            lane registers keep their pass-through semantics. Transmitted
+            garbage needs no mask: every park is driven by the host slot
+            tables, which sentinel all unscheduled arrivals, and the
+            double-buffered carriers never hold a value past its park."""
             if overlap:
                 (pend_f, pend_g, stash, gpark, h_last, wstash, taps_store,
                  res_store, pres_store, sk_reg, gk_reg, sk_park, gk_park,
@@ -2050,9 +2183,42 @@ class ScheduledPipeline:
             branches = [idle_branch, fwd_branch, bwd_branch]
             if has_w:
                 branches.append(wgrad_branch)
+            if concrete is None:
+                branch_out = jax.lax.switch(opj, branches)
+            else:
+                branch_out = branches[concrete]()
             (hl_slot, (w_v, w_s), (taps_v, taps_s), (res_v, res_s),
              (pres_v, pres_s), stats2, g_sp2, g_pre2, g_post2, loss2,
-             tx_h, tx_g, tx_sk, tx_gk) = jax.lax.switch(opj, branches)
+             tx_h, tx_g, tx_sk, tx_gk) = branch_out
+            if concrete is not None and masked and concrete != IDLE:
+                # Partially idle ramp cycle: idle devices just ran the
+                # cycle's branch on garbage inputs. Garbage VALUES are
+                # inert (sentinel-driven parks, see cycle docstring);
+                # garbage SLOTS and accumulator updates are not — route
+                # the former to the sentinels and keep the latter.
+                active = opj == concrete
+
+                def keep(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda a_, b_: jnp.where(active, a_, b_), new, old)
+
+                hl_slot = jnp.where(active, hl_slot, Sg)
+                w_s = jnp.where(active, w_s, v * Wg)
+                taps_s = jnp.where(active, taps_s, v * Sg)
+                res_s = jnp.where(active, res_s, n_res)
+                pres_s = jnp.where(active, pres_s, v * Sg)
+                stats2 = keep(stats2, stats_acc)
+                g_sp2 = keep(g_sp2, g_sp)
+                g_pre2 = keep(g_pre2, g_pre)
+                g_post2 = keep(g_post2, g_post)
+                loss2 = jnp.where(active, loss2, loss)
+                # idle semantics for lane registers is pass-through: a
+                # garbage overwrite here would clobber a live 0-hop
+                # register between its stash and pop stages
+                tx_sk = tuple(keep(t_, r_)
+                              for t_, r_ in zip(tx_sk, sk_ring))
+                tx_gk = tuple(keep(t_, r_)
+                              for t_, r_ in zip(tx_gk, gk_ring))
 
             # THE slot-store writers: branches return (values, slot), and
             # each store takes exactly one unconditional masked write per
@@ -2166,7 +2332,38 @@ class ScheduledPipeline:
             carry0 = (h_ring, g_ring, stash, h_last, wstash, taps_store,
                       res_store, pres_store, sk_ring, gk_ring, sk_park,
                       gk_park, stats0, g_sp, g_pre, g_post, loss0)
-        final_carry, _ = jax.lax.scan(cycle, carry0, xs)
+        if phased_prog is not None:
+            # Per-phase lowering: ramps unroll to straight-line cycles
+            # (concrete op code each, idle devices masked), the dense
+            # periodic steady state becomes a fixed-body scan — the body
+            # is the period's concrete branch sequence, one sub-cycle per
+            # period offset, fed by (iterations, period, ...) row stacks.
+            # No lax.switch anywhere; no masked no-ops inside the scan.
+            codes = phased_prog.cycle_codes
+            dense = phased_prog.dense
+            carry = carry0
+            for seg in phased_prog.segments:
+                if seg.kind == "unroll":
+                    for t in range(seg.t0, seg.t1):
+                        row = tuple(jnp.asarray(c[t]) for c in cols_np)
+                        carry, _ = cycle(carry, row, concrete=codes[t],
+                                         masked=not dense[t])
+                    continue
+                seg_xs = tuple(
+                    jnp.asarray(c[seg.t0:seg.t1].reshape(
+                        (seg.iters, seg.period) + c.shape[1:]))
+                    for c in cols_np)
+
+                def seg_body(carry, rows, _codes=seg.codes):
+                    for k, code_k in enumerate(_codes):
+                        sub = tuple(r_[k] for r_ in rows)
+                        carry, _ = cycle(carry, sub, concrete=code_k)
+                    return carry, None
+
+                carry, _ = jax.lax.scan(seg_body, carry, seg_xs)
+            final_carry = carry
+        else:
+            final_carry, _ = jax.lax.scan(cycle, carry0, xs)
         stats_out, g_sp, g_pre, g_post, loss = final_carry[-5:]
 
         # --- cross-device reductions ------------------------------------

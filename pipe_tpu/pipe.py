@@ -69,7 +69,8 @@ class Pipe:
                  schedule: str = "gpipe",
                  deferred_batch_norm: bool = False,
                  remat_policy=None,
-                 overlap_transport: Optional[bool] = None):
+                 overlap_transport: Optional[bool] = None,
+                 phase_compile: Optional[bool] = None):
         # --- fail-fast validation (reference pipe.py:324-345) ---
         if not isinstance(chunks, int) or isinstance(chunks, bool):
             raise TypeError("chunks must be an integer")
@@ -95,6 +96,10 @@ class Pipe:
         # the training executor — tri-state, resolved per backend; see
         # ScheduledPipeline.overlap_transport.
         self.overlap_transport = overlap_transport
+        # Phase-compiled lowering of the op tables (warmup/cooldown
+        # unrolled, steady state a switch-free lax.scan) — tri-state like
+        # overlap_transport; see ScheduledPipeline.phase_compile.
+        self.phase_compile = phase_compile
 
         if deferred_batch_norm:
             from .extras.norm import convert_deferred_batch_norm
@@ -187,7 +192,8 @@ class Pipe:
             self._train_executor = HeteroScheduledPipeline(
                 mesh, self.partitions, self.skip_layout, chunks,
                 checkpoint, sched_obj, remat_policy=remat_policy,
-                overlap_transport=overlap_transport)
+                overlap_transport=overlap_transport,
+                phase_compile=phase_compile)
 
     # --- container protocol (reference pipe.py:358-386) ---
 

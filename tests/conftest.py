@@ -81,6 +81,16 @@ _SMOKE = {
     "test_generate.py::test_greedy_generation_matches_naive_reforward",
     "test_pipelined_gen.py::"
     "test_pipelined_greedy_matches_single_device[2-4-8-6]",
+    # phase-compiled executor: one bitwise-parity case per lowering shape
+    # (scan steady state, scan-free unroll), the loud rejection path, and
+    # the front-door plumbing
+    "test_phase_compile.py::test_phased_bitwise_parity[never-1f1b]",
+    "test_phase_compile.py::test_phased_bitwise_parity[never-zb-h1]",
+    "test_phase_compile.py::test_phased_bitwise_parity_interleaved",
+    "test_phase_compile.py::test_rejected_table_falls_back_loudly",
+    "test_phase_compile.py::test_front_door_phase_compile_plumbing",
+    # schedules-as-data: a user-authored op table through the front door
+    "test_custom_schedule.py::test_custom_table_through_pipe_front_door",
 }
 
 
@@ -94,9 +104,10 @@ def pytest_collection_modifyitems(config, items):
     # Enforce completeness PER FILE: a smoke nodeid must exist whenever
     # its file collected at all — catches renames without tripping on
     # legitimate partial runs (single files, --ignore, -k filters leave
-    # whole files out, not individual smoke ids... except -k, so gate on
-    # no keyword filter).
-    if not config.option.keyword:
+    # whole files out, not individual smoke ids). -k filters and explicit
+    # `file.py::test` selections DO drop individual ids, so gate on both.
+    if not config.option.keyword and \
+            not any("::" in a for a in config.args):
         collected_files = {item.nodeid.split("tests/")[-1].split("::")[0]
                            for item in items}
         missing = {nid for nid in _SMOKE - found

@@ -1,0 +1,154 @@
+"""Schedules-as-data demo test: a user-AUTHORED op table drives `Pipe`.
+
+The executor contract is a pair of numpy tables, not a class hierarchy:
+anything whose ``op_tables(m, n)`` passes ``verify_op_tables`` runs on
+``ScheduledPipeline`` and therefore through the ``Pipe(mesh=,
+schedule=...)`` front door. This file is the documented walkthrough
+(``docs/schedules.md``, "Bring your own schedule") as an executable test:
+
+1. write the (op, microbatch) tables BY HAND as array literals;
+2. prove them with ``verify_op_tables`` (and show what it rejects);
+3. wrap them in a minimal ``Schedule`` subclass;
+4. train through ``Pipe(mesh=, schedule=<custom>)`` and match the plain
+   composition;
+5. ask the phase compiler for its verdict on the hand-written table —
+   the same table that interprets also phase-compiles (dense steady
+   state, switch-free scan).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.core import microbatch as mb
+from pipe_tpu.core.schedule import (BWD, FWD, IDLE, Schedule,
+                                    compile_phases, verify_op_tables)
+from pipe_tpu.parallel.mesh import make_mesh
+
+WIDTH = 8
+
+# The hand-authored tables: 1F1B geometry at (m=4, n=2), written out as
+# data. Row = cycle, column = stage. F/B/. are just ints (FWD/BWD/IDLE);
+# MBI says which micro-batch each op touches (0 where idle).
+F, B, _ = FWD, BWD, IDLE
+OP = np.array([
+    [F, _],   # c0: stage0 F0
+    [F, F],   # c1: stage0 F1, stage1 F0
+    [_, B],   # c2:            stage1 B0
+    [B, F],   # c3: stage0 B0, stage1 F1   (B0 exactly 1 cycle after c2)
+    [F, B],   # c4: stage0 F2, stage1 B1
+    [B, F],   # c5: stage0 B1, stage1 F2
+    [F, B],   # c6: stage0 F3, stage1 B2
+    [B, F],   # c7: stage0 B2, stage1 F3
+    [_, B],   # c8:            stage1 B3
+    [B, _],   # c9: stage0 B3
+], dtype=np.int32)
+MBI = np.array([
+    [0, 0], [1, 0], [0, 0], [0, 1], [2, 1],
+    [1, 2], [3, 2], [2, 3], [0, 3], [3, 0],
+], dtype=np.int32)
+M, N = 4, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class HandAuthoredSchedule(Schedule):
+    """Step 3 of the demo: the thinnest wrapper the executor accepts —
+    tables plus the stash capacity the tables imply."""
+    name: str = "hand-authored-1f1b"
+
+    def op_tables(self, m, n):
+        assert (m, n) == (M, N), "this table was authored for m=4, n=2"
+        return OP.copy(), MBI.copy()
+
+    def stash_slots(self, m, n):
+        return 2  # max live FWD-to-BWD activations per stage, by eye
+
+    def bubble(self, m, n):
+        op, _ = self.op_tables(m, n)
+        return float((op == IDLE).mean())
+
+
+def test_hand_written_table_verifies():
+    verify_op_tables(OP, MBI, M, N, stash_slots=2)
+
+
+def test_verifier_rejects_a_broken_edit():
+    """Step 2's negative half: delay stage0's B0 by one cycle (break the
+    rigid reverse ring) and the proof fails — authoring mistakes are
+    caught before anything executes."""
+    op, mbi = OP.copy(), MBI.copy()
+    op[3, 0], op[4, 0] = IDLE, BWD      # B0 slides c3 -> c4, clobbering F2
+    mbi[3, 0], mbi[4, 0] = 0, 0
+    with pytest.raises(AssertionError):
+        verify_op_tables(op, mbi, M, N, stash_slots=2)
+
+
+def test_custom_table_through_pipe_front_door():
+    """Steps 3-4: Pipe(mesh=, schedule=<custom>) trains on the authored
+    table, and — since the table IS 1F1B geometry — reproduces the
+    shipped '1f1b' schedule's loss and grads exactly."""
+    from pipe_tpu import Linear, Pipe, Sequential
+
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+    y = jax.random.normal(jax.random.key(2), (8, WIDTH))
+
+    def loss_fn(out, tgt):
+        return jnp.mean((out - tgt) ** 2, axis=-1)
+
+    out = []
+    for sched in (HandAuthoredSchedule(), "1f1b"):
+        seq = Sequential([Linear(WIDTH) for _ in range(4)])
+        mesh = make_mesh(N, 1, devices=jax.devices()[:N])
+        pipe = Pipe(seq, chunks=M, checkpoint="never", mesh=mesh,
+                    schedule=sched)
+        packed = pipe.shard_params(pipe.init(jax.random.key(0), x))
+        out.append(pipe.loss_and_grad(packed, x, targets=y,
+                                      loss_fn=loss_fn))
+    (l_c, g_c), (l_ref, g_ref) = out
+    np.testing.assert_array_equal(np.asarray(l_c), np.asarray(l_ref))
+    for a, b in zip(jax.tree_util.tree_leaves(g_c),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_custom_table_phase_compiler_verdict():
+    """Step 5: the SAME hand-written table phase-compiles — the compiler
+    finds the dense F/B steady state and the scheduled executor's phased
+    lowering matches the interpreted one bitwise."""
+    verdict = compile_phases(OP, MBI, None, m=M, d=N, v=1)
+    assert verdict.accepted, verdict.reason
+    assert verdict.program.scan_cycles > 0
+
+    from pipe_tpu.parallel.scheduled import ScheduledPipeline
+    from pipe_tpu.parallel.spmd import stack_stage_params
+    from pipe_tpu.ops.layers import Linear
+
+    layer = Linear(WIDTH)
+    params = [layer.init(jax.random.fold_in(jax.random.key(0), j),
+                         jnp.zeros((1, WIDTH))) for j in range(N)]
+
+    def stage_fn(p, h, ctx):
+        return jnp.tanh(layer.apply(p, h))
+
+    mesh = make_mesh(N, 1, devices=jax.devices()[:N])
+    x = jax.random.normal(jax.random.key(1), (2 * M, WIDTH))
+    xs, _ = mb.stack_scatter(x, M)
+    w = jnp.ones(xs.shape[:2], jnp.float32)
+    out = []
+    for phase in (True, False):
+        pipe = ScheduledPipeline(
+            mesh, stage_fn,
+            pre_fn=lambda p, x_mb, ctx: x_mb,
+            post_fn=lambda p, h, x_mb, ctx: jnp.sum((h - 1.0) ** 2, -1),
+            checkpoint="never", schedule=HandAuthoredSchedule(),
+            phase_compile=phase)
+        out.append(jax.jit(pipe.loss_and_grad)(
+            stack_stage_params(params), {}, {}, xs, w))
+    (l_p, g_p), (l_i, g_i) = out
+    np.testing.assert_array_equal(np.asarray(l_p), np.asarray(l_i))
+    for a, b in zip(jax.tree_util.tree_leaves(g_p),
+                    jax.tree_util.tree_leaves(g_i)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

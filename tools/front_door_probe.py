@@ -8,14 +8,27 @@ branches. Round 5 adds the uniform-partition fast path
 to the same jaxpr over the same boundary/param layout, ONE shared branch
 replaces the switch and the front door emits the raw executor's program.
 
+Round 6 rebuilt the fast path as an IDENTITY lowering (native boundary
+tuple carrier, natural stage-stacked params — no per-cycle PackPlan
+flatten/pad/slice, no per-cycle ``unpack_stage``) and added the phase
+compiler (``phase_compile=True``: unrolled ramps + switch-free
+steady-state scan) on both sides of the ratio.
+
 ``python tools/front_door_probe.py`` (boots its own virtual 8-device CPU
-platform) times three programs on the same uniform 4-stage stack:
+platform) times five programs on the same uniform 4-stage stack:
 
 * ``raw``            — `ScheduledPipeline` driven directly (the floor);
-* ``pipe-uniform``   — the front door with the fast path (round 5);
+* ``raw-phase``      — the floor with phase compilation on;
+* ``pipe-uniform``   — the front door with the fast path (round 5/6);
+* ``pipe-phase``     — the front door, fast path + phase compilation
+  (the acceptance configuration: tax ≤ 1.05x vs ``raw-phase``);
 * ``pipe-switch``    — the front door with the fast path disabled
   (round 4's program, kept honest via monkeypatch).
 
+Each program runs in its OWN subprocess (``PROBE_ONLY=<tag>`` re-invokes
+this script for one timing): with five compiled programs resident in one
+process, the later ones measured up to ~1.8x slower from allocator/cache
+pressure alone — per-process isolation is the honest apples-to-apples.
 One JSON line per program + a summary line with the tax ratios
 (stdout only; redirect to keep a record).
 """
@@ -68,7 +81,10 @@ def time_fn(fn, *args):
     return (time.perf_counter() - t0) / ITERS, out
 
 
-def main():
+TAGS = ("raw", "raw-phase", "pipe-uniform", "pipe-phase", "pipe-switch")
+
+
+def run_one(tag: str) -> dict:
     mesh = make_mesh(N_STAGES, 1, devices=jax.devices()[:N_STAGES])
     n_layers = N_STAGES * LAYERS_PER_STAGE
     model = Sequential([l for _ in range(n_layers) for l in block_layers()])
@@ -78,70 +94,83 @@ def main():
     def loss_fn(out, tgt):
         return jnp.mean((out - tgt) ** 2, axis=-1)
 
-    results = {}
+    phase = True if tag.endswith("-phase") else None
+    if tag.startswith("raw"):
+        # --- raw homogeneous executor (the floor) -----------------------
+        pipe0 = Pipe(model, chunks=M, checkpoint="except_last",
+                     n_stages=N_STAGES)
+        params_per_stage = pipe0.init(jax.random.key(0), x)
+        # the raw executor needs a homogeneous stage body: apply the
+        # stage's layer stack from the stacked param rows
+        params_per_stage_layers = list(pipe0.partitions[0])
 
-    # --- raw homogeneous executor (the floor) ---------------------------
-    pipe0 = Pipe(model, chunks=M, checkpoint="except_last",
-                 n_stages=N_STAGES)
-    params_per_stage = pipe0.init(jax.random.key(0), x)
+        def stage_fn(params_g, h, ctx):
+            for j, layer in enumerate(params_per_stage_layers):
+                h = layer.apply(params_g[j], h, ctx=ctx.fold(j))
+            return h
 
-    def stage_fn(params_g, h, ctx):
-        for j, layer in enumerate(params_per_stage_layers):
-            h = layer.apply(params_g[j], h, ctx=ctx.fold(j))
-        return h
+        stacked = stack_stage_params(params_per_stage)
+        xs, n_rows = mb.stack_scatter({"x": x, "tgt": y}, M)
+        w = mb.valid_row_mask(xs, n_rows)
+        raw = ScheduledPipeline(mesh, stage_fn,
+                                pre_fn=lambda prep, x_mb, ctx: x_mb["x"],
+                                post_fn=lambda postp, h, x_mb, ctx:
+                                loss_fn(h, x_mb["tgt"]),
+                                checkpoint="except_last", schedule="1f1b",
+                                phase_compile=phase)
+        raw_step = jax.jit(lambda sp, xx, ww: raw.loss_and_grad(
+            sp, {}, {}, xx, ww, key=jax.random.key(9)))
+        sec, (loss_raw, _) = time_fn(raw_step, stacked, xs, w)
+        return {"sec_per_step": round(sec, 5),
+                "loss": round(float(loss_raw), 6)}
 
-    # the raw executor needs a homogeneous stage body: apply the stage's
-    # layer stack from the stacked param rows
-    part0 = pipe0.partitions[0]
-    params_per_stage_layers = list(part0)
-
-    raw = ScheduledPipeline(mesh, stage_fn,
-                            pre_fn=lambda prep, x_mb, ctx: x_mb["x"],
-                            post_fn=lambda postp, h, x_mb, ctx:
-                            loss_fn(h, x_mb["tgt"]),
-                            checkpoint="except_last", schedule="1f1b")
-    stacked = stack_stage_params(params_per_stage)
-    xs, n_rows = mb.stack_scatter({"x": x, "tgt": y}, M)
-    w = mb.valid_row_mask(xs, n_rows)
-    raw_step = jax.jit(lambda sp, xx, ww: raw.loss_and_grad(
-        sp, {}, {}, xx, ww, key=jax.random.key(9)))
-    sec, (loss_raw, _) = time_fn(raw_step, stacked, xs, w)
-    results["raw"] = {"sec_per_step": round(sec, 5),
-                      "loss": round(float(loss_raw), 6)}
-    print(json.dumps({"program": "raw", **results["raw"]}), flush=True)
-
-    # --- front door, fast path on / off ---------------------------------
-    def front_door(tag):
+    # --- front door: fast path on / phased / off ------------------------
+    orig = HeteroScheduledPipeline._branches_uniform
+    if tag == "pipe-switch":
+        HeteroScheduledPipeline._branches_uniform = (
+            lambda self, low, *, train: False)
+    try:
         pipe = Pipe(model, chunks=M, checkpoint="except_last",
-                    mesh=mesh, schedule="1f1b")
+                    mesh=mesh, schedule="1f1b", phase_compile=phase)
         packed = pipe.shard_params(pipe.init(jax.random.key(0), x))
         step = jax.jit(lambda p, xx, yy: pipe.loss_and_grad(
             p, xx, targets=yy, loss_fn=loss_fn, key=jax.random.key(9)))
         sec, (loss, _) = time_fn(step, packed, x, y)
-        uni = getattr(pipe._train_executor, "uniform_fastpath", None)
-        results[tag] = {"sec_per_step": round(sec, 5),
-                        "loss": round(float(loss), 6),
-                        "uniform_fastpath": uni}
-        print(json.dumps({"program": tag, **results[tag]}), flush=True)
-
-    front_door("pipe-uniform")
-
-    orig = HeteroScheduledPipeline._branches_uniform
-    HeteroScheduledPipeline._branches_uniform = (
-        lambda self, low, *, train: False)
-    try:
-        front_door("pipe-switch")
     finally:
         HeteroScheduledPipeline._branches_uniform = orig
+    uni = getattr(pipe._train_executor, "uniform_fastpath", None)
+    return {"sec_per_step": round(sec, 5), "loss": round(float(loss), 6),
+            "uniform_fastpath": uni}
+
+
+def main():
+    import subprocess
+
+    results = {}
+    for tag in TAGS:
+        env = dict(os.environ, PROBE_ONLY=tag)
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            raise SystemExit(f"probe subprocess {tag!r} failed")
+        line = proc.stdout.strip().splitlines()[-1]
+        results[tag] = json.loads(line)
+        results[tag].pop("program", None)
+        print(json.dumps({"program": tag, **results[tag]}), flush=True)
 
     summary = {
         "config": {"d_model": D_MODEL, "n_stages": N_STAGES,
                    "layers_per_stage": LAYERS_PER_STAGE, "chunks": M,
                    "rows": ROWS, "platform": jax.default_backend(),
-                   "n_devices": jax.device_count()},
+                   "n_devices": jax.device_count(),
+                   "isolation": "one subprocess per program"},
         "tax_uniform_vs_raw": round(
             results["pipe-uniform"]["sec_per_step"]
             / results["raw"]["sec_per_step"], 4),
+        "tax_phase_vs_raw_phase": round(
+            results["pipe-phase"]["sec_per_step"]
+            / results["raw-phase"]["sec_per_step"], 4),
         "tax_switch_vs_raw": round(
             results["pipe-switch"]["sec_per_step"]
             / results["raw"]["sec_per_step"], 4),
@@ -151,4 +180,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    only = os.environ.get("PROBE_ONLY")
+    if only:
+        print(json.dumps({"program": only, **run_one(only)}), flush=True)
+    else:
+        main()

@@ -19,8 +19,12 @@ Prints one JSON line; also used by docs/architecture.md's overhead table.
 from __future__ import annotations
 
 import json
+import os
 import re
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def audit(n_stages: int = 4, chunks: int = 8, checkpoint: str = "never",
@@ -173,6 +177,193 @@ def percycle(checkpoint: str = "except_last", d_model: int = 256,
     return out
 
 
+def _hlo_computations(hlo: str):
+    """Split optimized-HLO text into {computation_name: body_text}."""
+    comps = {}
+    name = None
+    depth = 0
+    buf: list = []
+    for line in hlo.splitlines():
+        if depth == 0:
+            m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)\s*(?:\([^)]*\))?.*\{",
+                         line)
+            if m:
+                name = m.group(1)
+                buf = [line]
+                depth = line.count("{") - line.count("}")
+                if depth == 0:
+                    comps[name] = line
+                    name = None
+            continue
+        buf.append(line)
+        depth += line.count("{") - line.count("}")
+        if depth <= 0 and name is not None:
+            comps[name] = "\n".join(buf)
+            name = None
+            depth = 0
+    return comps
+
+
+def _called(body: str):
+    """Computation names a body references (calls, control-flow regions)."""
+    out = set()
+    for key in ("to_apply", "body", "condition", "true_computation",
+                "false_computation", "branch_computations", "calls"):
+        for m in re.finditer(rf"{key}=\{{?([^,)\}}]+(?:,\s*[^,)\}}]+)*)\}}?",
+                             body):
+            for nm in m.group(1).split(","):
+                out.add(nm.strip().lstrip("%"))
+    return out
+
+
+def _conditional_census(text: str):
+    """Count HLO conditionals by arity. XLA canonicalizes pred-form
+    conditionals (``lax.cond``) to 2-branch ``branch_computations={a, b}``,
+    so the text key alone cannot separate op DISPATCH (``lax.switch`` —
+    one branch per op code, ≥3 for any real table) from the executor's
+    2-branch edge-ROLE conds (pre_fn at s==0, loss-seed at is_last,
+    except_last's i==m-1). Arity can."""
+    dispatch = role = 0
+    for m in re.finditer(r"branch_computations=\{([^}]*)\}", text):
+        arity = len([b for b in m.group(1).split(",") if b.strip()])
+        if arity >= 3:
+            dispatch += 1
+        else:
+            role += 1
+    for _ in re.finditer(r"true_computation=", text):
+        role += 1
+    return dispatch, role
+
+
+def _region_census(hlo: str, roots):
+    """Op census over ``roots`` computations plus everything they call."""
+    comps = _hlo_computations(hlo)
+    seen = set()
+    frontier = [r for r in roots if r in comps]
+    while frontier:
+        nm = frontier.pop()
+        if nm in seen:
+            continue
+        seen.add(nm)
+        frontier.extend(c for c in _called(comps[nm])
+                        if c in comps and c not in seen)
+    text = "\n".join(comps[nm] for nm in seen)
+    dispatch, role = _conditional_census(text)
+    return {
+        # indexed (≥3-branch) HLO conditional — what lax.switch lowers to:
+        # the op-dispatch construct the phase compiler exists to remove
+        "dispatch_conditionals": dispatch,
+        # 2-branch conditionals: the executor's edge-role conds, reported
+        # transparently; they select a role, not an op
+        "role_conditionals": role,
+        "selects": len(re.findall(r" select\(", text)),
+        "whiles": len(re.findall(r" while\(", text)),
+    }
+
+
+def phases(n_stages: int = 4, chunks: int = 8, checkpoint: str = "never",
+           schedules=("1f1b", "zb-h1", "gpipe"), d_model: int = 64,
+           d_ff: int = 128, seq_len: int = 32) -> dict:
+    """Census of the PHASE-COMPILED program vs the interpreted executor.
+
+    For each schedule, compiles one ``loss_and_grad`` step with
+    ``phase_compile=True`` and one with ``phase_compile=False`` and reports
+
+    * whole-program dispatch-conditional counts (``branch_computations=``
+      in optimized HLO — the indexed conditional ``lax.switch`` lowers
+      to). The phased program must have ZERO anywhere;
+    * per-while (= per steady-state scan segment) censuses of the phased
+      program: zero dispatch conditionals and zero pred conditionals other
+      than the executor's edge-role conds, which are listed so the claim
+      stays honest ("switch-free" means no op dispatch, not no HLO
+      conditional at all);
+    * the phase program's segmentation (unrolled vs scan cycles).
+
+    ASSERTS the acceptance invariant (steady-state scan bodies free of
+    conditional dispatch) and exits non-zero on violation.
+    """
+    from pipe_tpu.utils.platform import force_cpu_platform
+    force_cpu_platform(8)
+
+    import jax
+    import jax.numpy as jnp
+
+    from pipe_tpu.core import microbatch as mb
+    from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+    from pipe_tpu.parallel.mesh import make_mesh
+    from pipe_tpu.parallel.scheduled import ScheduledPipeline
+    from pipe_tpu.parallel.spmd import stack_stage_params
+
+    cfg = LMConfig(vocab=128, d_model=d_model, nhead=4, d_ff=d_ff,
+                   n_layers=n_stages, seq_len=seq_len, dropout=0.0)
+    mesh = make_mesh(n_stages, 1, devices=jax.devices()[:n_stages])
+    model = PipelinedLM(cfg, n_stages)
+    sp, prep, postp = model.init(jax.random.key(0))
+    sp = stack_stage_params(sp)
+
+    m = chunks
+    tokens = jax.random.randint(jax.random.key(1), (4 * m, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    x, n_rows = mb.stack_scatter(
+        {"tokens": tokens, "targets": jnp.roll(tokens, -1, -1)}, m)
+    w = mb.valid_row_mask(x, n_rows)
+
+    out = {"platform": "cpu8", "n_stages": n_stages, "chunks": m,
+           "checkpoint": checkpoint, "d_model": d_model, "programs": {}}
+    violations = []
+    for name in schedules:
+        row = {}
+        for mode, phase in (("phased", True), ("interpreted", False)):
+            pipe = ScheduledPipeline(
+                mesh, model.stage_fn, pre_fn=model.pre_fn,
+                post_fn=model.loss_post_fn, checkpoint=checkpoint,
+                schedule=name, phase_compile=phase)
+            hlo = jax.jit(
+                lambda s, pipe=pipe: pipe.loss_and_grad(s, prep, postp,
+                                                        x, w)
+            ).lower(sp).compile().as_text()
+            comps = _hlo_computations(hlo)
+            dispatch, role = _conditional_census(hlo)
+            whole = {
+                "dispatch_conditionals": dispatch,
+                "role_conditionals": role,
+                "whiles": len(re.findall(r" while\(", hlo)),
+            }
+            entry = {"whole_program": whole}
+            if phase:
+                prog = pipe._phase_program(m)
+                entry["segments"] = [
+                    (s_.kind, s_.t0, s_.t1, s_.period)
+                    for s_ in prog.segments] if prog else None
+                entry["scan_cycles"] = prog.scan_cycles if prog else 0
+                entry["unrolled_cycles"] = (prog.unrolled_cycles
+                                            if prog else 0)
+                # every while body in the phased program is a steady-state
+                # scan segment (ramps are straight-line)
+                bodies = {}
+                for comp_name, body in comps.items():
+                    for mt in re.finditer(r"body=%?([\w.\-]+)", body):
+                        bodies[mt.group(1)] = None
+                per_while = {b: _region_census(hlo, [b]) for b in bodies}
+                entry["steady_bodies"] = per_while
+                bad = [b for b, c in per_while.items()
+                       if c["dispatch_conditionals"]]
+                if whole["dispatch_conditionals"] or bad:
+                    violations.append(
+                        f"{name}: dispatch conditional in phased program "
+                        f"(whole={whole['dispatch_conditionals']}, "
+                        f"bodies={bad})")
+                if prog is None:
+                    violations.append(
+                        f"{name}: phase compiler rejected the table "
+                        "(no phased program to audit)")
+            row[mode] = entry
+        out["programs"][name] = row
+    out["violations"] = violations
+    out["ok"] = not violations
+    return out
+
+
 if __name__ == "__main__":
     kw = {}
     mode = audit
@@ -180,8 +371,14 @@ if __name__ == "__main__":
         if a == "--percycle":
             mode = percycle
             continue
+        if a == "--phases":
+            mode = phases
+            continue
         k, v = a.lstrip("-").split("=", 1)
         k = k.replace("-", "_")
         kw[k] = tuple(v.split(",")) if k == "schedules" else (
             v if k == "checkpoint" else int(v))
-    print(json.dumps(mode(**kw)))
+    res = mode(**kw)
+    print(json.dumps(res))
+    if mode is phases and not res["ok"]:
+        sys.exit(1)

@@ -1,0 +1,130 @@
+"""Measure the MFU "item 3" residue: non-matmul op time in the train step.
+
+``docs/mfu_roofline.md`` attributes the single-chip MFU gap to three
+mechanisms; the third — elementwise/small-matmul residue — was originally
+an "order 25-35 ms" estimate. This tool replaces the estimate with a
+measurement: it captures a profiler trace of one compiled train step at
+the bench layer shapes (``obs.meters.profile_trace``), parses the XSpace
+with the repo's dependency-free reader (``obs.xplane``), classifies every
+XLA op event as matmul vs everything-else, and prints the op-category
+time split as one JSON line.
+
+Scope honesty: this host has no TPU, so the ABSOLUTE times are CPU times.
+What transfers is the op INVENTORY and the structure of the residue (which
+non-dot ops exist in the compiled step and their relative weight among
+themselves); the committed v5e milliseconds in the doc come from the
+residue-by-subtraction arithmetic over the on-chip artifacts
+(measured step - ideal matmul - optimizer streaming), which this trace
+corroborates by showing the residue ops are really there and really
+serialized between the dots.
+
+Shapes: per-layer dims are the bench config exactly (d=2048, d_ff=2048,
+nhead=32, s=128, V=28782); layer count and batch shrink (env
+``RESIDUE_LAYERS`` / ``RESIDUE_BATCH``) so a CPU host traces in seconds —
+per-layer op mix is what the doc cites, and that is layer-count-invariant.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pipe_tpu.utils.platform import force_cpu_platform
+
+force_cpu_platform(num_devices=1)
+
+import jax
+import jax.numpy as jnp
+
+LAYERS = int(os.environ.get("RESIDUE_LAYERS", "2"))
+BATCH = int(os.environ.get("RESIDUE_BATCH", "2"))
+VOCAB = int(os.environ.get("RESIDUE_VOCAB", "28782"))
+
+_MATMUL_MARKERS = ("dot", "matmul", "conv", "gemm")
+_INFRA_PREFIXES = ("Tfrt", "Pjit", "Parse", "Thread")
+
+
+_XLA_OP = re.compile(r"^[a-z][a-z0-9._\-]*$")
+
+
+def _classify(name: str):
+    """'matmul' / 'other' for XLA op events, None for runtime infra and
+    the host tracer's Python-frame events ('$contextlib', 'PjitFunction',
+    'ThreadpoolListener::...')."""
+    if "::" in name or "$" in name or " " in name or name[:1].isupper():
+        return None
+    if not _XLA_OP.match(name):
+        return None
+    if any(name.startswith(p) for p in _INFRA_PREFIXES):
+        return None
+    base = name.split(".")[0]
+    if any(m in base for m in _MATMUL_MARKERS):
+        return "matmul"
+    return "other"
+
+
+def main() -> dict:
+    from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+    from pipe_tpu.obs.meters import profile_trace
+    from pipe_tpu.obs.xplane import load_trace_planes
+
+    cfg = LMConfig(vocab=VOCAB, d_model=2048, nhead=32, d_ff=2048,
+                   n_layers=LAYERS, seq_len=128, dropout=0.0)
+    model = PipelinedLM(cfg, n_stages=1)
+    sp, prep, postp = model.init(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (BATCH, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, -1)}
+
+    from pipe_tpu.core.partition import StageCtx
+
+    def loss_fn(sp):
+        ctx = StageCtx(train=True)
+        h = model.pre_fn(prep, batch, ctx)
+        h = model.stage_fn(sp[0], h, ctx)
+        return jnp.mean(model.loss_post_fn(postp, h, batch, ctx))
+
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    jax.block_until_ready(step(sp))  # compile outside the trace
+
+    logdir = tempfile.mkdtemp(prefix="roofline_residue_")
+    with profile_trace(logdir):
+        jax.block_until_ready(step(sp))
+
+    cat_ns = collections.Counter()
+    op_ns = collections.Counter()
+    for plane in load_trace_planes(logdir):
+        for line in plane.lines:
+            for ev in line.events:
+                cat = _classify(ev.name)
+                if cat is None:
+                    continue
+                cat_ns[cat] += ev.duration_ns
+                op_ns[(cat, ev.name.split(".")[0])] += ev.duration_ns
+
+    total = sum(cat_ns.values())
+    top_other = sorted(((n, t) for (c, n), t in op_ns.items()
+                        if c == "other"), key=lambda kv: -kv[1])[:10]
+    out = {
+        "platform": jax.default_backend(),
+        "layers": LAYERS, "batch": BATCH,
+        "d_model": cfg.d_model, "d_ff": cfg.d_ff, "seq_len": cfg.seq_len,
+        "vocab": VOCAB,
+        "op_time_total_ms": round(total / 1e6, 3),
+        "matmul_ms": round(cat_ns["matmul"] / 1e6, 3),
+        "other_ms": round(cat_ns["other"] / 1e6, 3),
+        "other_share": round(cat_ns["other"] / total, 4) if total else None,
+        "top_other_ops_ms": {n: round(t / 1e6, 3) for n, t in top_other},
+    }
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(main()))
