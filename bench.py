@@ -447,6 +447,26 @@ def main():
     except Exception as e:
         print(f"front-door probe failed: {e}", file=sys.stderr)
 
+    # Serving probe: the continuous-batching engine's steady-state
+    # tokens/s vs the fixed-batch Generator at equal live-slot count,
+    # plus TTFT p50/p99 under 0.7x-capacity Poisson load (cpu8, quick
+    # mode of tools/serve_bench.py; SERVE_r{N}.json is the full record).
+    serve_summary = None
+    try:
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "serve_bench.py"), "--quick"],
+            capture_output=True, text=True, timeout=900, env=env)
+        if out.returncode == 0:
+            serve_summary = json.loads(out.stdout.strip().splitlines()[-1])
+        else:
+            print(f"serve probe rc={out.returncode}: "
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"serve probe failed: {e}", file=sys.stderr)
+
     trend_vs_prior = None
     try:
         trend_vs_prior = trend_vs_prior_round(here, bubble_multistage)
@@ -528,6 +548,7 @@ def main():
         "measured_bubble_method": bubble_method,
         "measured_bubble_multistage": bubble_multistage,
         "front_door_tax": front_door_tax,
+        "serve": serve_summary,
         "trend_vs_prior": trend_vs_prior,
         "final_loss": round(loss, 4),
         "step_report": report.to_json(),

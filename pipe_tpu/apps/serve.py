@@ -1,0 +1,212 @@
+"""Serving driver: the continuous-batching engine under a live workload.
+
+Runs :class:`~pipe_tpu.serve.ServeEngine` over either slot backend —
+``--stages 1`` (default) is the single-device backend with ``--slots``
+decode slots; ``--stages N`` keeps the weights stage-sharded and serves
+through the pipeline ring (slots == ring groups, kept full across
+admissions). Workload: ``--prompts-file`` (comma-separated token-id
+prompts, one per line, all arriving at once) or a synthetic seeded
+Poisson stream (``--requests``/``--rate``). Per-request results stream
+to stdout as JSON lines the moment each request retires; the final line
+is a summary with the engine's ``serve.*`` metrics (admitted/retired/
+rejected counters, TTFT percentiles, queue-depth/occupancy gauges).
+``--events`` additionally writes the request-span EventLog
+(docs/observability.md).
+
+Usage:
+    python -m pipe_tpu.apps.serve [--resume DIR] [--requests N --rate R]
+        [--prompts-file F] [--slots S] [--stages N] [--eos ID]
+        [--queue-capacity C] [--policy fifo|priority] [--timeout-s T]
+        [--decode-chunk K] [--events F.jsonl] [--tiny] [--cpu N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .generate import DriverError, load_params
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--resume", default=None,
+                   help="checkpoint dir (train/state.py layout); default: "
+                        "fresh random init")
+    p.add_argument("--prompts-file", default=None,
+                   help="serve these prompts (comma-separated ids per "
+                        "line) instead of a synthetic stream")
+    p.add_argument("--requests", type=int, default=16,
+                   help="synthetic stream: number of requests")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="synthetic stream: Poisson arrivals/s "
+                        "(0 = all at once)")
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--eos", type=int, default=None)
+    p.add_argument("--stages", type=int, default=1,
+                   help=">1: serve through the pipeline ring")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slots (single-device backend; the ring "
+                        "always has one slot per stage)")
+    p.add_argument("--queue-capacity", type=int, default=64)
+    p.add_argument("--policy", choices=["fifo", "priority"],
+                   default="fifo")
+    p.add_argument("--timeout-s", type=float, default=None,
+                   help="per-request deadline")
+    p.add_argument("--decode-chunk", type=int, default=4,
+                   help="decode steps per host tick (ring: ring "
+                        "revolutions per tick)")
+    p.add_argument("--events", default=None,
+                   help="write the request-span EventLog here (.jsonl)")
+    p.add_argument("--int8", action="store_true",
+                   help="int8 weight-only quantized block weights")
+    p.add_argument("--family", choices=["lm", "gpt2"], default="lm")
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--cpu", type=int, default=0,
+                   help="force N virtual CPU devices (testing without TPU)")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    if args.cpu:
+        from ..utils.platform import force_cpu_platform
+        force_cpu_platform(args.cpu)
+
+    import numpy as np
+
+    from ..inference import GenerationConfig
+
+    if args.family == "gpt2":
+        from ..models.gpt2 import GPT2Config as _Cfg
+        from ..models.gpt2 import PipelinedGPT2 as _Model
+    else:
+        from ..models.transformer_lm import LMConfig as _Cfg
+        from ..models.transformer_lm import PipelinedLM as _Model
+
+    model_cfg = _Cfg()
+    if args.tiny:
+        model_cfg = model_cfg.tiny()
+    n_stages = max(args.stages, 1)
+    if model_cfg.n_layers % n_stages:
+        print(f"--stages {n_stages} must divide the model's "
+              f"{model_cfg.n_layers} layers", file=sys.stderr)
+        return 2
+
+    if args.prompts_file:
+        if not os.path.isfile(args.prompts_file):
+            print(f"--prompts-file {args.prompts_file}: no such file",
+                  file=sys.stderr)
+            return 2
+        with open(args.prompts_file) as f:
+            try:
+                prompts = [[int(t) for t in ln.split(",") if t.strip()]
+                           for ln in f if ln.strip()]
+            except ValueError:
+                print("prompts must be comma-separated integer token ids",
+                      file=sys.stderr)
+                return 2
+        if not prompts or any(
+                not p or any(i < 0 or i >= model_cfg.vocab for i in p)
+                for p in prompts):
+            print(f"prompt ids must be in [0, {model_cfg.vocab})",
+                  file=sys.stderr)
+            return 2
+    else:
+        rng = np.random.RandomState(args.seed)
+        lens = rng.choice((8, 12, 16, 24, 32), size=args.requests)
+        prompts = [rng.randint(1, model_cfg.vocab, size=int(n)).tolist()
+                   for n in lens]
+
+    model = _Model(model_cfg, n_stages)
+    try:
+        params = load_params(args.resume, model_cfg, _Model, n_stages,
+                             args.seed)
+    except DriverError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.int8:
+        from ..inference.quant import quantize_params
+        sp_q, pre_q, post_q = params
+        params = (quantize_params(sp_q), pre_q, post_q)
+    gen_cfg = GenerationConfig(max_new_tokens=args.max_new,
+                               temperature=args.temperature,
+                               top_k=args.top_k, eos_token_id=args.eos)
+
+    from ..obs.events import EventLog, NULL_EVENT_LOG
+    from ..obs.telemetry import get_registry
+    from ..serve import BucketSpec, QueueFull, RequestQueue, ServeEngine
+    buckets = BucketSpec.pow2(min_len=8,
+                              max_len=max(len(p) for p in prompts))
+    max_len = buckets.max_len + args.max_new
+    if n_stages > 1:
+        from ..parallel.mesh import make_mesh
+        from ..parallel.spmd import stack_stage_params
+        from ..serve import RingSlotBackend
+        sp, pre, post = params
+        backend = RingSlotBackend(
+            make_mesh(n_stages, 1), model, stack_stage_params(sp), pre,
+            post, max_len=max_len, gen=gen_cfg, buckets=buckets,
+            revolutions=args.decode_chunk)
+    else:
+        from ..serve import SingleDeviceSlotBackend
+        backend = SingleDeviceSlotBackend(
+            model, params, num_slots=args.slots, max_len=max_len,
+            gen=gen_cfg, buckets=buckets, decode_chunk=args.decode_chunk)
+
+    events = EventLog(args.events) if args.events else NULL_EVENT_LOG
+    queue = RequestQueue(capacity=args.queue_capacity,
+                         policy=args.policy)
+    eng = ServeEngine(backend, queue, event_log=events)
+
+    if args.prompts_file or args.rate <= 0:
+        arrivals = [0.0] * len(prompts)
+    else:
+        rng = np.random.RandomState(args.seed + 1)
+        arrivals = np.cumsum(
+            rng.exponential(1.0 / args.rate, size=len(prompts))).tolist()
+
+    t0 = time.monotonic()
+    i = rejected = done = 0
+    while i < len(prompts) or not eng.idle:
+        now = time.monotonic() - t0
+        while i < len(prompts) and arrivals[i] <= now:
+            try:
+                eng.submit(prompts[i], seed=args.seed + i,
+                           timeout_s=args.timeout_s)
+            except QueueFull:
+                rejected += 1
+            i += 1
+        if eng.idle and i < len(prompts):
+            time.sleep(min(arrivals[i] - now, 0.005))
+            continue
+        for r in eng.tick():
+            done += 1
+            print(json.dumps({
+                "request": r.request_id, "status": r.status,
+                "finish_reason": r.finish_reason,
+                "prompt_len": r.prompt_len, "tokens": r.tokens,
+                "ttft_s": (round(r.ttft, 4)
+                           if r.ttft is not None else None),
+                "latency_s": round(r.latency, 4)}), flush=True)
+    elapsed = time.monotonic() - t0
+
+    snap = {k: v for k, v in get_registry().scalars().items()
+            if k.startswith("serve.")}
+    print(json.dumps({"summary": {
+        "backend": type(backend).__name__,
+        "finished": done, "rejected": rejected,
+        "elapsed_s": round(elapsed, 3),
+        "buckets": list(buckets.lengths), "metrics": snap}}))
+    events.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

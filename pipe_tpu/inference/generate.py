@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import warnings
 from typing import Optional
 
 import jax
@@ -36,7 +37,7 @@ from ..obs.telemetry import get_registry
 from .quant import QuantLeaf, dequant_tree
 
 __all__ = ["GenerationConfig", "Generator", "check_positions",
-           "head_logits", "sample_logits"]
+           "head_logits", "sample_logits", "sequence_lengths"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,6 +49,12 @@ class GenerationConfig:
     # temperature/top_k sampling knobs are ignored). KV caches are
     # physically reordered by parent beam each step.
     num_beams: int = 1
+    # Stop token: once a sequence samples it, every later step emits
+    # pad_token_id instead (static shapes — the scan still runs the full
+    # max_new_tokens; finished rows just decode pad). None = no early
+    # stop, every sequence runs to max_new_tokens, the pre-EOS behavior.
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -60,6 +67,17 @@ class GenerationConfig:
             raise ValueError(f"top_k must be >= 1, got {self.top_k}")
         if self.num_beams < 1:
             raise ValueError(f"num_beams must be >= 1, got {self.num_beams}")
+        if self.eos_token_id is not None and self.eos_token_id < 0:
+            raise ValueError(
+                f"eos_token_id must be >= 0, got {self.eos_token_id}")
+        if self.pad_token_id < 0:
+            raise ValueError(
+                f"pad_token_id must be >= 0, got {self.pad_token_id}")
+        if self.num_beams > 1 and self.eos_token_id is not None:
+            raise ValueError(
+                "eos_token_id with beam search is not implemented — "
+                "EOS-aware beam pruning needs per-hypothesis length "
+                "normalization; use num_beams=1 for early stopping")
 
 
 def check_positions(model, prompt_len: int, max_new_tokens: int) -> None:
@@ -99,6 +117,21 @@ def sample_logits(logits: jax.Array, key: jax.Array,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def sequence_lengths(tokens: jax.Array,
+                     eos_token_id: Optional[int]) -> jax.Array:
+    """Per-sequence generated length from ``tokens [..., max_new]``: the
+    index of the first EOS plus one (the EOS itself counts as emitted),
+    or the full width for rows that never stopped. ``None`` => every row
+    ran to ``max_new_tokens``."""
+    toks = jnp.asarray(tokens)
+    width = toks.shape[-1]
+    if eos_token_id is None:
+        return jnp.full(toks.shape[:-1], width, jnp.int32)
+    hit = toks == jnp.int32(eos_token_id)
+    first = jnp.argmax(hit, axis=-1)
+    return jnp.where(hit.any(axis=-1), first + 1, width).astype(jnp.int32)
+
+
 class Generator:
     """KV-cached sampling over a :class:`~.models.common.PipelinedTransformer`
     LM factorization (``PipelinedLM`` and friends: ``embed_at`` + causal
@@ -118,7 +151,8 @@ class Generator:
     """
 
     def __init__(self, model, gen_cfg: GenerationConfig = GenerationConfig(),
-                 *, layer_scan: bool = True, phase_timing: bool = False):
+                 *, layer_scan: bool = True, phase_timing: bool = False,
+                 shape_cache_warn: int = 16):
         if not hasattr(model, "embed_at"):
             raise TypeError(
                 f"{type(model).__name__} has no embed_at; KV-cache "
@@ -139,6 +173,13 @@ class Generator:
         self._jitted = jax.jit(self._generate)
         self._jitted_beam = None  # built on first beam-search call
         self._jitted_prefill = None  # built on first phase_timing call
+        # Per-shape jit cache bookkeeping: `generate` compiles one program
+        # per (batch, prompt_len). That's invisible from the outside —
+        # count it, and warn loudly once the cache grows past the
+        # threshold (a serving workload feeding raw prompt lengths here
+        # should bucket them: pipe_tpu.serve.BucketSpec).
+        self.shape_cache_warn = shape_cache_warn
+        self._shapes_seen: set = set()
 
     # --- internals ---
 
@@ -222,18 +263,34 @@ class Generator:
                         lambda a, n: a.at[l].set(n), caches, c_l)
                 return h, caches
 
+        # EOS handling is a Python-level gate so eos_token_id=None traces
+        # the exact pre-EOS program (no dead done-mask ops in the scan).
+        eos = gen.eos_token_id
+
         def step(carry, _):
-            caches, tok, pos, key = carry
+            if eos is None:
+                caches, tok, pos, key = carry
+            else:
+                caches, tok, pos, key, done = carry
             h = m.embed_at(pre_params, tok[:, None], pos)
             h, caches = run_layers(h, pos, caches)
             key, sub = jax.random.split(key)
             nxt = sample_logits(self._head(post_params, h)[:, 0, :],
                                 sub, gen)
-            return (caches, nxt, pos + 1, key), tok
+            if eos is None:
+                return (caches, nxt, pos + 1, key), tok
+            # finished rows emit pad from the step AFTER their EOS; the
+            # EOS token itself is emitted (it counts toward the length)
+            nxt = jnp.where(done, jnp.int32(gen.pad_token_id), nxt)
+            done = done | (nxt == jnp.int32(eos))
+            return (caches, nxt, pos + 1, key, done), tok
 
-        (_, last, _, _), toks = jax.lax.scan(
-            step, (cache_stack, tok, jnp.int32(p), key), None,
-            length=gen.max_new_tokens - 1)
+        init = (cache_stack, tok, jnp.int32(p), key)
+        if eos is not None:
+            init = init + (tok == jnp.int32(eos),)
+        carry_out, toks = jax.lax.scan(
+            step, init, None, length=gen.max_new_tokens - 1)
+        last = carry_out[1]
         # toks holds the tokens *entering* each step; append the final one
         out = jnp.moveaxis(toks, 0, 1)  # [b, max_new-1]
         return jnp.concatenate([out, last[:, None]], axis=1)
@@ -320,6 +377,27 @@ class Generator:
             out, best[:, None, None], axis=1)[:, 0, :]
         return toks, jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0]
 
+    def _note_shape(self, shape_key) -> None:
+        """Track the per-shape jit cache: one program per (batch,
+        prompt_len) [plus a tag for the beam variant]. Counters make the
+        cache visible to serving telemetry; the warning fires when an
+        unbucketed workload is compiling per raw prompt length."""
+        reg = get_registry()
+        if shape_key in self._shapes_seen:
+            reg.counter("serve.program_cache_hits").inc()
+            return
+        self._shapes_seen.add(shape_key)
+        reg.counter("serve.program_cache_misses").inc()
+        reg.gauge("serve.program_cache_entries").set(len(self._shapes_seen))
+        if len(self._shapes_seen) == self.shape_cache_warn + 1:
+            warnings.warn(
+                f"Generator has compiled {len(self._shapes_seen)} distinct "
+                f"(batch, prompt_len) programs — every new prompt shape "
+                f"recompiles the full prefill+decode step. Bucket prompt "
+                f"lengths (pipe_tpu.serve.BucketSpec / ServeEngine) or pad "
+                f"to a fixed shape to cap the cache.",
+                RuntimeWarning, stacklevel=3)
+
     # --- public ---
 
     def generate(self, params, prompt: jax.Array,
@@ -334,6 +412,7 @@ class Generator:
         if key is None:
             key = jax.random.key(0)
         prompt = jnp.asarray(prompt, jnp.int32)
+        self._note_shape(prompt.shape)
         reg = get_registry()
         t0 = time.perf_counter()
         out = self._jitted(params, prompt, key)
@@ -361,6 +440,7 @@ class Generator:
         if self._jitted_beam is None:
             self._jitted_beam = jax.jit(self._generate_beam)
         prompt = jnp.asarray(prompt, jnp.int32)
+        self._note_shape(("beam",) + prompt.shape)
         reg = get_registry()
         t0 = time.perf_counter()
         out = self._jitted_beam(params, prompt)
@@ -373,3 +453,12 @@ class Generator:
             if dt > 0:
                 reg.gauge("serve.tokens_per_sec").set(tokens / dt)
         return out
+
+    def generate_with_lengths(self, params, prompt: jax.Array,
+                              key: Optional[jax.Array] = None):
+        """``(tokens [b, max_new], lengths [b])`` — per-sequence generated
+        length: up to and including the first EOS, or ``max_new_tokens``
+        when the row never stopped (always ``max_new_tokens`` with
+        ``eos_token_id=None``). Rows past their length hold pad."""
+        out = self.generate(params, prompt, key)
+        return out, sequence_lengths(out, self.gen_cfg.eos_token_id)

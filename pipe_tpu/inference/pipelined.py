@@ -46,7 +46,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..obs.telemetry import get_registry
 from ..parallel.mesh import STAGE_AXIS
 from .generate import (GenerationConfig, check_positions, head_logits,
-                       sample_logits)
+                       sample_logits, sequence_lengths)
 from .quant import QuantLeaf, dequant_tree
 from ..utils.compat import shard_map
 
@@ -184,9 +184,19 @@ class PipelinedGenerator:
         init_toks = jax.lax.psum(
             jnp.where(s == n - 1, init_toks, 0), STAGE_AXIS)
 
+        # EOS: Python-level gate so eos_token_id=None traces the exact
+        # pre-EOS program. Every stage carries its own done table, but
+        # only stage n-1's chain is consulted (its tokens ride the wrap
+        # edge and fill `out`); the other stages' updates track garbage
+        # samples harmlessly.
+        eos = gen.eos_token_id
+
         # ---- decode: one token-group per cycle in steady state (q = 1)
         def dec_cycle(carry, c):
-            h_carry, tok_ring, caches, out = carry
+            if eos is None:
+                h_carry, tok_ring, caches, out = carry
+            else:
+                h_carry, tok_ring, caches, out, done = carry
             raw = c - s
             valid = (raw >= 0) & (raw < n * max_new)
             grp = jnp.mod(raw, n)
@@ -200,6 +210,13 @@ class PipelinedGenerator:
                                              grp, pos)
             logits = self._head(post_params, h_out)[:, 0, :]
             tok_out = sample_logits(logits, dec_key(grp, t), gen)
+            if eos is not None:
+                done_g = jnp.take(done, grp, axis=0)
+                tok_out = jnp.where(done_g, jnp.int32(gen.pad_token_id),
+                                    tok_out)
+                done = jax.lax.dynamic_update_slice(
+                    done, (done_g | (tok_out == jnp.int32(eos)))[None],
+                    (grp, 0))
             emit = (s == n - 1) & valid
             # slot t holds the token SAMPLED while processing decode index
             # t — i.e. generated token t+1 (the assembly below prepends
@@ -208,15 +225,20 @@ class PipelinedGenerator:
             t_write = jnp.where(emit, t, max_new)
             out = jax.lax.dynamic_update_slice(
                 out, tok_out[None, :, None], (grp, 0, t_write))
-            return (self._ring(h_out), self._ring(tok_out), caches,
-                    out), None
+            ring_out = (self._ring(h_out), self._ring(tok_out), caches,
+                        out)
+            if eos is not None:
+                ring_out = ring_out + (done,)
+            return ring_out, None
 
         h0 = jnp.zeros((rpg, 1, m.cfg.d_model), cd)
         out = jnp.zeros((n, rpg, max_new + 1), jnp.int32)
         cycles = n * max_new + n - 1
-        (_, _, _, out), _ = jax.lax.scan(
-            dec_cycle, (h0, jnp.zeros((rpg,), jnp.int32), caches, out),
-            jnp.arange(cycles))
+        carry0 = (h0, jnp.zeros((rpg,), jnp.int32), caches, out)
+        if eos is not None:
+            carry0 = carry0 + (init_toks == jnp.int32(eos),)
+        carry_out, _ = jax.lax.scan(dec_cycle, carry0, jnp.arange(cycles))
+        out = carry_out[3]
         # tokens ENTERING each step are init_toks (t=0 slot) shifted by the
         # sampled stream: out[g, :, t] holds the token sampled AT decode
         # index t, i.e. generated token t+1; generated token 0 is
@@ -453,6 +475,16 @@ class PipelinedGenerator:
             if dt > 0:
                 reg.gauge("serve.pipelined.tokens_per_sec").set(tokens / dt)
         return out.reshape(b, self.gen_cfg.max_new_tokens)
+
+    def generate_with_lengths(self, stage_params, pre_params, post_params,
+                              prompt: jax.Array,
+                              key: Optional[jax.Array] = None):
+        """``(tokens [b, max_new], lengths [b])`` — the pipelined analogue
+        of ``Generator.generate_with_lengths``: lengths run up to and
+        including the first EOS (or ``max_new_tokens`` without one)."""
+        out = self.generate(stage_params, pre_params, post_params,
+                            prompt, key)
+        return out, sequence_lengths(out, self.gen_cfg.eos_token_id)
 
     def generate_with_scores(self, stage_params, pre_params, post_params,
                              prompt: jax.Array):

@@ -16,8 +16,9 @@ Record schema (one dict per line)::
 plus a ``log_open`` header carrying the wall-clock epoch so host events
 can be correlated with profiler traces. Span kinds used by the built-in
 wiring: ``step``, ``stage``, ``microbatch``, ``comm``,
-``checkpoint-recompute`` (:data:`SPAN_KINDS`); ``step_report`` records
-carry a full :class:`~.telemetry.StepReport` (``to_json`` payload).
+``checkpoint-recompute``, ``request`` (:data:`SPAN_KINDS`);
+``step_report`` records carry a full :class:`~.telemetry.StepReport`
+(``to_json`` payload).
 
 Spans nest through a per-thread stack: ``parent`` is the id of the
 innermost open span on the same thread. Records are written at span
@@ -38,14 +39,17 @@ import time
 from typing import Any, Dict, IO, List, Optional
 
 __all__ = ["EventLog", "NullEventLog", "NULL_EVENT_LOG", "SPAN_KINDS",
-           "STEP", "STAGE", "MICROBATCH", "COMM", "RECOMPUTE"]
+           "STEP", "STAGE", "MICROBATCH", "COMM", "RECOMPUTE", "REQUEST"]
 
 STEP = "step"
 STAGE = "stage"
 MICROBATCH = "microbatch"
 COMM = "comm"
 RECOMPUTE = "checkpoint-recompute"
-SPAN_KINDS = (STEP, STAGE, MICROBATCH, COMM, RECOMPUTE)
+# serving: one record per retired request, written by the serve engine at
+# retirement (see docs/observability.md "Request spans" for the schema)
+REQUEST = "request"
+SPAN_KINDS = (STEP, STAGE, MICROBATCH, COMM, RECOMPUTE, REQUEST)
 
 
 class EventLog:

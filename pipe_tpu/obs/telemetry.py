@@ -35,6 +35,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "EwmaTimer", "Histogram", "MetricsRegistry",
     "StepReport", "get_registry", "set_registry", "null_registry",
+    "percentile_exact",
     "train_flops_per_token", "peak_flops_per_chip", "device_memory_peaks",
 ]
 
@@ -279,6 +280,20 @@ def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
 def null_registry() -> MetricsRegistry:
     """The shared disabled registry — every instrument is a no-op."""
     return _NULL_REGISTRY
+
+
+def percentile_exact(values, q: float) -> float:
+    """Exact q-quantile (nearest-rank, q in [0, 1]) of raw samples.
+
+    :class:`Histogram` trades precision for O(1) memory — its percentile
+    is a power-of-2 upper edge, up to 2x above the true value. Benchmark
+    artifacts (``tools/serve_bench.py`` TTFT numbers) keep the raw
+    samples and use this instead, so committed p50/p99 are exact."""
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    rank = min(len(vals), max(1, math.ceil(q * len(vals))))
+    return float(vals[rank - 1])
 
 
 # --------------------------------------------------------------------------

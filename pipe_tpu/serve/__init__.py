@@ -1,0 +1,24 @@
+"""Continuous-batching serving over the pipe_tpu generators.
+
+The subsystem in one paragraph: :class:`~.queue.RequestQueue` is the
+bounded front door (backpressure, deadlines, cancellation, FIFO or
+priority); :class:`~.engine.ServeEngine` schedules requests into fixed
+decode **slots** and runs one compiled, fixed-shape decode step per host
+tick — zero steady-state recompiles, pinned by a trace counter;
+:class:`~.buckets.BucketSpec` caps prefill to a closed set of
+prompt-length shapes. Two slot backends:
+:class:`~.engine.SingleDeviceSlotBackend` (replicated weights, S
+arbitrary) and :class:`~.ring.RingSlotBackend` (stage-sharded weights —
+slots are the pipeline ring's request groups, kept continuously full
+across admissions/retirements). See ``docs/serving.md`` ("Online
+serving") and ``apps/serve.py`` for the driver.
+"""
+
+from .buckets import BucketSpec
+from .engine import ServeEngine, SingleDeviceSlotBackend
+from .queue import QueueFull, Request, RequestQueue, Response
+from .ring import RingSlotBackend
+
+__all__ = ["BucketSpec", "ServeEngine", "SingleDeviceSlotBackend",
+           "RingSlotBackend", "QueueFull", "Request", "RequestQueue",
+           "Response"]

@@ -1,0 +1,528 @@
+"""Slot-based continuous batching: a dynamic request stream through ONE
+compiled decode step.
+
+The paper's schedule-as-static-table discipline, applied to serving: the
+device program is fixed-shape and compiled once; everything dynamic —
+arrivals, retirements, deadlines — is host-side table maintenance, like
+the executors' masked-slot op tables. The engine owns ``S`` decode
+slots, each a row block of every layer's KV cache plus a (token,
+position, PRNG key) triple. A host **tick** is:
+
+1. reap requests that died waiting (deadline/cancel) and retire running
+   slots whose deadline passed or that were cancelled;
+2. admit waiting requests into free slots — one bucketed prefill program
+   per prompt-length bucket (:class:`~.buckets.BucketSpec`) writes the
+   slot's cache rows and samples the first token (TTFT is measured
+   here);
+3. run the **one** decode step for all S slots — finished/empty slots
+   decode garbage into rows the next prefill overwrites, the same
+   sacrificial-write trick as the pipelined generators — and retire
+   slots on EOS / per-request ``max_new_tokens``.
+
+Zero steady-state recompiles is a pinned invariant, not an aspiration:
+the decode program body increments ``serve.engine.decode_traces`` at
+trace time (traces happen once per compile), and ``tests/test_serve.py``
+asserts the counter stays at 1 across staggered mixed-length traffic.
+
+Token parity is the other pin: because each slot carries the exact
+(prefill -> split -> sample -> split -> sample...) key chain of a
+batch-1 :class:`~..inference.generate.Generator` call, and right-padded
+bucket rows are causally masked until decode overwrites them, a request
+served through the engine produces bitwise the tokens of a one-shot
+``Generator.generate`` on its prompt — regardless of what the other
+slots are doing.
+
+``decode_chunk > 1`` runs K decode steps per tick inside a ``lax.scan``
+(one host round-trip per K tokens — the host-sync amortization knob);
+the carry chain is identical however it is chopped, so parity holds.
+The cost is retirement lag: a slot finishing mid-chunk wastes at most
+K-1 slot-steps before the host sees it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..inference.generate import (GenerationConfig, head_logits,
+                                  sample_logits)
+from ..inference.quant import QuantLeaf, dequant_tree
+from ..obs.events import NULL_EVENT_LOG, REQUEST
+from ..obs.telemetry import get_registry
+from .buckets import BucketSpec
+from .queue import QueueFull, Request, RequestQueue, Response
+
+__all__ = ["SingleDeviceSlotBackend", "ServeEngine"]
+
+
+class _Slot:
+    """Host-side state of one running request."""
+
+    __slots__ = ("req", "tokens", "ttft")
+
+    def __init__(self, req: Request, first_token: int, ttft: float):
+        self.req = req
+        self.tokens: List[int] = [first_token]
+        self.ttft = ttft
+
+
+class SingleDeviceSlotBackend:
+    """S decode slots over one device's worth of (replicated) params.
+
+    ``params`` is the training-layout ``(stage_params, pre_params,
+    post_params)`` triple (``model.init``); blocks are flattened/stacked
+    once at construction, quantized leaves (``inference/quant.py``) pass
+    through and dequantize in-step — same weight handling as
+    :class:`~..inference.generate.Generator`.
+    """
+
+    def __init__(self, model, params, *, num_slots: int, max_len: int,
+                 gen: GenerationConfig = GenerationConfig(),
+                 buckets: Optional[BucketSpec] = None,
+                 decode_chunk: int = 1, shape_cache_warn: int = 8):
+        if not hasattr(model, "embed_at"):
+            raise TypeError(
+                f"{type(model).__name__} has no embed_at; KV-cache "
+                "generation needs position-offset embedding")
+        if gen.num_beams != 1:
+            raise ValueError(
+                "the serve engine decodes greedy/sampled slots; beam "
+                "search has no incremental slot form (num_beams must be 1)")
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if decode_chunk < 1:
+            raise ValueError(
+                f"decode_chunk must be >= 1, got {decode_chunk}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        self.model = model
+        self.gen = gen
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.buckets = buckets
+        self.decode_chunk = decode_chunk
+        self.shape_cache_warn = shape_cache_warn
+
+        stage_params, pre_params, post_params = params
+        cd = model.cfg.compute_dtype
+        flat = [bp for stage in stage_params for bp in stage]
+        blocks = [jax.tree_util.tree_map(
+                      lambda p: p if isinstance(p, QuantLeaf)
+                      else p.astype(cd),
+                      bp, is_leaf=lambda x: isinstance(x, QuantLeaf))
+                  for bp in flat]
+        self._n_layers = len(blocks)
+        self._block_stack = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *blocks)
+        self._pre = pre_params
+        self._post = post_params
+
+        proto = model.block.attn.make_cache(1, max_len, dtype=cd)
+        self._caches = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(
+                (self._n_layers, num_slots) + a.shape[1:], a.dtype),
+            proto)
+        self._tok = jnp.zeros((num_slots,), jnp.int32)
+        self._pos = jnp.zeros((num_slots,), jnp.int32)
+        kd0 = jax.random.key_data(jax.random.key(0))
+        self._key_data = jnp.broadcast_to(kd0, (num_slots,) + kd0.shape)
+
+        self._prefill_programs = {}
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(3,))
+
+    # -- validation --------------------------------------------------------
+
+    def validate(self, prompt_len: int, max_new_tokens: int) -> None:
+        """Admission-control shape checks — reject at submit, not at
+        prefill, so a bad request never costs a slot."""
+        bucket = (self.buckets.bucket_for(prompt_len)
+                  if self.buckets is not None else prompt_len)
+        if prompt_len + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt_len {prompt_len} + max_new_tokens "
+                f"{max_new_tokens} exceeds the slot cache ({self.max_len} "
+                f"rows); raise max_len or shorten the request")
+        if max_new_tokens > self.gen.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} exceeds the engine cap "
+                f"({self.gen.max_new_tokens})")
+        mp = getattr(self.model, "max_position", None)
+        limit = mp() if callable(mp) else None
+        if limit is not None and max(bucket,
+                                     prompt_len + max_new_tokens) > limit:
+            raise ValueError(
+                f"request needs position {max(bucket, prompt_len + max_new_tokens)} "
+                f"but the positional table has {limit}")
+
+    # -- device programs ---------------------------------------------------
+
+    def _prefill_fn(self, block_stack, pre, post, caches, prompt,
+                    true_len, slot, key):
+        """One bucket-length-B prefill: runs the padded prompt through
+        every layer against a fresh full-length temp cache, then writes
+        the ENTIRE slot slab (previous occupant's rows are gone, not
+        merely masked) and samples the first token with the exact
+        batch-1 Generator key chain."""
+        m, gen = self.model, self.gen
+        cd = m.cfg.compute_dtype
+        get_registry().counter("serve.engine.prefill_traces").inc()
+        proto = m.block.attn.make_cache(1, self.max_len, dtype=cd)
+        temp0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros((self._n_layers,) + a.shape, a.dtype),
+            proto)
+        h = m.embed_at(pre, prompt, 0)                    # [1, B, d]
+
+        def layer(h, inp):
+            bp, cache = inp
+            h, cache = m.block.decode(dequant_tree(bp, cd), h, cache, 0)
+            return h, cache
+
+        h, temp = jax.lax.scan(layer, h, (block_stack, temp0))
+        caches = jax.tree_util.tree_map(
+            lambda big, rows: jax.lax.dynamic_update_slice(
+                big, rows, (0, slot) + (0,) * (rows.ndim - 2)),
+            caches, temp)
+        h_last = jax.lax.dynamic_slice(
+            h, (0, true_len - 1, 0), (1, 1, h.shape[-1]))
+        key, sub = jax.random.split(key)
+        tok0 = sample_logits(head_logits(m, post, h_last)[:, 0, :],
+                             sub, gen)[0]
+        return caches, tok0, key
+
+    def _decode_fn(self, block_stack, pre, post, caches, tok, pos,
+                   key_data):
+        """THE decode step: ``decode_chunk`` tokens for all S slots in
+        one fixed-shape program. Per-slot positions ride a ``vmap`` over
+        the layer decode (the scalar-pos cache write becomes a batched
+        scatter). Traced exactly once — the counter below increments at
+        trace time only, pinning the zero-recompile claim."""
+        m, gen = self.model, self.gen
+        cd = m.cfg.compute_dtype
+        get_registry().counter("serve.engine.decode_traces").inc()
+        eos = gen.eos_token_id
+
+        def embed_one(t, p):
+            return m.embed_at(pre, t[None, None], p)[0]    # [1, d]
+
+        def step(carry, _):
+            if eos is None:
+                caches, tok, pos, key_data = carry
+            else:
+                caches, tok, pos, key_data, done = carry
+            h = jax.vmap(embed_one)(tok, pos)              # [S, 1, d]
+
+            def layer(h, inp):
+                bp, cache = inp
+                bpd = dequant_tree(bp, cd)
+
+                def one(hh, cc, pp):
+                    out, cc2 = m.block.decode(
+                        bpd, hh[None],
+                        jax.tree_util.tree_map(lambda a: a[None], cc), pp)
+                    return out[0], jax.tree_util.tree_map(
+                        lambda a: a[0], cc2)
+
+                return jax.vmap(one)(h, cache, pos)
+
+            h, caches = jax.lax.scan(layer, h, (block_stack, caches))
+            logits = head_logits(m, post, h)[:, 0, :]      # [S, V]
+            keys = jax.random.wrap_key_data(key_data)
+            ks = jax.vmap(jax.random.split)(keys)          # [S, 2] keys
+            key_data = jax.random.key_data(ks[:, 0])
+            nxt = jax.vmap(
+                lambda lg, k: sample_logits(lg[None], k, gen)[0])(
+                    logits, ks[:, 1])
+            if eos is None:
+                return (caches, nxt, pos + 1, key_data), nxt
+            nxt = jnp.where(done, jnp.int32(gen.pad_token_id), nxt)
+            done = done | (nxt == jnp.int32(eos))
+            return (caches, nxt, pos + 1, key_data, done), nxt
+
+        init = (caches, tok, pos, key_data)
+        if eos is not None:
+            init = init + (tok == jnp.int32(eos),)
+        carry, toks = jax.lax.scan(step, init, None,
+                                   length=self.decode_chunk)
+        caches, tok, pos, key_data = carry[:4]
+        return caches, tok, pos, key_data, jnp.moveaxis(toks, 0, 1)
+
+    # -- backend API -------------------------------------------------------
+
+    def prefill(self, slot: int, prompt: Sequence[int], seed: int) -> int:
+        """Fill slot ``slot``'s cache rows from ``prompt`` and return the
+        first sampled token. Blocking — the returned int IS the TTFT
+        moment. One program per prompt-length bucket."""
+        reg = get_registry()
+        if self.buckets is not None:
+            padded, p = self.buckets.pad(prompt, self.gen.pad_token_id)
+        else:
+            padded, p = list(prompt), len(prompt)
+        B = len(padded)
+        run = self._prefill_programs.get(B)
+        if run is None:
+            reg.counter("serve.engine.prefill_program_misses").inc()
+            run = jax.jit(self._prefill_fn, donate_argnums=(3,))
+            self._prefill_programs[B] = run
+            reg.gauge("serve.engine.prefill_programs").set(
+                len(self._prefill_programs))
+            if self.buckets is None and \
+                    len(self._prefill_programs) == self.shape_cache_warn + 1:
+                import warnings
+                warnings.warn(
+                    f"serve engine compiled "
+                    f"{len(self._prefill_programs)} distinct prefill "
+                    f"programs with bucketing DISABLED — every new "
+                    f"prompt length recompiles. Pass a BucketSpec to cap "
+                    f"the program cache.", RuntimeWarning, stacklevel=3)
+        else:
+            reg.counter("serve.engine.prefill_program_hits").inc()
+        arr = jnp.asarray(padded, jnp.int32)[None, :]
+        key = jax.random.key(seed)
+        caches, tok0, key = run(self._block_stack, self._pre, self._post,
+                                self._caches, arr, jnp.int32(p),
+                                jnp.int32(slot), key)
+        self._caches = caches
+        tok0 = int(tok0)
+        self._tok = self._tok.at[slot].set(tok0)
+        self._pos = self._pos.at[slot].set(p)
+        self._key_data = self._key_data.at[slot].set(
+            jax.random.key_data(key))
+        return tok0
+
+    def decode(self, live: np.ndarray):
+        """One decode chunk for all slots. Returns ``(tokens [S, K],
+        valid [S, K])`` — dead slots compute garbage (their rows are
+        rewritten at the next prefill); ``valid`` masks them out."""
+        caches, tok, pos, kd, toks = self._decode_jit(
+            self._block_stack, self._pre, self._post, self._caches,
+            self._tok, self._pos, self._key_data)
+        self._caches = caches
+        self._tok, self._pos, self._key_data = tok, pos, kd
+        toks = np.asarray(toks)
+        valid = np.broadcast_to(
+            np.asarray(live, bool)[:, None], toks.shape)
+        return toks, valid
+
+    def program_stats(self) -> dict:
+        return {"prefill_programs": len(self._prefill_programs),
+                "decode_chunk": self.decode_chunk}
+
+
+class ServeEngine:
+    """The continuous-batching scheduler over a slot backend.
+
+    ``backend`` is a :class:`SingleDeviceSlotBackend` or
+    :class:`~.ring.RingSlotBackend`; the engine itself is pure host-side
+    bookkeeping (single-threaded tick loop — call ``tick`` from one
+    thread). ``queue`` defaults to a fresh bounded
+    :class:`~.queue.RequestQueue`; pass your own to share a front door
+    or to inject a test clock.
+    """
+
+    def __init__(self, backend, queue: Optional[RequestQueue] = None,
+                 *, event_log=None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.backend = backend
+        if queue is None:
+            queue = RequestQueue(clock=clock or time.monotonic)
+        elif clock is not None and clock is not queue.clock:
+            raise ValueError(
+                "pass the clock on the queue (engine adopts queue.clock)")
+        self.queue = queue
+        self.clock = queue.clock
+        self.events = event_log if event_log is not None else NULL_EVENT_LOG
+        self._slots: List[Optional[_Slot]] = [None] * backend.num_slots
+        self._free = list(range(backend.num_slots - 1, -1, -1))
+        self._responses = {}
+
+    # -- front door --------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None, seed: int = 0,
+               priority: int = 0,
+               timeout_s: Optional[float] = None) -> Request:
+        """Validate + enqueue. Raises ``ValueError`` on an unservable
+        request (too long for the buckets/cache/positions) and
+        :class:`~.queue.QueueFull` under backpressure."""
+        reg = get_registry()
+        if max_new_tokens is None:
+            max_new_tokens = self.backend.gen.max_new_tokens
+        self.backend.validate(len(prompt), max_new_tokens)
+        try:
+            req = self.queue.submit(prompt, max_new_tokens=max_new_tokens,
+                                    seed=seed, priority=priority,
+                                    timeout_s=timeout_s)
+        except QueueFull:
+            reg.counter("serve.engine.rejected").inc()
+            raise
+        reg.counter("serve.engine.submitted").inc()
+        reg.gauge("serve.engine.queue_depth").set(self.queue.depth)
+        return req
+
+    def cancel(self, request_id: int) -> bool:
+        return self.queue.cancel(request_id)
+
+    def response(self, request_id: int) -> Optional[Response]:
+        return self._responses.get(request_id)
+
+    @property
+    def live_slots(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def idle(self) -> bool:
+        return self.live_slots == 0 and self.queue.depth == 0
+
+    # -- retirement --------------------------------------------------------
+
+    def _record(self, resp: Response, bucket: Optional[int]) -> None:
+        self._responses[resp.request_id] = resp
+        self.queue.forget(resp.request_id)
+        reg = get_registry()
+        reg.counter("serve.engine.retired").inc()
+        if resp.status == "timeout":
+            reg.counter("serve.engine.timed_out").inc()
+        elif resp.status == "cancelled":
+            reg.counter("serve.engine.cancelled").inc()
+        self.events.event(
+            REQUEST, request=resp.request_id, status=resp.status,
+            finish_reason=resp.finish_reason, prompt_len=resp.prompt_len,
+            bucket=bucket, tokens=len(resp.tokens), ttft=resp.ttft,
+            latency=resp.latency)
+
+    def _finish_queued(self, req: Request, reason: str,
+                       now: float) -> Response:
+        status = "cancelled" if reason == "cancelled" else "timeout"
+        resp = Response(request_id=req.id, tokens=[], status=status,
+                        finish_reason=reason, prompt_len=len(req.prompt),
+                        ttft=None, latency=now - req.submitted_at)
+        self._record(resp, None)
+        return resp
+
+    def _retire(self, slot: int, status: str, reason: str,
+                now: float) -> Response:
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self._free.append(slot)
+        req = st.req
+        bucket = (self.backend.buckets.bucket_for(len(req.prompt))
+                  if self.backend.buckets is not None else len(req.prompt))
+        resp = Response(request_id=req.id, tokens=list(st.tokens),
+                        status=status, finish_reason=reason,
+                        prompt_len=len(req.prompt), ttft=st.ttft,
+                        latency=now - req.submitted_at)
+        self._record(resp, bucket)
+        return resp
+
+    # -- the tick ----------------------------------------------------------
+
+    def tick(self) -> List[Response]:
+        """One scheduler step: sweep deadlines/cancellations, admit into
+        free slots, run one decode chunk, retire. Returns the requests
+        that reached a terminal state during this tick."""
+        reg = get_registry()
+        now = self.clock()
+        finished: List[Response] = []
+        eos = self.backend.gen.eos_token_id
+
+        # 1) deaths — queued first (never cost a slot), then running
+        for req, reason in self.queue.reap(now):
+            finished.append(self._finish_queued(req, reason, now))
+        for slot in range(self.backend.num_slots):
+            st = self._slots[slot]
+            if st is None:
+                continue
+            if st.req.cancelled:
+                finished.append(
+                    self._retire(slot, "cancelled", "cancelled", now))
+            elif st.req.deadline is not None and now >= st.req.deadline:
+                finished.append(
+                    self._retire(slot, "timeout", "deadline", now))
+
+        # 2) admissions — prefill straight into the freed slots
+        while self._free and self.queue.depth:
+            req = self.queue.pop()
+            slot = self._free.pop()
+            tok0 = self.backend.prefill(slot, req.prompt, req.seed)
+            t_first = self.clock()
+            st = _Slot(req, tok0, ttft=t_first - req.submitted_at)
+            self._slots[slot] = st
+            reg.counter("serve.engine.admitted").inc()
+            reg.histogram("serve.engine.ttft_sec").observe(st.ttft)
+            if eos is not None and tok0 == eos:
+                finished.append(self._retire(slot, "ok", "eos", t_first))
+            elif req.max_new_tokens == 1:
+                finished.append(self._retire(slot, "ok", "length", t_first))
+
+        # 3) decode — one fixed-shape chunk for every slot
+        live = np.array([s is not None for s in self._slots])
+        if live.any():
+            t0 = self.clock()
+            toks, valid = self.backend.decode(live)
+            t1 = self.clock()
+            emitted = 0
+            for slot in range(self.backend.num_slots):
+                st = self._slots[slot]
+                if st is None:
+                    continue
+                for k in range(toks.shape[1]):
+                    if not valid[slot, k]:
+                        continue
+                    t = int(toks[slot, k])
+                    st.tokens.append(t)
+                    emitted += 1
+                    if eos is not None and t == eos:
+                        finished.append(
+                            self._retire(slot, "ok", "eos", t1))
+                        break
+                    if len(st.tokens) >= st.req.max_new_tokens:
+                        finished.append(
+                            self._retire(slot, "ok", "length", t1))
+                        break
+            if emitted:
+                reg.counter("serve.engine.tokens").inc(emitted)
+                reg.histogram("serve.engine.token_sec").observe(
+                    (t1 - t0) / emitted)
+
+        reg.gauge("serve.engine.queue_depth").set(self.queue.depth)
+        reg.gauge("serve.engine.slot_occupancy").set(
+            self.live_slots / self.backend.num_slots)
+        return finished
+
+    # -- convenience loops -------------------------------------------------
+
+    def run_until_idle(self, max_ticks: int = 1_000_000) -> List[Response]:
+        """Tick until every queued/running request retired."""
+        finished: List[Response] = []
+        for _ in range(max_ticks):
+            if self.idle:
+                return finished
+            finished.extend(self.tick())
+        raise RuntimeError(
+            f"engine not idle after {max_ticks} ticks "
+            f"(live={self.live_slots}, queued={self.queue.depth})")
+
+    def serve(self, prompts: Sequence[Sequence[int]], *,
+              max_new_tokens: Optional[int] = None,
+              seeds: Optional[Sequence[int]] = None) -> List[Response]:
+        """Batch convenience: submit all, drain, return responses in
+        submit order. Oversubscription beyond queue capacity is drained
+        incrementally (submit blocks on ticks, not on QueueFull)."""
+        ids = {}
+        i = 0
+        while i < len(prompts) or not self.idle:
+            while i < len(prompts):
+                try:
+                    req = self.submit(
+                        prompts[i], max_new_tokens=max_new_tokens,
+                        seed=seeds[i] if seeds is not None else 0)
+                except QueueFull:
+                    break
+                ids[i] = req.id
+                i += 1
+            self.tick()
+        return [self._responses[ids[j]] for j in range(len(prompts))]

@@ -1,0 +1,409 @@
+"""Continuous batching over the stage ring: slots = ring groups.
+
+:class:`~..inference.pipelined.PipelinedGenerator` keeps every stage
+busy by chasing ``n_stages`` request groups around the ring — but it
+decodes one fixed batch to completion: the ring drains as groups finish
+and refills only on the next ``generate`` call. This backend makes the
+ring **continuously** full: each of the ``n_stages`` slots is a ring
+group that can be retired and re-admitted independently, mid-flight,
+without touching the other groups' in-flight state.
+
+The trick is that the decode program carries the ring across host
+ticks. One tick = ``revolutions * n_stages`` cycles of the same
+wavefront recurrence as ``PipelinedGenerator`` (stage ``s``, cycle
+``c`` works group ``(c - s) mod n``), but the carry — per-stage
+activation ``h``, the wrap-edge token, per-stage per-group write
+positions — is device-resident state returned to the host and fed back
+next tick, with a monotonically increasing global cycle counter ``c0``.
+Admission is a host table write: prefill walks the new prompt through
+the stages (one serial ring pass, writing cache rows ``[0, p)``),
+samples the first token, and the host arms ``admit_cycle[g] = c0 + g``
+— the exact cycle stage 0 next meets group ``g``. Stage ``s`` treats
+group ``g`` as valid from ``admit_cycle[g] + s`` on, so the new
+request's wavefront threads between the live groups' wavefronts without
+any of them noticing; invalid (stage, cycle, group) combinations write
+to the sacrificial cache region past ``max_len``, the same masked-slot
+discipline as the generators.
+
+Like the single-device backend, the decode program is traced once
+(``serve.ring.decode_traces`` pins it) and prefill compiles per prompt
+bucket. Parity: greedy requests through this backend match the one-shot
+single-device ``Generator`` token-for-token (``tests/test_serve.py``);
+sampled requests use a per-request ``fold_in(key, t)`` chain (the
+``PipelinedGenerator`` convention), reproducible but intentionally not
+the single-device split chain.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..inference.generate import (GenerationConfig, head_logits,
+                                  sample_logits)
+from ..inference.quant import QuantLeaf, dequant_tree
+from ..obs.telemetry import get_registry
+from ..parallel.mesh import STAGE_AXIS
+from ..utils.compat import shard_map
+from .buckets import BucketSpec
+
+__all__ = ["RingSlotBackend"]
+
+_REBASE = 1 << 20   # keep the int32 cycle counter far from overflow
+
+
+class RingSlotBackend:
+    """``n_stages`` decode slots riding the pipeline ring, one request
+    per group (rpg=1). Params are the ``PipelinedGenerator`` layout:
+    ``stage_params`` stacked ``[n_stages, ...]`` and sharded over the
+    ``stage`` mesh axis."""
+
+    def __init__(self, mesh: Mesh, model, stage_params, pre_params,
+                 post_params, *, max_len: int,
+                 gen: GenerationConfig = GenerationConfig(),
+                 buckets: Optional[BucketSpec] = None,
+                 revolutions: int = 1, shape_cache_warn: int = 8):
+        if STAGE_AXIS not in mesh.axis_names:
+            raise ValueError(f"mesh must have a {STAGE_AXIS!r} axis")
+        if not hasattr(model, "embed_at"):
+            raise TypeError(
+                f"{type(model).__name__} has no embed_at; KV-cache "
+                "generation needs position-offset embedding")
+        if gen.num_beams != 1:
+            raise ValueError(
+                "the serve engine decodes greedy/sampled slots; beam "
+                "search has no incremental slot form (num_beams must be 1)")
+        if revolutions < 1:
+            raise ValueError(
+                f"revolutions must be >= 1, got {revolutions}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        self.mesh = mesh
+        self.model = model
+        self.gen = gen
+        self.buckets = buckets
+        self.max_len = max_len
+        self.n = mesh.shape[STAGE_AXIS]
+        self.num_slots = self.n
+        self.decode_chunk = revolutions   # tokens per slot per tick
+        self.shape_cache_warn = shape_cache_warn
+        self._stage_params = stage_params
+        self._pre = pre_params
+        self._post = post_params
+        self._lps = len(stage_params)
+
+        n = self.n
+        cd = model.cfg.compute_dtype
+        nh, hd = model.block.attn.nhead, model.block.attn.head_dim
+        # sacrificial region: big enough to absorb a q=max_bucket prefill
+        # write from an inactive stage AND any post-retirement decode
+        # overshoot within a tick
+        max_bucket = buckets.max_len if buckets is not None else max_len
+        self._cache_len = max_len + max_bucket
+        self._sac = max_len
+
+        stage_sh = NamedSharding(mesh, P(STAGE_AXIS))
+        self._caches = {
+            "k": jax.device_put(jnp.zeros(
+                (n * self._lps, n, 1, self._cache_len, nh, hd), cd),
+                stage_sh),
+            "v": jax.device_put(jnp.zeros(
+                (n * self._lps, n, 1, self._cache_len, nh, hd), cd),
+                stage_sh)}
+        self._h = jax.device_put(
+            jnp.zeros((n, 1, model.cfg.d_model), cd), stage_sh)
+        self._tok_ring = jax.device_put(jnp.zeros((n,), jnp.int32),
+                                        stage_sh)
+        self._pos_local = jax.device_put(jnp.zeros((n, n), jnp.int32),
+                                         stage_sh)
+
+        # host tables (replicated program inputs)
+        self._c0 = 0
+        self._admit = np.zeros(n, np.int32)
+        self._live_default = np.zeros(n, np.int32)
+        self._tok_inject = np.zeros(n, np.int32)
+        self._plen = np.zeros(n, np.int32)
+        kd0 = np.asarray(jax.random.key_data(jax.random.key(0)))
+        self._key_data = np.broadcast_to(
+            kd0, (n,) + kd0.shape).copy()
+        self._programs = {}
+
+    # -- validation --------------------------------------------------------
+
+    def validate(self, prompt_len: int, max_new_tokens: int) -> None:
+        bucket = (self.buckets.bucket_for(prompt_len)
+                  if self.buckets is not None else prompt_len)
+        if prompt_len + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt_len {prompt_len} + max_new_tokens "
+                f"{max_new_tokens} exceeds the slot cache ({self.max_len} "
+                f"rows); raise max_len or shorten the request")
+        if max_new_tokens > self.gen.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} exceeds the engine cap "
+                f"({self.gen.max_new_tokens})")
+        mp = getattr(self.model, "max_position", None)
+        limit = mp() if callable(mp) else None
+        need = max(bucket, prompt_len + max_new_tokens
+                   + self.decode_chunk - 1)
+        if limit is not None and need > limit:
+            raise ValueError(
+                f"request needs position {need} but the positional "
+                f"table has {limit}")
+
+    # -- shared device pieces ---------------------------------------------
+
+    def _ring(self, x):
+        n = self.n
+        return jax.lax.ppermute(x, STAGE_AXIS,
+                                [(i, (i + 1) % n) for i in range(n)])
+
+    def _local_blocks(self, stage_params):
+        cd = self.model.cfg.compute_dtype
+
+        def local_slice(a):
+            if isinstance(a, QuantLeaf):
+                return QuantLeaf(q=a.q[0], scale=a.scale[0])
+            return a[0].astype(cd)
+
+        blocks = [jax.tree_util.tree_map(
+                      local_slice, bp,
+                      is_leaf=lambda x: isinstance(x, QuantLeaf))
+                  for bp in stage_params]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+
+    def _run_blocks(self, block_stack, h, caches, grp, pos):
+        """This stage's layers on ``h`` against group ``grp``'s slab —
+        the ``PipelinedGenerator._run_blocks`` recurrence."""
+        m = self.model
+        cd = m.cfg.compute_dtype
+        lps = self._lps
+
+        def slab_slice(a):
+            s = jax.lax.dynamic_slice(
+                a, (0, grp) + (0,) * (a.ndim - 2),
+                (lps, 1) + a.shape[2:])
+            return jnp.squeeze(s, axis=1)
+
+        def slab_write(a, new):
+            return jax.lax.dynamic_update_slice(
+                a, new[:, None], (0, grp) + (0,) * (a.ndim - 2))
+
+        slab = jax.tree_util.tree_map(slab_slice, caches)
+
+        def layer_step(h_c, inp):
+            bp, cache = inp
+            h_new, cache = m.block.decode(dequant_tree(bp, cd), h_c,
+                                          cache, pos)
+            return h_new, cache
+
+        h, new_slab = jax.lax.scan(layer_step, h, (block_stack, slab))
+        caches = jax.tree_util.tree_map(slab_write, caches, new_slab)
+        return h, caches
+
+    # -- device programs ---------------------------------------------------
+
+    def _prefill_fn(self, stage_params, pre, post, caches, pos_local,
+                    prompt, true_len, slot, key):
+        """One serial ring pass of the padded prompt: cycle ``i`` stage
+        ``i`` runs its layers (q = bucket len) on the h arriving from
+        stage ``i-1``, writing cache rows [0, B) of group ``slot``'s
+        slab; stage n-1 samples the first token on the last cycle. The
+        in-flight decode carry (h ring, wrap token) is untouched — live
+        groups never notice an admission."""
+        m, gen, n = self.model, self.gen, self.n
+        cd = m.cfg.compute_dtype
+        s = jax.lax.axis_index(STAGE_AXIS)
+        get_registry().counter("serve.ring.prefill_traces").inc()
+        block_stack = self._local_blocks(stage_params)
+        pos_row = pos_local[0]                          # [n_groups]
+
+        def cycle(carry, i):
+            h_carry, caches, tok0 = carry
+            active = (s == i)
+            pos_w = jnp.where(active, 0, self._sac)
+            h_embed = m.embed_at(pre, prompt, 0)        # [1, B, d]
+            h_in = jnp.where(s == 0, h_embed, h_carry)
+            h_out, caches = self._run_blocks(block_stack, h_in, caches,
+                                             slot, pos_w)
+            h_last = jax.lax.dynamic_slice(
+                h_out, (0, true_len - 1, 0), (1, 1, h_out.shape[-1]))
+            logits = head_logits(m, post, h_last)[:, 0, :]
+            tok = sample_logits(logits, jax.random.fold_in(key, 0),
+                                gen)[0]
+            emit = active & (s == n - 1)
+            tok0 = jnp.where(emit, tok, tok0)
+            return (self._ring(h_out), caches, tok0), None
+
+        h0 = jnp.zeros((1, prompt.shape[1], m.cfg.d_model), cd)
+        (_, caches, tok0), _ = jax.lax.scan(
+            cycle, (h0, caches, jnp.int32(0)), jnp.arange(n))
+        tok0 = jax.lax.psum(jnp.where(s == n - 1, tok0, 0), STAGE_AXIS)
+        pos_row = jax.lax.dynamic_update_slice(
+            pos_row, true_len[None], (slot,))
+        return caches, pos_row[None], tok0
+
+    def _decode_fn(self, stage_params, pre, post, caches, h_carry,
+                   tok_ring, pos_local, c0, admit, live, tok_inject,
+                   plen, key_data):
+        """``revolutions`` ring revolutions with a persistent carry. Per
+        cycle ``c = c0 + i``: stage ``s`` works group ``grp = (c - s)
+        mod n``; the group is valid here iff it is live and its
+        admission wavefront has reached this stage (``c >= admit[grp] +
+        s``); stage 0 swaps in the prefill-sampled token exactly at
+        ``c == admit[grp]``. Invalid work lands in the sacrificial cache
+        region. Traced once — the counter pins it."""
+        m, gen, n = self.model, self.gen, self.n
+        cd = m.cfg.compute_dtype
+        R = self.decode_chunk
+        s = jax.lax.axis_index(STAGE_AXIS)
+        get_registry().counter("serve.ring.decode_traces").inc()
+        block_stack = self._local_blocks(stage_params)
+        eos = gen.eos_token_id
+
+        def cycle(carry, i):
+            h_carry, tok_ring, caches, pos_row, emitted = carry
+            c = c0 + i
+            grp = jnp.mod(c - s, n)
+            adm = jnp.take(admit, grp)
+            valid = (jnp.take(live, grp) != 0) & (c >= adm + s)
+            pos = jnp.take(pos_row, grp)
+            pos_use = jnp.where(valid, pos, self._sac)
+            inject = c == adm
+            tok_use = jnp.where(inject, jnp.take(tok_inject, grp),
+                                tok_ring[0])
+            h_embed = m.embed_at(pre, tok_use[None, None], pos_use)
+            h_in = jnp.where(s == 0, h_embed, h_carry)
+            h_out, caches = self._run_blocks(block_stack, h_in, caches,
+                                             grp, pos_use)
+            logits = head_logits(m, post, h_out)[:, 0, :]   # [1, V]
+            kd_g = jax.lax.dynamic_index_in_dim(key_data, grp, 0,
+                                                keepdims=False)
+            key_g = jax.random.wrap_key_data(kd_g)
+            t_gen = pos - jnp.take(plen, grp) + 1
+            tok_out = sample_logits(
+                logits, jax.random.fold_in(key_g, t_gen), gen)
+            emit = (s == n - 1) & valid
+            r = i // n
+            old = jax.lax.dynamic_slice(emitted, (grp, r), (1, 1))[0, 0]
+            emitted = jax.lax.dynamic_update_slice(
+                emitted, jnp.where(emit, tok_out[0], old)[None, None],
+                (grp, r))
+            pos_row = jax.lax.dynamic_update_slice(
+                pos_row, jnp.where(valid, pos + 1, pos)[None], (grp,))
+            return (self._ring(h_out), self._ring(tok_out), caches,
+                    pos_row, emitted), None
+
+        emitted0 = jnp.zeros((n, R), jnp.int32)
+        (h_carry, tok_ring, caches, pos_row, emitted), _ = jax.lax.scan(
+            cycle, (h_carry, tok_ring, caches, pos_local[0], emitted0),
+            jnp.arange(n * R))
+        emitted = jax.lax.psum(
+            jnp.where(s == n - 1, emitted, 0), STAGE_AXIS)
+        return caches, h_carry, tok_ring, pos_row[None], emitted
+
+    # -- backend API -------------------------------------------------------
+
+    def _build(self, kind, B=None):
+        pspec = jax.tree_util.tree_map(lambda _: P(STAGE_AXIS),
+                                       self._stage_params)
+        pre_spec = jax.tree_util.tree_map(lambda _: P(), self._pre)
+        post_spec = jax.tree_util.tree_map(lambda _: P(), self._post)
+        cache_spec = jax.tree_util.tree_map(lambda _: P(STAGE_AXIS),
+                                            self._caches)
+        if kind == "prefill":
+            in_specs = (pspec, pre_spec, post_spec, cache_spec,
+                        P(STAGE_AXIS), P(), P(), P(), P())
+            out_specs = (cache_spec, P(STAGE_AXIS), P())
+            fn = self._prefill_fn
+        else:
+            in_specs = (pspec, pre_spec, post_spec, cache_spec,
+                        P(STAGE_AXIS), P(STAGE_AXIS), P(STAGE_AXIS),
+                        P(), P(), P(), P(), P(), P())
+            out_specs = (cache_spec, P(STAGE_AXIS), P(STAGE_AXIS),
+                         P(STAGE_AXIS), P())
+            fn = self._decode_fn
+        return jax.jit(shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+    def prefill(self, slot: int, prompt: Sequence[int], seed: int) -> int:
+        reg = get_registry()
+        if self.buckets is not None:
+            padded, p = self.buckets.pad(prompt, self.gen.pad_token_id)
+        else:
+            padded, p = list(prompt), len(prompt)
+        B = len(padded)
+        run = self._programs.get(("prefill", B))
+        if run is None:
+            reg.counter("serve.engine.prefill_program_misses").inc()
+            run = self._build("prefill", B)
+            self._programs[("prefill", B)] = run
+            n_pre = sum(1 for k in self._programs if k[0] == "prefill")
+            reg.gauge("serve.engine.prefill_programs").set(n_pre)
+            if self.buckets is None and n_pre == self.shape_cache_warn + 1:
+                import warnings
+                warnings.warn(
+                    f"ring serve backend compiled {n_pre} distinct "
+                    f"prefill programs with bucketing DISABLED — every "
+                    f"new prompt length recompiles. Pass a BucketSpec "
+                    f"to cap the program cache.",
+                    RuntimeWarning, stacklevel=3)
+        else:
+            reg.counter("serve.engine.prefill_program_hits").inc()
+        arr = jnp.asarray(padded, jnp.int32)[None, :]
+        key = jax.random.key(seed)
+        caches, pos_local, tok0 = run(
+            self._stage_params, self._pre, self._post, self._caches,
+            self._pos_local, arr, jnp.int32(p), jnp.int32(slot), key)
+        self._caches = caches
+        self._pos_local = pos_local
+        tok0 = int(tok0)
+        self._admit[slot] = self._c0 + slot
+        self._tok_inject[slot] = tok0
+        self._plen[slot] = p
+        self._key_data[slot] = np.asarray(
+            jax.random.key_data(jax.random.key(seed)))
+        return tok0
+
+    def decode(self, live: np.ndarray):
+        """One tick = ``revolutions`` tokens per live slot. Returns
+        ``(tokens [S, R], valid [S, R])``; validity accounts for
+        admission wavefronts still filling the ring."""
+        n, R = self.n, self.decode_chunk
+        live = np.asarray(live).astype(np.int32)
+        run = self._programs.get("decode")
+        if run is None:
+            run = self._build("decode")
+            self._programs["decode"] = run
+        caches, h, tok_ring, pos_local, emitted = run(
+            self._stage_params, self._pre, self._post, self._caches,
+            self._h, self._tok_ring, self._pos_local,
+            jnp.int32(self._c0), jnp.asarray(self._admit),
+            jnp.asarray(live), jnp.asarray(self._tok_inject),
+            jnp.asarray(self._plen), jnp.asarray(self._key_data))
+        self._caches, self._h = caches, h
+        self._tok_ring, self._pos_local = tok_ring, pos_local
+        toks = np.asarray(emitted)                       # [n, R]
+        g = np.arange(n)[:, None]
+        r = np.arange(R)[None, :]
+        emit_cycle = self._c0 + r * n + (g + n - 1) % n
+        valid = (live[:, None] != 0) & \
+            (emit_cycle >= self._admit[:, None] + n - 1)
+        self._c0 += n * R
+        if self._c0 > _REBASE:
+            shift = self._c0
+            self._c0 = 0
+            self._admit = np.maximum(
+                self._admit - shift, -np.int32(_REBASE)).astype(np.int32)
+        return toks, valid
+
+    def program_stats(self) -> dict:
+        return {"prefill_programs": sum(
+                    1 for k in self._programs
+                    if isinstance(k, tuple) and k[0] == "prefill"),
+                "decode_chunk": self.decode_chunk}

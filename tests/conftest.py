@@ -81,6 +81,15 @@ _SMOKE = {
     "test_generate.py::test_greedy_generation_matches_naive_reforward",
     "test_pipelined_gen.py::"
     "test_pipelined_greedy_matches_single_device[2-4-8-6]",
+    # serve engine: the parity + zero-recompile pin on both backends,
+    # and the queue's three liveness behaviours
+    "test_serve.py::test_staggered_arrivals_match_one_shot_generator"
+    "[single]",
+    "test_serve.py::test_staggered_arrivals_match_one_shot_generator"
+    "[ring]",
+    "test_serve.py::test_backpressure_rejects_when_full",
+    "test_serve.py::test_deadline_timeout_retires_running_slot",
+    "test_serve.py::test_cancellation_frees_slot",
     # phase-compiled executor: one bitwise-parity case per lowering shape
     # (scan steady state, scan-free unroll), the loud rejection path, and
     # the front-door plumbing

@@ -1,0 +1,432 @@
+"""The serve subsystem (pipe_tpu/serve): continuous batching over slots.
+
+Gold contract, same shape as the generator suites: serving a request
+through the slot engine — staggered arrivals, mixed prompt lengths,
+whatever the other slots are doing — yields bitwise the tokens of a
+one-shot batch-1 ``Generator.generate`` on that prompt. On top of the
+parity pin: the zero-recompile pin (the decode program's trace counter
+stays at 1 across all traffic), and the queue semantics (backpressure,
+deadlines, cancellation, priority).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.inference import GenerationConfig, Generator
+from pipe_tpu.inference.generate import sequence_lengths
+from pipe_tpu.inference.pipelined import PipelinedGenerator
+from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+from pipe_tpu.obs.telemetry import get_registry, percentile_exact
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.spmd import stack_stage_params
+from pipe_tpu.serve import (BucketSpec, QueueFull, RequestQueue,
+                            RingSlotBackend, ServeEngine,
+                            SingleDeviceSlotBackend)
+
+CFG = LMConfig(vocab=89, d_model=32, nhead=4, d_ff=64, n_layers=4,
+               seq_len=32, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = PipelinedLM(CFG, n_stages=2)
+    return model, model.init(jax.random.key(0))
+
+
+def _one_shot_refs(model, params, prompts, gen_cfg, seed):
+    g = Generator(model, gen_cfg)
+    return [np.asarray(g.generate(params,
+                                  jnp.asarray(p, jnp.int32)[None],
+                                  jax.random.key(seed)))[0]
+            for p in prompts]
+
+
+def _mixed_prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(1, CFG.vocab, size=n)) for n in lengths]
+
+
+def _make_backend(kind, model, params, gen_cfg, **kw):
+    if kind == "single":
+        return SingleDeviceSlotBackend(model, params, num_slots=2,
+                                       max_len=16, gen=gen_cfg,
+                                       buckets=BucketSpec.of(4, 8), **kw)
+    sp, pre, post = params
+    mesh = make_mesh(2, 1)
+    return RingSlotBackend(mesh, model, stack_stage_params(sp), pre, post,
+                           max_len=16, gen=gen_cfg,
+                           buckets=BucketSpec.of(4, 8), **kw)
+
+
+# ---------------------------------------------------------------------------
+# the parity pin + the zero-recompile pin
+
+
+@pytest.mark.parametrize("kind", ["single", "ring"])
+def test_staggered_arrivals_match_one_shot_generator(kind,
+                                                     model_and_params):
+    """Mixed prompt lengths arriving mid-flight, greedy: every response
+    is bitwise the one-shot batch-1 Generator output, and the decode
+    program traced exactly once (zero steady-state recompiles)."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    prompts = _mixed_prompts((3, 5, 4, 7, 5))
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=7)
+
+    backend = _make_backend(kind, model, params, gen_cfg)
+    trace_counter = ("serve.engine.decode_traces" if kind == "single"
+                     else "serve.ring.decode_traces")
+    traces0 = get_registry().counter(trace_counter).value
+
+    eng = ServeEngine(backend)
+    ids = [eng.submit(prompts[0], seed=7).id]
+    eng.tick()
+    ids += [eng.submit(p, seed=7).id for p in prompts[1:3]]
+    eng.tick()
+    ids += [eng.submit(p, seed=7).id for p in prompts[3:]]
+    eng.run_until_idle()
+
+    for i, rid in enumerate(ids):
+        resp = eng.response(rid)
+        assert resp.status == "ok" and resp.finish_reason == "length"
+        np.testing.assert_array_equal(np.asarray(resp.tokens), refs[i])
+        assert resp.ttft is not None and resp.latency >= resp.ttft
+    assert get_registry().counter(trace_counter).value - traces0 == 1
+    # two buckets touched -> exactly two prefill programs
+    assert backend.program_stats()["prefill_programs"] == 2
+
+
+def test_chunked_decode_parity(model_and_params):
+    """decode_chunk=3 chops the same carry chain into K-step ticks —
+    parity is unchanged."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    prompts = _mixed_prompts((3, 5, 4, 7, 5))
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=7)
+    backend = _make_backend("single", model, params, gen_cfg,
+                            decode_chunk=3)
+    resps = ServeEngine(backend).serve(prompts, seeds=[7] * len(prompts))
+    for resp, ref in zip(resps, refs):
+        np.testing.assert_array_equal(np.asarray(resp.tokens), ref)
+
+
+def test_sampled_decode_parity(model_and_params):
+    """temperature>0: the slot key chain replicates the batch-1
+    Generator chain exactly, so even sampled tokens are bitwise equal."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.8,
+                               top_k=12)
+    prompts = _mixed_prompts((3, 5, 4))
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=5)
+    backend = _make_backend("single", model, params, gen_cfg)
+    resps = ServeEngine(backend).serve(prompts, seeds=[5] * len(prompts))
+    for resp, ref in zip(resps, refs):
+        np.testing.assert_array_equal(np.asarray(resp.tokens), ref)
+
+
+def test_serve_eos_retires_early(model_and_params):
+    """With eos_token_id set, the engine retires the slot at the EOS
+    token and the emitted tokens are the one-shot run truncated at its
+    sequence length."""
+    model, params = model_and_params
+    probe = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    prompts = _mixed_prompts((4, 6))
+    free = _one_shot_refs(model, params, prompts, probe, seed=7)
+    eos = int(free[0][2])   # a token greedy decoding actually emits
+
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=0.0,
+                               eos_token_id=eos)
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=7)
+    lens = [int(sequence_lengths(jnp.asarray(r)[None], eos)[0])
+            for r in refs]
+    backend = _make_backend("single", model, params, gen_cfg)
+    resps = ServeEngine(backend).serve(prompts, seeds=[7, 7])
+    for resp, ref, n in zip(resps, refs, lens):
+        np.testing.assert_array_equal(np.asarray(resp.tokens), ref[:n])
+        if resp.finish_reason == "eos":
+            assert resp.tokens[-1] == eos
+        assert len(resp.tokens) == n
+
+
+def test_validate_rejects_unservable_requests(model_and_params):
+    """Bad requests bounce at submit — they never cost a slot."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    eng = ServeEngine(_make_backend("single", model, params, gen_cfg))
+    with pytest.raises(ValueError, match="bucket"):
+        eng.submit(list(range(1, 10)))          # longest bucket is 8
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1, 2, 3], max_new_tokens=60)
+    assert eng.queue.depth == 0
+
+
+# ---------------------------------------------------------------------------
+# queue semantics: backpressure, deadlines, cancellation, priority
+
+
+def test_backpressure_rejects_when_full(model_and_params):
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    backend = _make_backend("single", model, params, gen_cfg)
+    eng = ServeEngine(backend, RequestQueue(capacity=2))
+    reg = get_registry()
+    rejected0 = reg.counter("serve.engine.rejected").value
+    eng.submit([1, 2, 3])
+    eng.submit([4, 5])
+    with pytest.raises(QueueFull):
+        eng.submit([6, 7, 8])
+    assert reg.counter("serve.engine.rejected").value - rejected0 == 1
+    # draining frees capacity again
+    eng.run_until_idle()
+    eng.submit([6, 7, 8])
+    eng.run_until_idle()
+
+
+def test_deadline_timeout_retires_running_slot(model_and_params):
+    """A running request whose deadline passes is retired mid-stream:
+    status=timeout, partial tokens kept, slot freed for the next
+    admission."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=50, temperature=0.0)
+    backend = SingleDeviceSlotBackend(model, params, num_slots=1,
+                                      max_len=64, gen=gen_cfg,
+                                      buckets=BucketSpec.of(4))
+    t = [0.0]
+    eng = ServeEngine(backend, RequestQueue(clock=lambda: t[0]))
+    doomed = eng.submit([1, 2, 3], timeout_s=5.0)
+    eng.tick()  # admit + first decode
+    assert eng.live_slots == 1
+    t[0] = 6.0
+    finished = eng.tick()
+    assert [r.request_id for r in finished] == [doomed.id]
+    resp = eng.response(doomed.id)
+    assert resp.status == "timeout" and resp.finish_reason == "deadline"
+    assert len(resp.tokens) >= 1           # partial output survives
+    assert eng.live_slots == 0
+    # the freed slot admits the next request
+    ok = eng.submit([4, 5, 6], max_new_tokens=3)
+    eng.run_until_idle()
+    assert eng.response(ok.id).status == "ok"
+
+
+def test_deadline_timeout_reaps_queued_request(model_and_params):
+    """A request that dies WAITING is reaped before ever costing a
+    prefill: no tokens, no ttft."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    backend = _make_backend("single", model, params, gen_cfg)
+    t = [0.0]
+    eng = ServeEngine(backend, RequestQueue(clock=lambda: t[0]))
+    req = eng.submit([1, 2, 3], timeout_s=1.0)
+    t[0] = 2.0
+    eng.tick()
+    resp = eng.response(req.id)
+    assert resp.status == "timeout" and resp.tokens == []
+    assert resp.ttft is None
+
+
+def test_cancellation_frees_slot(model_and_params):
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=50, temperature=0.0)
+    backend = SingleDeviceSlotBackend(model, params, num_slots=1,
+                                      max_len=64, gen=gen_cfg,
+                                      buckets=BucketSpec.of(4))
+    eng = ServeEngine(backend)
+    victim = eng.submit([1, 2, 3])
+    queued = eng.submit([4, 5, 6], max_new_tokens=3)
+    eng.tick()
+    assert eng.live_slots == 1 and eng.queue.depth == 1
+    assert eng.cancel(victim.id)
+    eng.run_until_idle()
+    v = eng.response(victim.id)
+    assert v.status == "cancelled" and v.finish_reason == "cancelled"
+    assert eng.response(queued.id).status == "ok"
+    # cancelling a finished/unknown id is a no-op
+    assert not eng.cancel(victim.id)
+
+
+def test_cancel_while_queued_never_prefills(model_and_params):
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    backend = SingleDeviceSlotBackend(model, params, num_slots=1,
+                                      max_len=16, gen=gen_cfg,
+                                      buckets=BucketSpec.of(4))
+    eng = ServeEngine(backend)
+    running = eng.submit([1, 2], max_new_tokens=4)
+    waiting = eng.submit([3, 4], max_new_tokens=4)
+    eng.tick()
+    eng.cancel(waiting.id)
+    eng.run_until_idle()
+    assert eng.response(waiting.id).status == "cancelled"
+    assert eng.response(waiting.id).tokens == []
+    assert eng.response(running.id).status == "ok"
+
+
+def test_priority_queue_orders_admissions():
+    q = RequestQueue(capacity=8, policy="priority", clock=lambda: 0.0)
+    a = q.submit([1], max_new_tokens=1, seed=0, priority=0)
+    b = q.submit([2], max_new_tokens=1, seed=0, priority=5)
+    c = q.submit([3], max_new_tokens=1, seed=0, priority=5)
+    d = q.submit([4], max_new_tokens=1, seed=0, priority=1)
+    # highest priority first; FIFO among equals
+    assert [q.pop().id for _ in range(4)] == [b.id, c.id, d.id, a.id]
+
+
+def test_fifo_queue_is_fifo():
+    q = RequestQueue(capacity=4, clock=lambda: 0.0)
+    ids = [q.submit([i], max_new_tokens=1, seed=0).id for i in range(3)]
+    assert [q.pop().id for _ in range(3)] == ids
+
+
+# ---------------------------------------------------------------------------
+# buckets + program-cache hygiene
+
+
+def test_bucket_spec_selection_and_padding():
+    spec = BucketSpec.of(4, 8, 16)
+    assert spec.bucket_for(1) == 4
+    assert spec.bucket_for(4) == 4
+    assert spec.bucket_for(5) == 8
+    assert spec.bucket_for(16) == 16
+    with pytest.raises(ValueError):
+        spec.bucket_for(17)
+    padded, n = spec.pad([7, 7, 7, 7, 7], pad_token_id=9)
+    assert padded == [7, 7, 7, 7, 7, 9, 9, 9] and n == 5
+    assert spec.max_len == 16
+
+
+def test_bucket_pow2_ladder():
+    spec = BucketSpec.pow2(min_len=8, max_len=100)
+    assert spec.lengths == (8, 16, 32, 64, 100)
+
+
+def test_unbucketed_prefill_warns_past_threshold(model_and_params):
+    """bucketing disabled + many distinct prompt lengths -> loud
+    RuntimeWarning when the program cache blows past the threshold."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=2, temperature=0.0)
+    backend = SingleDeviceSlotBackend(model, params, num_slots=1,
+                                      max_len=16, gen=gen_cfg,
+                                      buckets=None, shape_cache_warn=2)
+    eng = ServeEngine(backend)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for n in (2, 3, 4):
+            eng.serve([_mixed_prompts((n,))[0]])
+        hits = [x for x in w if issubclass(x.category, RuntimeWarning)
+                and "bucketing DISABLED" in str(x.message)]
+    assert len(hits) == 1
+    assert backend.program_stats()["prefill_programs"] == 3
+
+
+def test_generator_shape_cache_counters(model_and_params):
+    """Satellite: the plain Generator now counts its per-shape jit cache
+    and warns when it grows past the threshold."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=2, temperature=0.0)
+    g = Generator(model, gen_cfg, shape_cache_warn=2)
+    reg = get_registry()
+    h0 = reg.counter("serve.program_cache_hits").value
+    m0 = reg.counter("serve.program_cache_misses").value
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for shape in ((1, 4), (1, 5), (1, 4), (1, 6)):
+            g.generate(params, jnp.ones(shape, jnp.int32))
+        hits = [x for x in w if issubclass(x.category, RuntimeWarning)]
+    assert reg.counter("serve.program_cache_misses").value - m0 == 3
+    assert reg.counter("serve.program_cache_hits").value - h0 == 1
+    assert len(hits) == 1
+
+
+# ---------------------------------------------------------------------------
+# EOS done-masking in the underlying generators (satellite)
+
+
+def test_generator_eos_masks_and_measures_lengths(model_and_params):
+    """eos_token_id: tokens match the unmasked run up to (and
+    including) the first EOS, pad after; sequence_lengths and
+    generate_with_lengths agree."""
+    model, params = model_and_params
+    prompt = jnp.asarray(_mixed_prompts((5,), seed=3)[0],
+                         jnp.int32)[None]
+    free = np.asarray(Generator(
+        model, GenerationConfig(max_new_tokens=8,
+                                temperature=0.0)).generate(params, prompt))
+    eos = int(free[0, 2])                      # output is new tokens only
+    hit = int(np.flatnonzero(free[0] == eos)[0])
+
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=0.0,
+                               eos_token_id=eos, pad_token_id=0)
+    out, lens = Generator(model, gen_cfg).generate_with_lengths(params,
+                                                                prompt)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[0, :hit + 1], free[0, :hit + 1])
+    assert (out[0, hit + 1:] == 0).all()
+    assert int(lens[0]) == hit + 1
+    np.testing.assert_array_equal(
+        np.asarray(sequence_lengths(jnp.asarray(out), eos)),
+        np.asarray(lens))
+
+
+def test_generator_eos_none_is_unchanged(model_and_params):
+    """eos_token_id=None must trace the exact pre-satellite program —
+    same outputs, full-width lengths."""
+    model, params = model_and_params
+    prompt = jnp.ones((2, 4), jnp.int32)
+    g = Generator(model, GenerationConfig(max_new_tokens=5,
+                                          temperature=0.0))
+    out, lens = g.generate_with_lengths(params, prompt)
+    assert np.asarray(lens).tolist() == [5, 5]
+    assert sequence_lengths(out, None).tolist() == [5, 5]
+
+
+def test_pipelined_eos_matches_single_device(model_and_params):
+    """EOS masking through the ring: bitwise vs the single-device
+    Generator with the same eos, including the pad tail."""
+    model, params = model_and_params
+    sp, pre, post = params
+    prompt = jax.random.randint(jax.random.key(2), (2, 6), 1, CFG.vocab,
+                                jnp.int32)
+    free = np.asarray(Generator(
+        model, GenerationConfig(max_new_tokens=6,
+                                temperature=0.0)).generate(params, prompt))
+    eos = int(free[0, 3])
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.0,
+                               eos_token_id=eos)
+    ref = np.asarray(Generator(model, gen_cfg).generate(params, prompt))
+    mesh = make_mesh(2, 1)
+    pg = PipelinedGenerator(mesh, model, gen_cfg)
+    got, lens = pg.generate_with_lengths(stack_stage_params(sp), pre,
+                                         post, prompt)
+    np.testing.assert_array_equal(np.asarray(got), ref)
+    np.testing.assert_array_equal(
+        np.asarray(lens), np.asarray(sequence_lengths(jnp.asarray(ref),
+                                                      eos)))
+
+
+def test_generation_config_validates_eos():
+    with pytest.raises(ValueError, match="eos_token_id"):
+        GenerationConfig(eos_token_id=-1)
+    with pytest.raises(ValueError, match="pad_token_id"):
+        GenerationConfig(pad_token_id=-2)
+    with pytest.raises(ValueError, match="beam"):
+        GenerationConfig(num_beams=2, eos_token_id=3)
+
+
+def test_sequence_lengths_basics():
+    toks = jnp.asarray([[5, 2, 7, 7], [1, 1, 1, 2], [3, 3, 3, 3]],
+                       jnp.int32)
+    assert sequence_lengths(toks, 2).tolist() == [2, 4, 4]
+    assert sequence_lengths(toks, None).tolist() == [4, 4, 4]
+
+
+def test_percentile_exact():
+    vals = [5.0, 1.0, 3.0, 2.0, 4.0]
+    assert percentile_exact(vals, 0.5) == 3.0
+    assert percentile_exact(vals, 0.99) == 5.0
+    assert percentile_exact(vals, 0.0) == 1.0
+    assert percentile_exact([], 0.5) == 0.0

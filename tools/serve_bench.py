@@ -1,0 +1,272 @@
+"""Serving benchmark: the continuous-batching engine under synthetic load.
+
+Three questions, answered on whatever backend is available (the numbers
+of record are the committed ``SERVE_r08.json``):
+
+1. **Slot tax** — steady-state decode tokens/s with every slot
+   continuously full, vs the fixed-batch ``Generator`` at the same live
+   count (batch = num_slots). The engine's decode step is the batched
+   per-slot program (vmapped positions, per-slot key chains) plus one
+   host round-trip per ``decode_chunk`` tokens; the acceptance bar is
+   >= 0.9x the one-shot batch program.
+2. **Latency under load** — seeded Poisson arrivals at a fraction of
+   measured capacity; per-request TTFT p50/p99
+   (:func:`pipe_tpu.obs.telemetry.percentile_exact` — the streaming
+   histogram's bucketed quantiles are too coarse for a bench artifact).
+3. **Goodput under 2x overload, backpressure on vs off** — "on" bounds
+   the queue (excess rejected at submit, cheap), "off" admits everything
+   (requests rot in the queue past their deadline and are reaped, or
+   time out mid-decode after burning slot-steps). Goodput counts only
+   tokens of requests that finished ``ok`` within their deadline.
+
+Usage:
+  python tools/serve_bench.py            # full run, pretty JSON to stdout
+  python tools/serve_bench.py --quick    # small run, one JSON line
+Progress goes to stderr; stdout is machine-readable (the last line is
+always the summary object), so ``bench.py`` embeds the --quick summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pipe_tpu.inference import GenerationConfig, Generator
+from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+from pipe_tpu.obs.telemetry import percentile_exact
+from pipe_tpu.serve import (BucketSpec, QueueFull, RequestQueue,
+                            ServeEngine, SingleDeviceSlotBackend)
+
+CFG = LMConfig(vocab=1024, d_model=128, nhead=8, d_ff=512, n_layers=4,
+               seq_len=256, dropout=0.0)
+BUCKETS = BucketSpec.of(32, 64)
+MAX_NEW = 64
+# Size the slot cache to the workload, exactly as Generator sizes its
+# cache to prompt+max_new: attention cost scales with cache ROWS, not
+# live tokens, so an oversized max_len taxes every decode step (measured
+# ~0.6x the fixed-batch baseline at 2x the needed rows vs ~1.3x when
+# sized to fit).
+MAX_LEN = BUCKETS.max_len + MAX_NEW
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_prompts(n, rng):
+    lens = rng.choice((20, 32, 48, 64), size=n)
+    return [rng.randint(1, CFG.vocab, size=int(p)).tolist() for p in lens]
+
+
+def baseline_tokens_per_sec(model, params, slots, rng):
+    """Fixed-batch Generator decode tokens/s at batch == num_slots.
+    Two generation lengths at the largest bucket's prompt width (the
+    Generator cache spans 80..144 rows vs the engine's fixed 128 — the
+    closest apples-to-apples the shape-specialized cache allows); the
+    slope isolates the decode scan from prefill + sampling setup, and
+    min-of-3 rejects scheduler noise."""
+    prompt = jnp.asarray(
+        rng.randint(1, CFG.vocab, size=(slots, BUCKETS.max_len)),
+        jnp.int32)
+    times = {}
+    for max_new in (16, 80):
+        g = Generator(model, GenerationConfig(max_new_tokens=max_new,
+                                              temperature=0.0))
+        g.generate(params, prompt).block_until_ready()   # compile
+        reps = []
+        for _ in range(3):
+            t0 = time.monotonic()
+            g.generate(params, prompt).block_until_ready()
+            reps.append(time.monotonic() - t0)
+        times[max_new] = min(reps)
+    per_tok = (times[80] - times[16]) / (80 - 16)
+    return slots / per_tok
+
+
+def steady_state_tokens_per_sec(model, params, slots, chunk, rng,
+                                ticks=20):
+    """Saturated continuous batching: a deep queue keeps every slot
+    full across retirements (requests finish, replacements prefill in
+    the same tick). Token count from the engine's own emitted-token
+    counter, so prefill/retire churn is charged to the number honestly."""
+    from pipe_tpu.obs.telemetry import get_registry
+    gen_cfg = GenerationConfig(max_new_tokens=MAX_NEW, temperature=0.0)
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=slots, max_len=MAX_LEN, gen=gen_cfg,
+        buckets=BUCKETS, decode_chunk=chunk)
+    n_requests = slots * (2 + chunk * ticks // MAX_NEW)
+    eng = ServeEngine(backend, RequestQueue(capacity=n_requests + slots))
+    for p in make_prompts(n_requests, rng):
+        eng.submit(p)
+    for _ in range(3):
+        eng.tick()              # compile both prefill buckets + decode
+    assert eng.live_slots == slots
+    counter = get_registry().counter("serve.engine.tokens")
+    n0 = counter.value
+    t0 = time.monotonic()
+    for _ in range(ticks):
+        eng.tick()
+    dt = time.monotonic() - t0
+    assert eng.live_slots == slots      # the queue never ran dry
+    return (counter.value - n0) / dt
+
+
+def drive_poisson(eng, prompts, arrivals, *, max_new, deadline_s):
+    """Feed the engine a precomputed arrival schedule against the wall
+    clock; tick until drained. Returns (responses, elapsed, rejected)."""
+    t0 = time.monotonic()
+    i, rejected, finished = 0, 0, []
+    while i < len(arrivals) or not eng.idle:
+        now = time.monotonic() - t0
+        while i < len(arrivals) and arrivals[i] <= now:
+            try:
+                eng.submit(prompts[i], seed=i, max_new_tokens=max_new,
+                           timeout_s=deadline_s)
+            except QueueFull:
+                rejected += 1
+            i += 1
+        if eng.idle and i < len(arrivals):
+            time.sleep(min(arrivals[i] - now, 0.002))
+            continue
+        finished.extend(eng.tick())
+    return finished, time.monotonic() - t0, rejected
+
+
+def load_run(model, params, slots, chunk, rng, *, n_requests, rate,
+             max_new, deadline_s, capacity):
+    gen_cfg = GenerationConfig(max_new_tokens=max_new, temperature=0.0)
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=slots, max_len=MAX_LEN, gen=gen_cfg,
+        buckets=BUCKETS, decode_chunk=chunk)
+    eng = ServeEngine(backend, RequestQueue(capacity=capacity))
+    # warm every program before the clock matters
+    for p in ([1] * 20, [1] * 40):
+        eng.submit(p, max_new_tokens=1)
+    eng.run_until_idle()
+
+    prompts = make_prompts(n_requests, rng)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
+    finished, elapsed, rejected = drive_poisson(
+        eng, prompts, arrivals, max_new=max_new, deadline_s=deadline_s)
+    ok = [r for r in finished if r.status == "ok"]
+    ttfts = sorted(r.ttft for r in ok)
+    return {
+        "requests": n_requests,
+        "offered_rate_req_s": round(rate, 3),
+        "elapsed_s": round(elapsed, 3),
+        "ok": len(ok),
+        "timeout": sum(r.status == "timeout" for r in finished),
+        "cancelled": sum(r.status == "cancelled" for r in finished),
+        "rejected": rejected,
+        "goodput_tokens_s": round(
+            sum(len(r.tokens) for r in ok) / elapsed, 1),
+        "ttft_p50_s": round(percentile_exact(ttfts, 0.50), 4),
+        "ttft_p99_s": round(percentile_exact(ttfts, 0.99), 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small run; single-line JSON summary")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=8,
+                    help="decode_chunk: tokens per host round-trip")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(args.seed)
+    model = PipelinedLM(CFG, 1)
+    params = model.init(jax.random.key(0))
+    slots, chunk = args.slots, args.chunk
+
+    log("baseline: fixed-batch Generator decode slope...")
+    base_tps = baseline_tokens_per_sec(model, params, slots, rng)
+    log(f"  {base_tps:.1f} tokens/s at batch={slots}")
+
+    log("steady state: engine with every slot full...")
+    ticks = 8 if args.quick else 24
+    serve_tps = steady_state_tokens_per_sec(model, params, slots, chunk,
+                                            rng, ticks=ticks)
+    ratio = serve_tps / base_tps
+    log(f"  {serve_tps:.1f} tokens/s ({ratio:.3f}x fixed-batch)")
+
+    # capacity in requests/s at the bench's request size
+    max_new = MAX_NEW
+    cap_req_s = serve_tps / max_new
+
+    log("poisson @ 0.7x capacity...")
+    n = 12 if args.quick else 48
+    moderate = load_run(model, params, slots, chunk, rng,
+                        n_requests=n, rate=0.7 * cap_req_s,
+                        max_new=max_new, deadline_s=30.0,
+                        capacity=4 * slots)
+
+    summary = {
+        "bench": "serve_bench",
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "slots": slots,
+        "decode_chunk": chunk,
+        "buckets": list(BUCKETS.lengths),
+        "max_new_tokens": max_new,
+        "baseline_fixed_batch_tokens_s": round(base_tps, 1),
+        "steady_state_tokens_s": round(serve_tps, 1),
+        "serve_vs_fixed_batch": round(ratio, 4),
+        "poisson_0p7": moderate,
+    }
+    if args.quick:
+        print(json.dumps({
+            "steady_state_tokens_s": summary["steady_state_tokens_s"],
+            "serve_vs_fixed_batch": summary["serve_vs_fixed_batch"],
+            "ttft_p50_s": moderate["ttft_p50_s"],
+            "ttft_p99_s": moderate["ttft_p99_s"],
+            "goodput_tokens_s": moderate["goodput_tokens_s"],
+        }))
+        return
+
+    # 2x overload: backpressure bounds the queue so the engine only
+    # accepts what it can finish inside the deadline; without it the
+    # queue absorbs everything and requests expire waiting (reaped before
+    # prefill) or mid-decode (slot-steps burnt for zero goodput).
+    # Deadline sized so a bounded queue's wait (<= capacity/service
+    # rate) fits comfortably but an unbounded queue's does not — the
+    # regime where shedding at the door beats accepting work that will
+    # die waiting or burn slot-steps before timing out mid-decode.
+    log("overload 2x, backpressure ON (bounded queue)...")
+    n_over = 96
+    deadline = 1.0
+    on = load_run(model, params, slots, chunk,
+                  np.random.RandomState(args.seed + 1),
+                  n_requests=n_over, rate=2.0 * cap_req_s,
+                  max_new=max_new, deadline_s=deadline,
+                  capacity=2 * slots)
+    log("overload 2x, backpressure OFF (unbounded queue)...")
+    off = load_run(model, params, slots, chunk,
+                   np.random.RandomState(args.seed + 1),
+                   n_requests=n_over, rate=2.0 * cap_req_s,
+                   max_new=max_new, deadline_s=deadline,
+                   capacity=100000)
+    summary["overload_2x"] = {
+        "deadline_s": deadline,
+        "backpressure_on": on,
+        "backpressure_off": off,
+        "goodput_ratio_on_vs_off": round(
+            on["goodput_tokens_s"] / max(off["goodput_tokens_s"], 1e-9),
+            3),
+    }
+    print(json.dumps(summary, indent=2))
+
+
+if __name__ == "__main__":
+    main()
