@@ -467,6 +467,26 @@ def main():
     except Exception as e:
         print(f"serve probe failed: {e}", file=sys.stderr)
 
+    # Chaos probe: one injected fault per layer (train NaN, transport
+    # drop, serve backend raise, data raise) through the recovery
+    # machinery — all_recovered must stay true every round (cpu8, quick
+    # mode of tools/chaos_bench.py; CHAOS_r{N}.json is the full record).
+    chaos_summary = None
+    try:
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "chaos_bench.py"), "--quick"],
+            capture_output=True, text=True, timeout=900, env=env)
+        if out.returncode == 0:
+            chaos_summary = json.loads(out.stdout.strip().splitlines()[-1])
+        else:
+            print(f"chaos probe rc={out.returncode}: "
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"chaos probe failed: {e}", file=sys.stderr)
+
     trend_vs_prior = None
     try:
         trend_vs_prior = trend_vs_prior_round(here, bubble_multistage)
@@ -549,6 +569,7 @@ def main():
         "measured_bubble_multistage": bubble_multistage,
         "front_door_tax": front_door_tax,
         "serve": serve_summary,
+        "chaos": chaos_summary,
         "trend_vs_prior": trend_vs_prior,
         "final_loss": round(loss, 4),
         "step_report": report.to_json(),

@@ -63,6 +63,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "revolutions per tick)")
     p.add_argument("--events", default=None,
                    help="write the request-span EventLog here (.jsonl)")
+    p.add_argument("--tick-budget-s", type=float, default=None,
+                   help="watchdog: count ticks slower than this "
+                        "(resilience.watchdog_slow_ticks)")
+    p.add_argument("--shed-ewma", type=float, default=None,
+                   help="watchdog: deadline-miss EWMA above which "
+                        "lowest-priority queued requests are shed")
     p.add_argument("--int8", action="store_true",
                    help="int8 weight-only quantized block weights")
     p.add_argument("--family", choices=["lm", "gpt2"], default="lm")
@@ -163,7 +169,25 @@ def main(argv=None) -> int:
     events = EventLog(args.events) if args.events else NULL_EVENT_LOG
     queue = RequestQueue(capacity=args.queue_capacity,
                          policy=args.policy)
-    eng = ServeEngine(backend, queue, event_log=events)
+    watchdog = None
+    if args.tick_budget_s is not None or args.shed_ewma is not None:
+        from ..resilience import TickWatchdog
+        watchdog = TickWatchdog(tick_budget_s=args.tick_budget_s,
+                                shed_ewma_threshold=args.shed_ewma)
+    eng = ServeEngine(backend, queue, event_log=events, watchdog=watchdog)
+
+    # Graceful drain on SIGTERM/SIGINT: live slots finish, queued work is
+    # shed back to callers, new admissions stop — then a clean summary.
+    import signal as _signal
+
+    def _drain_handler(signum, frame):
+        eng.drain()
+
+    for _sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            _signal.signal(_sig, _drain_handler)
+        except (ValueError, OSError):
+            pass  # not the main thread (embedded use) — skip handlers
 
     if args.prompts_file or args.rate <= 0:
         arrivals = [0.0] * len(prompts)
@@ -172,9 +196,13 @@ def main(argv=None) -> int:
         arrivals = np.cumsum(
             rng.exponential(1.0 / args.rate, size=len(prompts))).tolist()
 
+    from ..serve import EngineDraining
+
     t0 = time.monotonic()
     i = rejected = done = 0
     while i < len(prompts) or not eng.idle:
+        if eng.draining:
+            i = len(prompts)      # stop submitting; finish what's live
         now = time.monotonic() - t0
         while i < len(prompts) and arrivals[i] <= now:
             try:
@@ -182,6 +210,9 @@ def main(argv=None) -> int:
                            timeout_s=args.timeout_s)
             except QueueFull:
                 rejected += 1
+            except EngineDraining:
+                i = len(prompts)
+                break
             i += 1
         if eng.idle and i < len(prompts):
             time.sleep(min(arrivals[i] - now, 0.005))
@@ -198,10 +229,11 @@ def main(argv=None) -> int:
     elapsed = time.monotonic() - t0
 
     snap = {k: v for k, v in get_registry().scalars().items()
-            if k.startswith("serve.")}
+            if k.startswith(("serve.", "resilience."))}
     print(json.dumps({"summary": {
         "backend": type(backend).__name__,
         "finished": done, "rejected": rejected,
+        "drained": eng.draining,
         "elapsed_s": round(elapsed, 3),
         "buckets": list(buckets.lengths), "metrics": snap}}))
     events.close()

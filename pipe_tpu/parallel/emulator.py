@@ -93,6 +93,29 @@ def _compute_one(stage: Stage, params: Any, batch: mb.Batch, ctx: StageCtx,
     return mb.Batch(result, atomic=True)
 
 
+def _corrupt_hop(batch: mb.Batch, mode: str) -> mb.Batch:
+    """Chaos-plan transport fault on a stage-boundary hop: 'drop' zeroes
+    the payload (a lost transfer), 'corrupt' scales it by NaN (a torn
+    one). Structural at trace time — with no plan the program is
+    untouched."""
+    import jax.numpy as jnp
+
+    def one(v):
+        if not mb.is_array(v):
+            return v                      # NoChunk riders pass through
+        if mode == "drop":
+            return jnp.zeros_like(v)
+        if jnp.issubdtype(v.dtype, jnp.inexact):
+            return v * jnp.asarray(jnp.nan, v.dtype)
+        return jnp.full_like(v, -1)       # int payload: garbage fill
+
+    def hit(*vals):
+        out = tuple(one(v) for v in vals)
+        return out[0] if len(out) == 1 else out
+
+    return batch.call(hit)
+
+
 def run(stages: Sequence[Stage],
         params_per_stage: Sequence[Any],
         batches: List[mb.Batch],
@@ -102,7 +125,8 @@ def run(stages: Sequence[Stage],
         train: bool = False,
         key: Optional[jax.Array] = None,
         remat_policy=None,
-        skip_tracker=None) -> List[mb.Batch]:
+        skip_tracker=None,
+        chaos=None) -> List[mb.Batch]:
     """Execute the clock-cycle schedule serially; returns transformed batches.
 
     Mirrors ``Pipeline.run`` (reference ``pipeline.py:100-117``): iterate the
@@ -111,6 +135,12 @@ def run(stages: Sequence[Stage],
     failure propagates immediately (eager Python → strictly earlier than the
     reference's hold-and-drain, ``pipeline.py:239-247``, which existed only
     because of worker threads).
+
+    ``chaos`` (a :class:`~pipe_tpu.resilience.ChaosPlan`) injects
+    transport faults: after stage ``j`` produces micro-batch ``i``, a
+    planned ``transport_drop``/``transport_corrupt`` at ``(i, j)``
+    zeroes/NaN-poisons the hop before stage ``j+1`` consumes it —
+    deterministic, and absent from the program when no plan is given.
     """
     validate_mode(checkpoint)
     schedule = schedule or GPipeSchedule()
@@ -130,4 +160,8 @@ def run(stages: Sequence[Stage],
                 stages[j], params_per_stage[j], batches[i], ctx,
                 remat=i < stop, remat_policy=remat_policy,
                 skip_tracker=skip_tracker)
+            if chaos is not None and j < n - 1:
+                mode = chaos.transport_fault(i, j)
+                if mode is not None:
+                    batches[i] = _corrupt_hop(batches[i], mode)
     return batches

@@ -1,0 +1,45 @@
+"""Fault injection, anomaly detection, and recovery for train + serve.
+
+The north-star system serves heavy traffic; at that scale faults are
+weather, not news — a NaN step, a wedged serve tick, a torn checkpoint
+must cost *one step / one request*, not the job. Three layers, each
+usable alone:
+
+* :mod:`.chaos` — deterministic, seeded, step/tick-indexed fault
+  injection (:class:`ChaosPlan`): NaN/inf into grads or activations,
+  loss spikes, data-iterator raises, transport-hop drop/corrupt on the
+  emulator, serve-tick stalls, queue floods, backend raises. Drives
+  the recovery proofs in ``tools/chaos_bench.py`` (``CHAOS_r09.json``)
+  and the ``chaos``-marked tests.
+* :mod:`.detect` — cheap in-program detection: a fused
+  finiteness+loss-spike check on the train step (one extra global-norm
+  reduction, no host sync of its own — see :func:`detect.step_guard`)
+  and :class:`detect.TickWatchdog` for the serve tick (wall-clock
+  budget, stuck-slot ceiling, deadline-miss EWMA for overload
+  shedding).
+* :mod:`.recover` — policies: skip-step with optimizer-state rollback
+  happens *inside* the jitted step (a ``where``-select, zero
+  recompiles); :class:`recover.ResilienceController` adds host-side
+  bounded rewind-to-snapshot with exponential backoff;
+  :class:`recover.RetryingIterator` retries the data iterator.
+
+The whole subsystem is strictly opt-in and the opt-out is bitwise: with
+``TrainerConfig.resilience=None`` (the default) and no
+:class:`ChaosPlan`, every lowered program is byte-identical to the
+unwired build — pinned by ``tests/test_resilience.py``'s HLO equality
+tests. See ``docs/resilience.md`` for the fault model and the recovery
+state machine.
+"""
+
+from .chaos import ChaosError, ChaosPlan, Fault
+from .detect import TickWatchdog, step_guard
+from .recover import (DataIteratorFailed, ResilienceConfig,
+                      ResilienceController, RetryingIterator,
+                      TrainingAborted)
+
+__all__ = [
+    "ChaosError", "ChaosPlan", "Fault",
+    "TickWatchdog", "step_guard",
+    "DataIteratorFailed", "ResilienceConfig", "ResilienceController",
+    "RetryingIterator", "TrainingAborted",
+]

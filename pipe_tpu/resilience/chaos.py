@@ -1,0 +1,249 @@
+"""Deterministic fault injection: seeded, step/tick-indexed ChaosPlans.
+
+A :class:`ChaosPlan` is a static list of :class:`Fault`\\ s, each firing
+at a known step (train) or tick (serve) index for a known duration —
+the same discipline as the schedule tables: everything decided up
+front, nothing random at run time (the ``seed`` only derives payload
+*content*, e.g. flood prompts, never *whether* a fault fires). That
+determinism is what makes the recovery proofs in
+``tools/chaos_bench.py`` reproducible artifacts instead of flaky
+demos.
+
+Injection sites, by fault kind:
+
+==================  =======================================================
+``nan_grads``       gradients scaled by NaN inside the jitted train step
+``inf_grads``       gradients scaled by +inf inside the jitted train step
+``nan_loss``        the step loss replaced by NaN
+``loss_spike``      the step loss scaled by ``magnitude`` (default 1e3)
+``nan_activations`` the pre-stage activations scaled by NaN (rides the
+                    wrapped ``pre_fn``; corrupts loss AND grads the way
+                    a real numeric blowup does)
+``data_raise``      :class:`ChaosError` raised from the data iterator
+``transport_drop``  a stage-boundary hop zeroed in the emulator executor
+``transport_corrupt`` the same hop scaled by NaN instead
+``stall_tick``      the serve engine sleeps ``magnitude`` seconds in-tick
+``queue_flood``     the serve queue force-filled to capacity with junk
+``backend_raise``   :class:`ChaosError` raised at the next backend
+                    prefill (exercises the slot-error containment path)
+==================  =======================================================
+
+Train-step faults ride a *traced* ``inject`` code (one int32 scalar
+argument of the guarded step): the program is compiled once and the
+host flips the code at the fault step — zero recompiles across
+fault/no-fault steps, and a plan with no faults simply keeps the code
+at 0. The activation hook threads the traced code to the model's
+``pre_fn`` through a trace-time context (:func:`inject_scope` /
+:func:`current_inject`), set only while the guarded step is tracing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional, Sequence, Tuple
+
+__all__ = ["ChaosError", "Fault", "ChaosPlan",
+           "INJECT_NONE", "INJECT_NAN_GRADS", "INJECT_INF_GRADS",
+           "INJECT_NAN_LOSS", "INJECT_LOSS_SPIKE", "INJECT_NAN_ACT",
+           "inject_scope", "current_inject", "apply_train_faults",
+           "wrap_pre_fn"]
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (never raised by real code paths)."""
+
+
+TRAIN_KINDS = ("nan_grads", "inf_grads", "nan_loss", "loss_spike",
+               "nan_activations")
+DATA_KINDS = ("data_raise",)
+TRANSPORT_KINDS = ("transport_drop", "transport_corrupt")
+SERVE_KINDS = ("stall_tick", "queue_flood", "backend_raise")
+KINDS = TRAIN_KINDS + DATA_KINDS + TRANSPORT_KINDS + SERVE_KINDS
+
+# Traced inject codes (the int32 scalar argument of the guarded step).
+INJECT_NONE = 0
+INJECT_NAN_GRADS = 1
+INJECT_INF_GRADS = 2
+INJECT_NAN_LOSS = 3
+INJECT_LOSS_SPIKE = 4
+INJECT_NAN_ACT = 5
+_TRAIN_CODE = {"nan_grads": INJECT_NAN_GRADS,
+               "inf_grads": INJECT_INF_GRADS,
+               "nan_loss": INJECT_NAN_LOSS,
+               "loss_spike": INJECT_LOSS_SPIKE,
+               "nan_activations": INJECT_NAN_ACT}
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One planned fault: ``kind`` fires at ``step`` (train step or
+    serve tick index, 0-based) for ``count`` consecutive indices.
+    ``stage``/``microbatch`` address transport faults (the hop leaving
+    ``stage`` for micro-batch ``microbatch``); ``magnitude`` scales
+    ``loss_spike`` (factor) and ``stall_tick`` (seconds)."""
+
+    kind: str
+    step: int
+    count: int = 1
+    stage: int = 0
+    microbatch: int = 0
+    magnitude: float = 1e3
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"one of {KINDS}")
+        if self.step < 0 or self.count < 1:
+            raise ValueError(
+                f"fault needs step >= 0 and count >= 1, got "
+                f"step={self.step} count={self.count}")
+
+    def covers(self, index: int) -> bool:
+        return self.step <= index < self.step + self.count
+
+
+class ChaosPlan:
+    """A static, seeded fault schedule. Immutable after construction;
+    safe to share between a Trainer and a ServeEngine (train faults key
+    on step index, serve faults on tick index — disjoint kinds)."""
+
+    def __init__(self, faults: Sequence[Fault] = (), *, seed: int = 0):
+        self.faults: Tuple[Fault, ...] = tuple(faults)
+        self.seed = int(seed)
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def __repr__(self) -> str:
+        return f"ChaosPlan({list(self.faults)!r}, seed={self.seed})"
+
+    def active(self, kind: str, index: int) -> Optional[Fault]:
+        """The first ``kind`` fault covering ``index`` (or None)."""
+        for f in self.faults:
+            if f.kind == kind and f.covers(index):
+                return f
+        return None
+
+    # -- train step ---------------------------------------------------------
+
+    def train_inject(self, step: int) -> Tuple[int, float]:
+        """(inject code, magnitude) for the guarded train step at
+        ``step`` — (0, 1.0) when no train fault covers it."""
+        for f in self.faults:
+            if f.kind in _TRAIN_CODE and f.covers(step):
+                return _TRAIN_CODE[f.kind], float(f.magnitude)
+        return INJECT_NONE, 1.0
+
+    def last_train_fault_step(self) -> int:
+        """Last step index any train-visible fault covers (-1 if none) —
+        chaos_bench uses it to define steps-to-recover."""
+        last = -1
+        for f in self.faults:
+            if f.kind in _TRAIN_CODE:
+                last = max(last, f.step + f.count - 1)
+        return last
+
+    # -- data iterator ------------------------------------------------------
+
+    def maybe_raise_data(self, index: int) -> None:
+        f = self.active("data_raise", index)
+        if f is not None:
+            raise ChaosError(
+                f"injected data-iterator fault at batch {index} "
+                f"(plan seed {self.seed})")
+
+    # -- emulator transport -------------------------------------------------
+
+    def transport_fault(self, microbatch: int, stage: int) -> Optional[str]:
+        """'drop' | 'corrupt' | None for the hop leaving ``stage`` with
+        micro-batch ``microbatch`` (emulator executor only)."""
+        for f in self.faults:
+            if f.kind in TRANSPORT_KINDS and f.stage == stage \
+                    and f.microbatch == microbatch:
+                return "drop" if f.kind == "transport_drop" else "corrupt"
+        return None
+
+    # -- serve tick ---------------------------------------------------------
+
+    def serve_fault(self, kind: str, tick: int) -> Optional[Fault]:
+        if kind not in SERVE_KINDS:
+            raise ValueError(f"{kind!r} is not a serve fault kind")
+        return self.active(kind, tick)
+
+    def flood_prompt(self, i: int) -> list:
+        """Deterministic junk prompt ``i`` for queue_flood (content from
+        the plan seed, so floods are reproducible)."""
+        import numpy as np
+        rng = np.random.RandomState(self.seed * 1_000_003 + i)
+        return [int(t) for t in rng.randint(1, 32, size=4)]
+
+
+# ---------------------------------------------------------------------------
+# Traced-injection plumbing (train step)
+# ---------------------------------------------------------------------------
+
+_trace_local = threading.local()
+
+
+class inject_scope:
+    """Context manager installing the traced inject code for the
+    duration of one guarded-step trace, so wrapped model fns
+    (:func:`wrap_pre_fn`) can read it. ``code=None`` installs nothing
+    (the wrapped fns then compile to the identity)."""
+
+    def __init__(self, code):
+        self.code = code
+
+    def __enter__(self):
+        self._prev = getattr(_trace_local, "code", None)
+        _trace_local.code = self.code
+        return self
+
+    def __exit__(self, *exc):
+        _trace_local.code = self._prev
+
+
+def current_inject():
+    """The traced inject code installed by :class:`inject_scope`, or
+    None outside any scope (including every non-resilient trace)."""
+    return getattr(_trace_local, "code", None)
+
+
+def apply_train_faults(inject, magnitude, loss, grads):
+    """Apply the grad/loss fault selected by the traced ``inject`` code.
+    One scalar select + one broadcast multiply per tree — the program
+    is identical whichever code the host passes at run time."""
+    import jax
+    import jax.numpy as jnp
+
+    gscale = jnp.where(
+        inject == INJECT_NAN_GRADS, jnp.float32(jnp.nan),
+        jnp.where(inject == INJECT_INF_GRADS, jnp.float32(jnp.inf),
+                  jnp.float32(1.0)))
+    grads = jax.tree_util.tree_map(
+        lambda g: g * gscale.astype(g.dtype), grads)
+    lscale = jnp.where(
+        inject == INJECT_NAN_LOSS, jnp.float32(jnp.nan),
+        jnp.where(inject == INJECT_LOSS_SPIKE,
+                  jnp.float32(magnitude), jnp.float32(1.0)))
+    loss = loss * lscale.astype(loss.dtype)
+    return loss, grads
+
+
+def wrap_pre_fn(pre_fn):
+    """Wrap a model ``pre_fn`` so INJECT_NAN_ACT poisons the activations
+    it emits. Outside an :class:`inject_scope` (every non-chaos trace)
+    the wrapper is a transparent pass-through — no program change."""
+    import jax.numpy as jnp
+
+    def chaos_pre_fn(prep, x, ctx):
+        h = pre_fn(prep, x, ctx)
+        code = current_inject()
+        if code is None:
+            return h
+        scale = jnp.where(code == INJECT_NAN_ACT, jnp.float32(jnp.nan),
+                          jnp.float32(1.0))
+        return h * scale.astype(h.dtype)
+
+    return chaos_pre_fn

@@ -1,0 +1,102 @@
+"""Anomaly detection: in-program train-step guard + serve-tick watchdog.
+
+Train side — :func:`step_guard` is traced INTO the guarded train step:
+one ``global_norm`` reduction (NaN/inf in any gradient leaf propagates
+into it) fused with a loss-spike test against an EWMA carried in the
+device-side aux state. No host sync of its own: the verdict rides the
+step outputs the loop already holds, and the host reads it on its own
+cadence (``ResilienceConfig.check_every``). Skip-step then happens
+inside the same program (``where``-select in ``train/loop.py``), so an
+isolated NaN step costs one wasted micro-batch of work, never a
+poisoned optimizer state.
+
+Serve side — :class:`TickWatchdog` is pure host bookkeeping for the
+engine tick: a wall-clock budget per tick (a stalled backend shows up
+as ``resilience.watchdog_slow_ticks`` instead of silent lag), a
+stuck-slot ceiling (a slot alive far past the ticks its token budget
+can need is retired ``status="error"`` rather than squatting forever),
+and the deadline-miss EWMA that arms the degraded mode (shed
+lowest-priority queued work — see ``serve/engine.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+__all__ = ["step_guard", "TickWatchdog"]
+
+
+def step_guard(loss, grads, ewma, step, *, spike_factor: float,
+               warmup_steps: int, ewma_alpha: float):
+    """Fused finiteness + loss-spike check, traced into the train step.
+
+    Returns ``(ok, new_ewma)``: ``ok`` is False when the loss or any
+    gradient is non-finite, or (past warmup) the loss exceeds
+    ``spike_factor`` x the EWMA of accepted losses. The EWMA folds only
+    accepted steps — a rejected spike must not drag the baseline toward
+    itself and mask a follow-up.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    loss32 = loss.astype(jnp.float32)
+    gnorm = optax.global_norm(grads)
+    finite = jnp.isfinite(loss32) & jnp.isfinite(gnorm)
+    warmed = step >= warmup_steps
+    # non-finite loss fails `finite` already; guard the comparison so a
+    # NaN loss cannot sneak past via compare-False semantics
+    spike = warmed & finite & (loss32 > ewma * spike_factor)
+    ok = finite & ~spike
+    seeded = ewma > 0.0
+    new_ewma = jnp.where(
+        ok,
+        jnp.where(seeded, ewma_alpha * loss32 + (1.0 - ewma_alpha) * ewma,
+                  loss32),
+        ewma)
+    return ok, new_ewma
+
+
+@dataclasses.dataclass
+class TickWatchdog:
+    """Serve-tick health policy (host-side; no device program change).
+
+    ``tick_budget_s`` — a tick slower than this is counted and evented
+    (``resilience.watchdog_slow_ticks``); None disables.
+    ``stuck_slack_ticks`` — a live slot is declared stuck (and retired
+    ``status="error"``) once its age exceeds the ticks its token budget
+    can possibly need (``ceil(max_new / decode_chunk)``) plus this
+    slack; None disables.
+    ``shed_ewma_threshold`` — deadline-miss EWMA (per retirement,
+    ``shed_ewma_alpha`` horizon) above which the engine enters degraded
+    mode and sheds lowest-priority queued requests; None disables.
+    """
+
+    tick_budget_s: Optional[float] = None
+    stuck_slack_ticks: Optional[int] = 8
+    shed_ewma_threshold: Optional[float] = None
+    shed_ewma_alpha: float = 0.1
+
+    def __post_init__(self):
+        if self.tick_budget_s is not None and self.tick_budget_s <= 0:
+            raise ValueError(
+                f"tick_budget_s must be > 0, got {self.tick_budget_s}")
+        if self.stuck_slack_ticks is not None and self.stuck_slack_ticks < 1:
+            raise ValueError(
+                f"stuck_slack_ticks must be >= 1, got "
+                f"{self.stuck_slack_ticks}")
+        if self.shed_ewma_threshold is not None and \
+                not 0.0 < self.shed_ewma_threshold <= 1.0:
+            raise ValueError(
+                f"shed_ewma_threshold must be in (0, 1], got "
+                f"{self.shed_ewma_threshold}")
+
+    def stuck_after(self, max_new_tokens: int, decode_chunk: int) -> \
+            Optional[int]:
+        """Tick-age ceiling for a slot with this token budget (None when
+        stuck detection is disabled)."""
+        if self.stuck_slack_ticks is None:
+            return None
+        need = math.ceil(max_new_tokens / max(decode_chunk, 1))
+        return need + self.stuck_slack_ticks

@@ -15,10 +15,10 @@ serving") and ``apps/serve.py`` for the driver.
 """
 
 from .buckets import BucketSpec
-from .engine import ServeEngine, SingleDeviceSlotBackend
+from .engine import EngineDraining, ServeEngine, SingleDeviceSlotBackend
 from .queue import QueueFull, Request, RequestQueue, Response
 from .ring import RingSlotBackend
 
 __all__ = ["BucketSpec", "ServeEngine", "SingleDeviceSlotBackend",
            "RingSlotBackend", "QueueFull", "Request", "RequestQueue",
-           "Response"]
+           "Response", "EngineDraining"]

@@ -56,18 +56,26 @@ from ..obs.telemetry import get_registry
 from .buckets import BucketSpec
 from .queue import QueueFull, Request, RequestQueue, Response
 
-__all__ = ["SingleDeviceSlotBackend", "ServeEngine"]
+__all__ = ["SingleDeviceSlotBackend", "ServeEngine", "EngineDraining"]
+
+
+class EngineDraining(RuntimeError):
+    """Raised by ``submit`` after :meth:`ServeEngine.drain`: the engine
+    is finishing its live slots and admits nothing new (the graceful-
+    shutdown signal — see ``apps/serve.py``'s SIGTERM handler)."""
 
 
 class _Slot:
     """Host-side state of one running request."""
 
-    __slots__ = ("req", "tokens", "ttft")
+    __slots__ = ("req", "tokens", "ttft", "admitted_tick")
 
-    def __init__(self, req: Request, first_token: int, ttft: float):
+    def __init__(self, req: Request, first_token: int, ttft: float,
+                 admitted_tick: int = 0):
         self.req = req
         self.tokens: List[int] = [first_token]
         self.ttft = ttft
+        self.admitted_tick = admitted_tick
 
 
 class SingleDeviceSlotBackend:
@@ -321,23 +329,47 @@ class ServeEngine:
     thread). ``queue`` defaults to a fresh bounded
     :class:`~.queue.RequestQueue`; pass your own to share a front door
     or to inject a test clock.
+
+    ``watchdog`` (a :class:`~..resilience.TickWatchdog`) arms the
+    host-side health policies — slow-tick accounting, stuck-slot
+    retirement, degraded-mode shedding; None (default) changes nothing.
+    ``chaos`` (a :class:`~..resilience.ChaosPlan`) injects serve-side
+    faults by tick index for the chaos bench/tests. A backend exception
+    is contained, never fatal: a failed prefill retires only the
+    offending request (``status="error"``, the slot goes back to the
+    free list, ``resilience.slot_errors`` counts it); a failed decode
+    skips the tick with all slot state intact, and only after
+    ``decode_error_limit`` consecutive failures are the live slots
+    retired as errors (batched decode cannot attribute the fault to one
+    slot).
     """
 
     def __init__(self, backend, queue: Optional[RequestQueue] = None,
                  *, event_log=None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 watchdog=None, chaos=None, decode_error_limit: int = 3):
         self.backend = backend
         if queue is None:
             queue = RequestQueue(clock=clock or time.monotonic)
         elif clock is not None and clock is not queue.clock:
             raise ValueError(
                 "pass the clock on the queue (engine adopts queue.clock)")
+        if decode_error_limit < 1:
+            raise ValueError(
+                f"decode_error_limit must be >= 1, got {decode_error_limit}")
         self.queue = queue
         self.clock = queue.clock
         self.events = event_log if event_log is not None else NULL_EVENT_LOG
+        self.watchdog = watchdog
+        self.chaos = chaos
+        self.decode_error_limit = decode_error_limit
         self._slots: List[Optional[_Slot]] = [None] * backend.num_slots
         self._free = list(range(backend.num_slots - 1, -1, -1))
         self._responses = {}
+        self._tick_index = 0
+        self._decode_errors = 0
+        self._miss_ewma = 0.0
+        self._draining = False
 
     # -- front door --------------------------------------------------------
 
@@ -349,6 +381,10 @@ class ServeEngine:
         request (too long for the buckets/cache/positions) and
         :class:`~.queue.QueueFull` under backpressure."""
         reg = get_registry()
+        if self._draining:
+            raise EngineDraining(
+                "engine is draining: live requests are finishing and no "
+                "new work is admitted")
         if max_new_tokens is None:
             max_new_tokens = self.backend.gen.max_new_tokens
         self.backend.validate(len(prompt), max_new_tokens)
@@ -377,6 +413,27 @@ class ServeEngine:
     def idle(self) -> bool:
         return self.live_slots == 0 and self.queue.depth == 0
 
+    # -- graceful drain ------------------------------------------------------
+
+    def drain(self) -> None:
+        """Enter graceful shutdown: ``submit`` starts raising
+        :class:`EngineDraining`, the next tick sheds everything still
+        queued (``status="shed"``, ``finish_reason="drain"``), and live
+        slots run to completion. Idempotent."""
+        if not self._draining:
+            self._draining = True
+            self.events.event("resilience", action="drain",
+                              live=self.live_slots, queued=self.queue.depth)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """True once a drain finished: nothing queued, nothing live."""
+        return self._draining and self.idle
+
     # -- retirement --------------------------------------------------------
 
     def _record(self, resp: Response, bucket: Optional[int]) -> None:
@@ -388,6 +445,19 @@ class ServeEngine:
             reg.counter("serve.engine.timed_out").inc()
         elif resp.status == "cancelled":
             reg.counter("serve.engine.cancelled").inc()
+        elif resp.status == "error":
+            reg.counter("serve.engine.errors").inc()
+        elif resp.status == "shed":
+            reg.counter("serve.engine.shed").inc()
+        wd = self.watchdog
+        if wd is not None and wd.shed_ewma_threshold is not None \
+                and resp.status in ("ok", "timeout"):
+            # only served outcomes move the deadline-miss EWMA: shedding
+            # is the *response* to misses and must not latch degraded mode
+            miss = 1.0 if resp.status == "timeout" else 0.0
+            a = wd.shed_ewma_alpha
+            self._miss_ewma = a * miss + (1.0 - a) * self._miss_ewma
+            reg.gauge("resilience.deadline_miss_ewma").set(self._miss_ewma)
         self.events.event(
             REQUEST, request=resp.request_id, status=resp.status,
             finish_reason=resp.finish_reason, prompt_len=resp.prompt_len,
@@ -399,6 +469,32 @@ class ServeEngine:
         status = "cancelled" if reason == "cancelled" else "timeout"
         resp = Response(request_id=req.id, tokens=[], status=status,
                         finish_reason=reason, prompt_len=len(req.prompt),
+                        ttft=None, latency=now - req.submitted_at)
+        self._record(resp, None)
+        return resp
+
+    def _shed_queued(self, req: Request, reason: str,
+                     now: float) -> Response:
+        """Queued request pushed back out unserved (degraded-mode
+        shedding or drain): ``status="shed"``."""
+        resp = Response(request_id=req.id, tokens=[], status="shed",
+                        finish_reason=reason, prompt_len=len(req.prompt),
+                        ttft=None, latency=now - req.submitted_at)
+        self._record(resp, None)
+        return resp
+
+    def _fail_queued(self, req: Request, exc: Exception,
+                     now: float) -> Response:
+        """Admission failed in the backend (prefill raised): the request
+        dies ``status="error"`` — the slot was returned to the free list
+        and every other request keeps serving."""
+        get_registry().counter("resilience.slot_errors").inc()
+        self.events.event("resilience", action="slot_error",
+                          request=req.id, where="prefill",
+                          error=type(exc).__name__)
+        resp = Response(request_id=req.id, tokens=[], status="error",
+                        finish_reason="backend_error",
+                        prompt_len=len(req.prompt),
                         ttft=None, latency=now - req.submitted_at)
         self._record(resp, None)
         return resp
@@ -421,13 +517,25 @@ class ServeEngine:
     # -- the tick ----------------------------------------------------------
 
     def tick(self) -> List[Response]:
-        """One scheduler step: sweep deadlines/cancellations, admit into
-        free slots, run one decode chunk, retire. Returns the requests
-        that reached a terminal state during this tick."""
+        """One scheduler step: sweep deadlines/cancellations, apply the
+        watchdog policies, admit into free slots, run one decode chunk,
+        retire. Returns the requests that reached a terminal state
+        during this tick."""
         reg = get_registry()
-        now = self.clock()
+        tick_idx = self._tick_index
+        self._tick_index += 1
+        if self.chaos is not None:
+            self._apply_chaos(reg, tick_idx)
+        t_start = self.clock()
+        now = t_start
         finished: List[Response] = []
         eos = self.backend.gen.eos_token_id
+        wd = self.watchdog
+
+        # 0) drain — everything still queued goes back to its caller
+        if self._draining and self.queue.depth:
+            for req in self.queue.shed_lowest(self.queue.depth):
+                finished.append(self._shed_queued(req, "drain", now))
 
         # 1) deaths — queued first (never cost a slot), then running
         for req, reason in self.queue.reap(now):
@@ -443,13 +551,56 @@ class ServeEngine:
                 finished.append(
                     self._retire(slot, "timeout", "deadline", now))
 
-        # 2) admissions — prefill straight into the freed slots
-        while self._free and self.queue.depth:
+        # 1b) stuck slots — alive far past the ticks their token budget
+        # can possibly need; retire as errors instead of squatting
+        if wd is not None and wd.stuck_slack_ticks is not None:
+            chunk = getattr(self.backend, "decode_chunk", 1)
+            for slot in range(self.backend.num_slots):
+                st = self._slots[slot]
+                if st is None:
+                    continue
+                limit = wd.stuck_after(st.req.max_new_tokens, chunk)
+                if tick_idx - st.admitted_tick >= limit:
+                    reg.counter("resilience.stuck_slots").inc()
+                    self.events.event("resilience", action="stuck_slot",
+                                      request=st.req.id, slot=slot,
+                                      age_ticks=tick_idx - st.admitted_tick)
+                    finished.append(self._retire(slot, "error", "stuck", now))
+
+        # 1c) degraded mode — shed lowest-priority queued work while the
+        # deadline-miss EWMA sits above the threshold
+        if wd is not None and wd.shed_ewma_threshold is not None \
+                and not self._draining \
+                and self._miss_ewma > wd.shed_ewma_threshold \
+                and self.queue.depth:
+            n = max(1, self.queue.depth // 2)
+            reg.counter("resilience.shed").inc(n)
+            self.events.event("resilience", action="shed", count=n,
+                              miss_ewma=self._miss_ewma,
+                              queued=self.queue.depth)
+            for req in self.queue.shed_lowest(n):
+                finished.append(self._shed_queued(req, "shed", now))
+
+        # 2) admissions — prefill straight into the freed slots; a
+        # backend failure here is attributable to ONE request: fail it,
+        # free the slot, keep admitting
+        while self._free and self.queue.depth and not self._draining:
             req = self.queue.pop()
             slot = self._free.pop()
-            tok0 = self.backend.prefill(slot, req.prompt, req.seed)
+            try:
+                if self.chaos is not None and self.chaos.serve_fault(
+                        "backend_raise", tick_idx) is not None:
+                    from ..resilience.chaos import ChaosError
+                    raise ChaosError(
+                        f"injected backend fault at tick {tick_idx}")
+                tok0 = self.backend.prefill(slot, req.prompt, req.seed)
+            except Exception as e:           # noqa: BLE001 — containment
+                self._free.append(slot)
+                finished.append(self._fail_queued(req, e, self.clock()))
+                continue
             t_first = self.clock()
-            st = _Slot(req, tok0, ttft=t_first - req.submitted_at)
+            st = _Slot(req, tok0, ttft=t_first - req.submitted_at,
+                       admitted_tick=tick_idx)
             self._slots[slot] = st
             reg.counter("serve.engine.admitted").inc()
             reg.histogram("serve.engine.ttft_sec").observe(st.ttft)
@@ -458,40 +609,88 @@ class ServeEngine:
             elif req.max_new_tokens == 1:
                 finished.append(self._retire(slot, "ok", "length", t_first))
 
-        # 3) decode — one fixed-shape chunk for every slot
+        # 3) decode — one fixed-shape chunk for every slot. A failure is
+        # NOT attributable (all slots share the program): skip the tick
+        # with slot state intact, and only a run of consecutive failures
+        # retires the live set.
         live = np.array([s is not None for s in self._slots])
         if live.any():
             t0 = self.clock()
-            toks, valid = self.backend.decode(live)
-            t1 = self.clock()
-            emitted = 0
-            for slot in range(self.backend.num_slots):
-                st = self._slots[slot]
-                if st is None:
-                    continue
-                for k in range(toks.shape[1]):
-                    if not valid[slot, k]:
+            try:
+                toks, valid = self.backend.decode(live)
+            except Exception as e:           # noqa: BLE001 — containment
+                self._on_decode_error(reg, e, tick_idx, finished)
+            else:
+                self._decode_errors = 0
+                t1 = self.clock()
+                emitted = 0
+                for slot in range(self.backend.num_slots):
+                    st = self._slots[slot]
+                    if st is None:
                         continue
-                    t = int(toks[slot, k])
-                    st.tokens.append(t)
-                    emitted += 1
-                    if eos is not None and t == eos:
-                        finished.append(
-                            self._retire(slot, "ok", "eos", t1))
-                        break
-                    if len(st.tokens) >= st.req.max_new_tokens:
-                        finished.append(
-                            self._retire(slot, "ok", "length", t1))
-                        break
-            if emitted:
-                reg.counter("serve.engine.tokens").inc(emitted)
-                reg.histogram("serve.engine.token_sec").observe(
-                    (t1 - t0) / emitted)
+                    for k in range(toks.shape[1]):
+                        if not valid[slot, k]:
+                            continue
+                        t = int(toks[slot, k])
+                        st.tokens.append(t)
+                        emitted += 1
+                        if eos is not None and t == eos:
+                            finished.append(
+                                self._retire(slot, "ok", "eos", t1))
+                            break
+                        if len(st.tokens) >= st.req.max_new_tokens:
+                            finished.append(
+                                self._retire(slot, "ok", "length", t1))
+                            break
+                if emitted:
+                    reg.counter("serve.engine.tokens").inc(emitted)
+                    reg.histogram("serve.engine.token_sec").observe(
+                        (t1 - t0) / emitted)
 
         reg.gauge("serve.engine.queue_depth").set(self.queue.depth)
         reg.gauge("serve.engine.slot_occupancy").set(
             self.live_slots / self.backend.num_slots)
+        dur = self.clock() - t_start
+        reg.gauge("resilience.tick_sec").set(dur)
+        if wd is not None and wd.tick_budget_s is not None \
+                and dur > wd.tick_budget_s:
+            reg.counter("resilience.watchdog_slow_ticks").inc()
+            self.events.event("resilience", action="slow_tick",
+                              tick=tick_idx, duration_s=dur,
+                              budget_s=wd.tick_budget_s)
         return finished
+
+    def _apply_chaos(self, reg, tick_idx: int) -> None:
+        """Serve-side fault injection (chaos plan only; no-op in real
+        deployments). ``backend_raise`` is handled at the prefill site."""
+        f = self.chaos.serve_fault("stall_tick", tick_idx)
+        if f is not None:
+            reg.counter("resilience.chaos_stalls").inc()
+            time.sleep(f.magnitude)
+        if self.chaos.serve_fault("queue_flood", tick_idx) is not None:
+            i = 0
+            while self.queue.depth < self.queue.capacity:
+                self.queue.submit(self.chaos.flood_prompt(i),
+                                  max_new_tokens=1, priority=-(10 ** 6))
+                i += 1
+            reg.counter("resilience.chaos_floods").inc()
+
+    def _on_decode_error(self, reg, exc: Exception, tick_idx: int,
+                         finished: List[Response]) -> None:
+        self._decode_errors += 1
+        reg.counter("resilience.decode_errors").inc()
+        self.events.event("resilience", action="decode_error",
+                          tick=tick_idx, consecutive=self._decode_errors,
+                          error=type(exc).__name__)
+        if self._decode_errors < self.decode_error_limit:
+            return                           # skip the tick; state intact
+        now = self.clock()
+        for slot in range(self.backend.num_slots):
+            if self._slots[slot] is not None:
+                reg.counter("resilience.slot_errors").inc()
+                finished.append(
+                    self._retire(slot, "error", "backend_error", now))
+        self._decode_errors = 0
 
     # -- convenience loops -------------------------------------------------
 

@@ -36,7 +36,17 @@ __all__ = ["QueueFull", "Request", "Response", "RequestQueue"]
 
 class QueueFull(RuntimeError):
     """Raised by ``submit`` when the admission queue is at capacity —
-    the backpressure signal. Retry later or shed the request."""
+    the backpressure signal. Retry later or shed the request. Carries
+    ``depth``/``capacity``/``oldest_age_s`` so callers can tune their
+    backoff (a deep queue whose head is old means the service is
+    wedged, not merely busy)."""
+
+    def __init__(self, message: str, *, depth: int = 0, capacity: int = 0,
+                 oldest_age_s: Optional[float] = None):
+        super().__init__(message)
+        self.depth = depth
+        self.capacity = capacity
+        self.oldest_age_s = oldest_age_s
 
 
 @dataclasses.dataclass
@@ -60,8 +70,11 @@ class Request:
 @dataclasses.dataclass
 class Response:
     """Terminal record for one request. ``status``: ``ok`` | ``timeout``
-    | ``cancelled``. ``finish_reason``: ``eos`` | ``length`` |
-    ``deadline`` | ``cancelled``. ``tokens`` holds whatever was generated
+    | ``cancelled`` | ``error`` (backend failure or stuck slot) |
+    ``shed`` (pushed back unserved — degraded mode or drain).
+    ``finish_reason``: ``eos`` | ``length`` | ``deadline`` |
+    ``cancelled`` | ``backend_error`` | ``stuck`` | ``shed`` | ``drain``.
+    ``tokens`` holds whatever was generated
     before the request finished (possibly empty when it never reached a
     slot). ``ttft`` is first-token latency (None when no token was
     produced); ``latency`` is submit-to-retire."""
@@ -104,9 +117,15 @@ class RequestQueue:
         """Enqueue or raise :class:`QueueFull`. Returns the live
         :class:`Request` (its ``id`` is the handle for ``cancel``)."""
         if len(self._waiting) >= self.capacity:
+            age = self.oldest_age()
             raise QueueFull(
-                f"admission queue at capacity ({self.capacity}); "
-                f"retry with backoff or raise capacity")
+                f"admission queue at capacity (depth "
+                f"{len(self._waiting)}/{self.capacity}; oldest queued "
+                f"request has waited "
+                f"{'n/a' if age is None else f'{age:.3f}s'}); retry "
+                f"with backoff or raise capacity",
+                depth=len(self._waiting), capacity=self.capacity,
+                oldest_age_s=age)
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -151,6 +170,31 @@ class RequestQueue:
                 alive.append(req)
         self._waiting = alive
         return dead
+
+    def oldest_age(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds the longest-waiting queued request has waited (None
+        when empty)."""
+        if not self._waiting:
+            return None
+        if now is None:
+            now = self.clock()
+        return now - min(r.submitted_at for r in self._waiting)
+
+    def shed_lowest(self, n: int) -> List[Request]:
+        """Degraded-mode load shedding: remove and return up to ``n``
+        queued requests, lowest ``priority`` first (ties: youngest
+        first — the oldest of a priority level has waited longest and
+        keeps its place). Used by the engine when the deadline-miss
+        EWMA crosses its threshold and during drain."""
+        if n < 1 or not self._waiting:
+            return []
+        order = sorted(range(len(self._waiting)),
+                       key=lambda i: (self._waiting[i].priority, -i))
+        drop = set(order[:n])
+        shed = [self._waiting[i] for i in sorted(drop)]
+        self._waiting = [r for i, r in enumerate(self._waiting)
+                         if i not in drop]
+        return shed
 
     def pop(self) -> Optional[Request]:
         """Next request to admit (None when empty). Priority policy pops

@@ -88,22 +88,42 @@ class TrainerConfig:
     # steps into telemetry_dir/trace_step{N} (0 disables). Feed captures to
     # tools/timeline_report.py for per-stage busy/idle attribution.
     profile_every: int = 0
+    # Anomaly detection + recovery (docs/resilience.md): a
+    # resilience.ResilienceConfig arms the guarded train step (in-jit
+    # finiteness/loss-spike check, where-select skip-step), the bounded
+    # rewind controller and data-iterator retry. None — the default —
+    # keeps the train step program byte-identical to the unguarded build
+    # (pinned in tests/test_resilience.py).
+    resilience: Optional[Any] = None
 
 
 class Trainer:
     """Builds the mesh, model, optimizer and the jitted step; runs epochs."""
 
     def __init__(self, model_cfg: LMConfig, cfg: TrainerConfig,
-                 devices: Optional[List[jax.Device]] = None):
+                 devices: Optional[List[jax.Device]] = None,
+                 chaos=None):
         self.model_cfg = model_cfg
         self.cfg = cfg
+        # Fault injection (resilience.ChaosPlan): the activation hook
+        # wraps the model's pre_fn ONLY when a plan is supplied, so the
+        # default build traces the exact original functions.
+        self.chaos = chaos
+
+        def _mk_model(n_stages: int) -> PipelinedLM:
+            m = PipelinedLM(model_cfg, n_stages)
+            if chaos is not None:
+                from ..resilience.chaos import wrap_pre_fn
+                m.pre_fn = wrap_pre_fn(m.pre_fn)
+            return m
+
         self.mesh = make_mesh(cfg.n_stages, cfg.n_data, devices=devices)
         if cfg.schedule == "interleaved":
             # n_stages devices, each hosting `interleave` virtual stages:
             # the model factors into n_stages*interleave stage bodies.
             from ..parallel.interleaved import InterleavedSpmdPipeline
             self.n_virtual = cfg.n_stages * cfg.interleave
-            self.model = PipelinedLM(model_cfg, self.n_virtual)
+            self.model = _mk_model(self.n_virtual)
             self.pipe = InterleavedSpmdPipeline(
                 self.mesh, self.model.stage_fn, v=cfg.interleave,
                 pre_fn=self.model.pre_fn, post_fn=self.model.loss_post_fn,
@@ -141,14 +161,14 @@ class Trainer:
                         f"preferring it over '1f1b'.", stacklevel=2)
                 sched = cfg.schedule
                 self.n_virtual = cfg.n_stages
-            self.model = PipelinedLM(model_cfg, self.n_virtual)
+            self.model = _mk_model(self.n_virtual)
             self.pipe = ScheduledPipeline(
                 self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
                 post_fn=self.model.loss_post_fn, checkpoint=cfg.checkpoint,
                 schedule=sched)
         elif cfg.schedule == "gpipe":
             self.n_virtual = cfg.n_stages
-            self.model = PipelinedLM(model_cfg, cfg.n_stages)
+            self.model = _mk_model(cfg.n_stages)
             self.pipe = SpmdPipeline(
                 self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
                 post_fn=self.model.loss_post_fn, post_with_batch=True,
@@ -193,7 +213,11 @@ class Trainer:
         # placed params). The jitted step traces on first call, after that.
         self._zero_shardings = None
         self._param_shardings = None
-        self._step_fn = jax.jit(self._train_step, donate_argnums=(0,))
+        if cfg.resilience is not None:
+            self._step_fn = jax.jit(self._train_step_guarded,
+                                    donate_argnums=(0,))
+        else:
+            self._step_fn = jax.jit(self._train_step, donate_argnums=(0,))
         self._eval_fn = jax.jit(self._eval_loss)
         if cfg.tb_dir is not None:
             from ..obs.tb_writer import ScalarWriter
@@ -374,7 +398,12 @@ class Trainer:
         per_row = pipe(sp, prep, postp, x, key=key, train=train)
         return jnp.sum(per_row * w) / jnp.sum(w)
 
-    def _train_step(self, state: TrainState, x, w, key, lr):
+    def _compute_update(self, state: TrainState, x, w, key, lr,
+                        inject=None, magnitude=None):
+        """Shared step body: loss+grads, optional fault injection,
+        optimizer update. Returns ``(params, opt_state, loss, grads)``;
+        with ``inject=None`` (the unguarded step) it traces the exact
+        pre-resilience program."""
         if self._scheduled:
             sp, prep, postp = state.params
             loss, grads = self.pipe.loss_and_grad(sp, prep, postp, x, w,
@@ -382,6 +411,9 @@ class Trainer:
         else:
             loss, grads = jax.value_and_grad(self._loss)(
                 state.params, x, w, key, True)
+        if inject is not None:
+            from ..resilience.chaos import apply_train_faults
+            loss, grads = apply_train_faults(inject, magnitude, loss, grads)
         updates, opt_state = self.tx.update(grads, state.opt_state,
                                             state.params)
         updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
@@ -402,8 +434,47 @@ class Trainer:
             params = jax.tree_util.tree_map(
                 lambda a, s: jax.lax.with_sharding_constraint(a, s),
                 params, self._param_shardings)
+        return params, opt_state, loss, grads
+
+    def _train_step(self, state: TrainState, x, w, key, lr):
+        params, opt_state, loss, _ = self._compute_update(state, x, w,
+                                                          key, lr)
         return TrainState(params=params, opt_state=opt_state,
                           step=state.step + 1), loss
+
+    def _train_step_guarded(self, state: TrainState, aux, x, w, key, lr,
+                            inject, magnitude):
+        """The resilient step: same update as :meth:`_train_step` plus
+        (a) chaos injection selected by the traced ``inject`` code and
+        (b) the fused anomaly check whose verdict ``where``-selects the
+        pre-step params/opt_state back in on a bad step (skip-step — the
+        step counter still advances, so the LR/PRNG walk is unaffected).
+        ``aux`` carries ``(loss EWMA, consecutive anomalies, total
+        anomalies)`` on device; the host reads it on its own cadence
+        (``ResilienceConfig.check_every``) — no extra sync here."""
+        from ..resilience.chaos import inject_scope
+        from ..resilience.detect import step_guard
+
+        rc = self.cfg.resilience
+        ewma, consec, total = aux
+        with inject_scope(inject):
+            params, opt_state, loss, grads = self._compute_update(
+                state, x, w, key, lr, inject=inject, magnitude=magnitude)
+        ok, new_ewma = step_guard(
+            loss, grads, ewma, state.step, spike_factor=rc.spike_factor,
+            warmup_steps=rc.warmup_steps, ewma_alpha=rc.ewma_alpha)
+
+        def select(new, old):
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), new, old)
+
+        params = select(params, state.params)
+        opt_state = select(opt_state, state.opt_state)
+        bad = (~ok).astype(jnp.int32)
+        new_aux = (new_ewma, jnp.where(ok, jnp.int32(0), consec + 1),
+                   total + bad)
+        return TrainState(params=params, opt_state=opt_state,
+                          step=state.step + 1), loss, new_aux
 
     def _eval_loss(self, params, x, w):
         return self._loss(params, x, w, make_key(0), False)
@@ -416,8 +487,8 @@ class Trainer:
         stacked, n_rows = mb.stack_scatter(x, self.cfg.chunks)
         return stacked, mb.valid_row_mask(stacked, n_rows)
 
-    def _batches(self, source: np.ndarray, n: int):
-        """Yield up to ``n`` full (data, target) batches.
+    def _batches(self, source: np.ndarray, n: int, start: int = 0):
+        """Yield full (data, target) batches ``start`` .. ``n``-1.
 
         With ``prefetch_depth > 0`` (and a toolchain), assembly runs on the
         native producer thread; the yielded slot views are copied before
@@ -427,6 +498,10 @@ class Trainer:
         the hot loop.
         Otherwise: inline ``get_batch`` (the reference's walk), stopping at
         the first short tail batch to keep shapes static.
+
+        ``start`` skips the first batches — the resume hook for
+        :class:`~..resilience.RetryingIterator`, which rebuilds a failed
+        iterator at its position.
         """
         cfg = self.cfg
         if cfg.prefetch_depth > 0:
@@ -437,9 +512,11 @@ class Trainer:
                     for i, (d, t) in enumerate(pf):
                         if i >= n:
                             break
+                        if i < start:
+                            continue
                         yield d.copy(), t.copy()
                 return
-        for b in range(n):
+        for b in range(start, n):
             data, target = lm_text.get_batch(source, b * cfg.bptt, cfg.bptt)
             if data.shape[1] < cfg.bptt:  # tail batch: keep shapes static
                 return
@@ -472,10 +549,30 @@ class Trainer:
         tps_gauge = self.registry.gauge("train.tokens_per_sec")
         peak = peak_flops_per_chip() if telemetry_on else None
         device_kind = jax.devices()[0].device_kind if telemetry_on else None
+
+        # Resilience plumbing — all of it gated on cfg.resilience so the
+        # default loop touches none of these objects.
+        rc = cfg.resilience
+        resil = None
+        aux = None
+        if rc is not None:
+            from ..resilience.recover import (ResilienceController,
+                                              RetryingIterator)
+            resil = ResilienceController(rc, self.registry, self.events,
+                                         log_fn=log_fn)
+            aux = (jnp.float32(0.0), jnp.int32(0), jnp.int32(0))
+            batch_iter = RetryingIterator(
+                lambda pos: self._batches(source, n, start=pos),
+                retries=rc.data_retries, backoff_s=rc.data_backoff_s,
+                chaos=self.chaos, registry=self.registry,
+                events=self.events)
+        else:
+            batch_iter = self._batches(source, n)
+
         t_first = t0 = time.perf_counter()
         losses = []
         w = None
-        for b, (data, target) in enumerate(self._batches(source, n)):
+        for b, (data, target) in enumerate(batch_iter):
             x, mask = self._make_x(data, target)
             # Row count is constant until the tail-batch break, so the valid-
             # row mask is too — build it once, not per step.
@@ -490,9 +587,17 @@ class Trainer:
                     trace_dir = os.path.join(cfg.telemetry_dir,
                                              f"trace_step{b + 1}")
                     scopes.enter_context(profile_trace(trace_dir))
-                state, loss = self._step_fn(state, x, w,
-                                            jax.random.fold_in(key, b),
-                                            jnp.float32(lr))
+                if rc is not None:
+                    inject, mag = (self.chaos.train_inject(b)
+                                   if self.chaos is not None else (0, 1.0))
+                    state, loss, aux = self._step_fn(
+                        state, aux, x, w, jax.random.fold_in(key, b),
+                        jnp.float32(lr), jnp.int32(inject),
+                        jnp.float32(mag))
+                else:
+                    state, loss = self._step_fn(state, x, w,
+                                                jax.random.fold_in(key, b),
+                                                jnp.float32(lr))
                 # Virtual-CPU platform: serialize steps (see
                 # sync_if_forced_cpu — interleaved async runs livelock the
                 # collective rendezvous there). No-op on real TPU.
@@ -532,6 +637,11 @@ class Trainer:
                 if self.tb is not None and at_log:
                     for tag, val in report.scalar_items():
                         self.tb.add_scalar(tag, val, int(state.step))
+            if resil is not None:
+                # Rewind/abort policy on the host cadence; may replace
+                # (state, aux) with known-good copies or raise
+                # TrainingAborted after the rewind budget.
+                state, aux = resil.after_step(b, state, aux)
             if self._autosave_pending():
                 self._autosave(state, log_fn)
                 break
@@ -571,10 +681,15 @@ class Trainer:
             self.events.metrics_snapshot(self.registry)
             self.events.flush()
         # t0 was reset after step 0, so elapsed covers len(losses)-1 steps
-        return state, {"loss": final,
-                       "steps": len(losses),
-                       "sec_per_step": (time.perf_counter() - t0)
-                       / max(len(losses) - 1, 1)}
+        info = {"loss": final,
+                "steps": len(losses),
+                "sec_per_step": (time.perf_counter() - t0)
+                / max(len(losses) - 1, 1)}
+        if resil is not None:
+            info["anomalies"] = resil.anomalies
+            info["rewinds"] = resil.rewinds
+            info["loss_ewma"] = float(aux[0])
+        return state, info
 
     def evaluate(self, source: np.ndarray, state: TrainState,
                  max_steps: Optional[int] = None) -> float:

@@ -15,9 +15,15 @@ from typing import Any, Optional
 
 import jax
 
-__all__ = ["TrainState", "save_checkpoint", "restore_checkpoint",
-           "latest_step", "checkpoint_params_layout", "restore_params",
-           "read_params_layout"]
+__all__ = ["TrainState", "CheckpointCorrupt", "save_checkpoint",
+           "restore_checkpoint", "latest_step", "checkpoint_params_layout",
+           "restore_params", "read_params_layout", "state_manifest"]
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A restored checkpoint's content hash disagrees with the manifest
+    recorded at save time. The message names the first corrupt leaf —
+    restore refuses to hand back silently-wrong weights."""
 
 
 @jax.tree_util.register_dataclass
@@ -28,6 +34,54 @@ class TrainState:
     params: Any
     opt_state: Any
     step: jax.Array  # scalar int32
+
+
+def state_manifest(state: Any) -> dict:
+    """Per-leaf sha256 content hashes of a state pytree, keyed by tree
+    path (``jax.tree_util.keystr``). The hash covers dtype, shape and the
+    raw bytes, so any bit flip — in value, shape or dtype — changes it."""
+    import hashlib
+
+    import numpy as np
+
+    leaves = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        h = hashlib.sha256()
+        try:
+            arr = np.asarray(leaf)
+            h.update(str(arr.dtype).encode())
+            h.update(str(arr.shape).encode())
+            h.update(arr.tobytes())
+        except (TypeError, ValueError):
+            h.update(repr(leaf).encode())
+        leaves[name] = h.hexdigest()
+    return leaves
+
+
+def _manifest_path(directory: str, step: int):
+    from etils import epath
+
+    return epath.Path(directory) / f"manifest_step{step}.json"
+
+
+def _write_manifest(directory: str, step: int, manifest: dict) -> None:
+    """Write the manifest atomically: temp name + rename, so a crash
+    mid-write leaves either no manifest (restore skips verification with
+    a warning) or a complete one — never a torn file."""
+    import json
+
+    target = _manifest_path(directory, step)
+    tmp = target.parent / f".{target.name}.tmp"
+    tmp.write_text(json.dumps({"step": step, "leaves": manifest},
+                              indent=0, sort_keys=True))
+    try:
+        tmp.rename(target)
+    except OSError:
+        # some epath backends lack rename; fall back to direct write
+        target.write_text(tmp.read_text())
+        tmp.unlink(missing_ok=True)
 
 
 def _manager(directory: str, max_to_keep: int = 3):
@@ -50,9 +104,17 @@ def save_checkpoint(directory: str, state: TrainState, step: int,
     serving consumers (``apps/generate.py``) can reconstruct the true layer
     order (interleaved stacking permutes rows device-major;
     ``parallel/interleaved.py``). ``Trainer.save`` passes it automatically.
+
+    Atomicity + verifiability: orbax itself commits via temp dir +
+    rename (a crashed save never looks like a checkpoint), and this
+    function additionally records a per-leaf sha256 manifest
+    (``manifest_step{N}.json``, written tmp+rename) that
+    :func:`restore_checkpoint` validates — a corrupt leaf fails loudly
+    by name instead of training on garbage.
     """
     import orbax.checkpoint as ocp
 
+    manifest = state_manifest(state)
     with _manager(directory, max_to_keep) as mngr:
         mngr.save(step, args=ocp.args.StandardSave(state))
         mngr.wait_until_finished()
@@ -65,6 +127,7 @@ def save_checkpoint(directory: str, state: TrainState, step: int,
 
     from etils import epath
 
+    _write_manifest(directory, step, manifest)
     record = epath.Path(directory) / "params_layout.json"
     if layout is not None:
         record.write_text(json.dumps(layout))
@@ -88,11 +151,17 @@ def read_params_layout(directory: str) -> Optional[dict]:
 
 
 def restore_checkpoint(directory: str, template: TrainState,
-                       step: Optional[int] = None) -> TrainState:
+                       step: Optional[int] = None,
+                       verify: bool = True) -> TrainState:
     """Restore ``step`` (default: latest) into ``template``'s structure.
 
     ``template`` supplies shapes/dtypes/shardings — pass a freshly-built
     TrainState (e.g. from ``init``) so restoration reproduces its layout.
+
+    With ``verify=True`` (default) the restored leaves are re-hashed
+    against the manifest recorded at save time; a mismatch raises
+    :class:`CheckpointCorrupt` naming the corrupt leaf. A checkpoint
+    saved before manifests existed restores with a warning.
     """
     import orbax.checkpoint as ocp
 
@@ -101,7 +170,38 @@ def restore_checkpoint(directory: str, template: TrainState,
             step = mngr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {directory}")
-        return mngr.restore(step, args=ocp.args.StandardRestore(template))
+        restored = mngr.restore(step, args=ocp.args.StandardRestore(template))
+    if verify:
+        _verify_manifest(directory, step, restored)
+    return restored
+
+
+def _verify_manifest(directory: str, step: int, restored: Any) -> None:
+    import json
+    import warnings
+
+    record = _manifest_path(directory, step)
+    if not record.exists():
+        warnings.warn(
+            f"checkpoint step {step} in {directory} has no content "
+            f"manifest (saved by an older build?) — restoring "
+            f"unverified", RuntimeWarning, stacklevel=3)
+        return
+    saved = json.loads(record.read_text())["leaves"]
+    actual = state_manifest(restored)
+    for name, digest in saved.items():
+        got = actual.get(name)
+        if got is None:
+            raise CheckpointCorrupt(
+                f"checkpoint step {step} in {directory}: leaf {name} is "
+                f"in the save-time manifest but missing from the "
+                f"restored tree (template/layout mismatch?)")
+        if got != digest:
+            raise CheckpointCorrupt(
+                f"checkpoint step {step} in {directory}: leaf {name} "
+                f"hash mismatch (saved {digest[:16]}…, restored "
+                f"{got[:16]}…) — the checkpoint is corrupt or was "
+                f"restored into the wrong template")
 
 
 def latest_step(directory: str) -> Optional[int]:

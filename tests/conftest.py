@@ -100,6 +100,13 @@ _SMOKE = {
     "test_phase_compile.py::test_front_door_phase_compile_plumbing",
     # schedules-as-data: a user-authored op table through the front door
     "test_custom_schedule.py::test_custom_table_through_pipe_front_door",
+    # resilience: the byte-identical-opt-out pin, one recovery path per
+    # layer (train skip-step, serve containment), and the verifiable save
+    "test_resilience.py::test_train_step_hlo_unchanged_by_resilience",
+    "test_resilience.py::test_skip_step_on_injected_nan",
+    "test_resilience.py::test_prefill_error_contained_to_one_request",
+    "test_resilience.py::"
+    "test_checkpoint_manifest_verifies_and_names_corrupt_leaf",
 }
 
 

@@ -1,0 +1,308 @@
+"""Chaos bench: prove the resilience layer recovers under every fault class.
+
+For each fault kind in the :class:`~pipe_tpu.resilience.ChaosPlan`
+taxonomy, run a small job with the fault injected deterministically and
+record whether it **recovered** and how many steps the fault cost
+(``steps_to_recover``):
+
+* **Train faults** (``nan_grads`` / ``inf_grads`` / ``nan_loss`` /
+  ``loss_spike`` / ``nan_activations``) — the guarded step must skip the
+  poisoned update(s), finish the run with finite params, and count
+  exactly the injected anomalies. ``steps_to_recover`` = skipped steps.
+  A separate ``rewind`` trial injects ``rewind_after`` consecutive
+  faults to force a snapshot rollback.
+* **Data faults** (``data_raise``) — the retrying iterator must rebuild
+  the source and deliver every batch; zero training steps lost.
+* **Transport faults** (``transport_drop`` / ``transport_corrupt``) —
+  the emulator executor's hop fault must (a) fire deterministically
+  (faulted output != clean output), and (b) a retry without the fault
+  must reproduce the clean output bitwise — the transient-loss recovery
+  story. ``steps_to_recover`` = 1 retried execution.
+* **Serve faults** (``stall_tick`` / ``queue_flood`` /
+  ``backend_raise``) — the engine must keep serving: stalls are counted
+  by the watchdog, floods cannot starve real (higher-priority) traffic,
+  and a raising backend errors only the request it hit.
+
+Usage:
+  python tools/chaos_bench.py                 # full run -> CHAOS_r09.json
+  python tools/chaos_bench.py --quick         # subset, one JSON line
+Progress goes to stderr; the last stdout line is always the summary
+object, so ``bench.py`` embeds the --quick summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# The train trials need a 2-stage mesh; force virtual CPU devices before
+# jax binds a backend (same pattern as multistage_probe).
+from pipe_tpu.utils.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(8)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipe_tpu.data import lm_text
+from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+from pipe_tpu.obs.telemetry import MetricsRegistry, set_registry
+from pipe_tpu.resilience import (ChaosPlan, Fault, ResilienceConfig,
+                                 TickWatchdog)
+from pipe_tpu.train.loop import Trainer, TrainerConfig
+
+CFG = LMConfig(vocab=67, d_model=16, nhead=2, d_ff=32, n_layers=4,
+               seq_len=32, dropout=0.0)
+STEPS = 8
+FAULT_STEP = 3
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _source():
+    ids = np.random.RandomState(0).randint(0, CFG.vocab, size=20000)
+    return lm_text.batchify(ids, 8)
+
+
+def _resilience(**kw):
+    base = dict(warmup_steps=100, rewind_after=2, snapshot_every=2,
+                data_backoff_s=0.0, rewind_backoff_s=0.0)
+    base.update(kw)
+    return ResilienceConfig(**base)
+
+
+def _trainer(rc, plan):
+    tc = TrainerConfig(batch_size=8, bptt=16, chunks=2, n_stages=2,
+                       checkpoint="never", lr=0.01, resilience=rc)
+    return Trainer(CFG, tc, chaos=plan)
+
+
+def _finite(state):
+    return all(bool(jnp.isfinite(l).all())
+               for l in jax.tree_util.tree_leaves(state.params)
+               if jnp.issubdtype(l.dtype, jnp.inexact))
+
+
+def train_trial(kind, count=1, magnitude=1e3, **rc_kw):
+    """Inject `count` consecutive `kind` faults at FAULT_STEP; recovery =
+    the run finishes all STEPS with finite params and the guard caught
+    exactly the injected steps."""
+    reg = set_registry(MetricsRegistry())
+    try:
+        plan = ChaosPlan([Fault(kind, step=FAULT_STEP, count=count,
+                                magnitude=magnitude)])
+        tr = _trainer(_resilience(**rc_kw), plan)
+        t0 = time.perf_counter()
+        state, info = tr.train_epoch(_source(), 0, tr.init_state(),
+                                     max_steps=STEPS, log_every=0,
+                                     log_fn=log)
+        finite = _finite(state)
+        recovered = (finite and info["steps"] == STEPS
+                     and info["anomalies"] >= count
+                     and np.isfinite(info["loss_ewma"]))
+        return {"recovered": bool(recovered),
+                "steps_to_recover": int(info["anomalies"]),
+                "anomalies": int(info["anomalies"]),
+                "rewinds": int(info["rewinds"]),
+                "params_finite": bool(finite),
+                "loss_ewma": round(float(info["loss_ewma"]), 4),
+                "wall_s": round(time.perf_counter() - t0, 2)}
+    finally:
+        set_registry(reg)
+
+
+def data_trial():
+    """data_raise at one batch index: the retrying iterator rebuilds the
+    source; every batch still arrives, zero steps lost."""
+    reg = set_registry(MetricsRegistry())
+    try:
+        plan = ChaosPlan([Fault("data_raise", step=FAULT_STEP)])
+        tr = _trainer(_resilience(), plan)
+        state, info = tr.train_epoch(_source(), 0, tr.init_state(),
+                                     max_steps=STEPS, log_every=0,
+                                     log_fn=log)
+        from pipe_tpu.obs.telemetry import get_registry
+        retries = get_registry().scalars().get("resilience.data_retries", 0)
+        recovered = (info["steps"] == STEPS and info["anomalies"] == 0
+                     and retries >= 1)
+        return {"recovered": bool(recovered), "steps_to_recover": 0,
+                "data_retries": int(retries),
+                "steps_completed": int(info["steps"])}
+    finally:
+        set_registry(reg)
+
+
+def transport_trial(kind):
+    """Emulator hop fault: faulted run differs from clean, retry without
+    the fault reproduces the clean output bitwise."""
+    from pipe_tpu.core import microbatch as mb
+    from pipe_tpu.core.partition import StageCtx
+    from pipe_tpu.parallel import emulator
+
+    def stage(p, x, ctx: StageCtx):
+        return jnp.tanh(x @ p)
+
+    key = jax.random.key(7)
+    params = [jax.random.normal(jax.random.fold_in(key, s), (8, 8))
+              for s in range(2)]
+    stages = [stage, stage]
+    xs = [mb.Batch(jax.random.normal(jax.random.fold_in(key, 10 + i),
+                                     (4, 8)), atomic=True)
+          for i in range(2)]
+
+    def run(chaos):
+        out = emulator.run(stages, params, list(xs), chaos=chaos)
+        return [np.asarray(b.values[0]) for b in out]
+
+    clean = run(None)
+    plan = ChaosPlan([Fault(kind, step=0, stage=0, microbatch=1)])
+    faulted = run(plan)
+    hit = not np.array_equal(faulted[1], clean[1])
+    spared = np.array_equal(faulted[0], clean[0])
+    retry = run(None)
+    restored = all(np.array_equal(a, b) for a, b in zip(retry, clean))
+    return {"recovered": bool(hit and spared and restored),
+            "steps_to_recover": 1, "fault_detected": bool(hit),
+            "other_microbatch_untouched": bool(spared),
+            "retry_bitwise_clean": bool(restored)}
+
+
+def _serve_engine(plan, watchdog=None, capacity=8, num_slots=2):
+    from pipe_tpu.inference.generate import GenerationConfig
+    from pipe_tpu.serve import (RequestQueue, ServeEngine,
+                                SingleDeviceSlotBackend)
+    model = PipelinedLM(CFG, 2)
+    params = model.init(jax.random.key(0))
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=num_slots, max_len=32,
+        gen=GenerationConfig(max_new_tokens=8, temperature=1.0))
+    queue = RequestQueue(capacity=capacity, policy="priority")
+    return ServeEngine(backend, queue, chaos=plan, watchdog=watchdog)
+
+
+def serve_trial(kind):
+    reg = set_registry(MetricsRegistry())
+    try:
+        from pipe_tpu.obs.telemetry import get_registry
+        if kind == "stall_tick":
+            plan = ChaosPlan([Fault("stall_tick", step=1, magnitude=0.15)])
+            eng = _serve_engine(plan, TickWatchdog(tick_budget_s=0.05))
+        elif kind == "queue_flood":
+            plan = ChaosPlan([Fault("queue_flood", step=0)])
+            eng = _serve_engine(plan)
+        else:
+            plan = ChaosPlan([Fault("backend_raise", step=0)])
+            eng = _serve_engine(plan)
+            # tick 0 is the faulted tick: whatever it admits dies with
+            # status="error"; traffic submitted afterwards must serve fine
+            bad = eng.submit([9, 2, 3], max_new_tokens=4, seed=0)
+            eng.tick()
+            reqs = [eng.submit([1 + i, 2, 3], max_new_tokens=4, seed=i)
+                    for i in range(2)]
+            eng.run_until_idle(max_ticks=200)
+            stats = [eng.response(r.id).status for r in reqs]
+            errs = int(get_registry().scalars().get(
+                "resilience.slot_errors", 0))
+            return {"request_statuses": stats,
+                    "faulted_status": eng.response(bad.id).status,
+                    "recovered": bool(
+                        eng.response(bad.id).status == "error"
+                        and all(s == "ok" for s in stats) and errs == 1),
+                    "steps_to_recover": errs, "slot_errors": errs}
+        reqs = [eng.submit([1 + i, 2, 3], max_new_tokens=4, seed=i)
+                for i in range(3)]
+        eng.run_until_idle(max_ticks=200)
+        stats = [eng.response(r.id).status for r in reqs]
+        scalars = get_registry().scalars()
+        out = {"request_statuses": stats}
+        if kind == "stall_tick":
+            slow = scalars.get("resilience.watchdog_slow_ticks", 0)
+            out.update(recovered=bool(all(s == "ok" for s in stats)
+                                      and slow >= 1),
+                       steps_to_recover=0, slow_ticks=int(slow))
+        else:
+            # flood junk rides at the lowest priority: real traffic all
+            # finishes despite the queue being force-filled
+            out.update(recovered=bool(all(s == "ok" for s in stats)),
+                       steps_to_recover=0,
+                       floods=int(scalars.get("resilience.chaos_floods", 0)))
+        return out
+    finally:
+        set_registry(reg)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="one fault per layer, one JSON line")
+    ap.add_argument("--out", default=None,
+                    help="also write the summary JSON here")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    results = {}
+    if args.quick:
+        train_kinds = ["nan_grads"]
+        transport_kinds = ["transport_drop"]
+        serve_kinds = ["backend_raise"]
+        rewind = False
+    else:
+        train_kinds = ["nan_grads", "inf_grads", "nan_loss", "loss_spike",
+                       "nan_activations"]
+        transport_kinds = ["transport_drop", "transport_corrupt"]
+        serve_kinds = ["stall_tick", "queue_flood", "backend_raise"]
+        rewind = True
+
+    for kind in train_kinds:
+        log(f"== train fault: {kind}")
+        # the spike check is disarmed during warmup, so the loss_spike
+        # trial must warm up BEFORE the fault step to see it fire
+        kw = {"warmup_steps": 2} if kind == "loss_spike" else {}
+        results[kind] = train_trial(kind, **kw)
+        log(f"   {results[kind]}")
+    if rewind:
+        log("== train fault: rewind (consecutive nan_grads)")
+        r = train_trial("nan_grads", count=2)   # == rewind_after
+        r["recovered"] = bool(r["recovered"] and r["rewinds"] >= 1)
+        results["rewind"] = r
+        log(f"   {r}")
+    log("== data fault: data_raise")
+    results["data_raise"] = data_trial()
+    log(f"   {results['data_raise']}")
+    for kind in transport_kinds:
+        log(f"== transport fault: {kind}")
+        results[kind] = transport_trial(kind)
+        log(f"   {results[kind]}")
+    for kind in serve_kinds:
+        log(f"== serve fault: {kind}")
+        results[kind] = serve_trial(kind)
+        log(f"   {results[kind]}")
+
+    summary = {
+        "bench": "chaos", "rev": "r09",
+        "quick": bool(args.quick),
+        "platform": jax.default_backend(),
+        "all_recovered": all(v.get("recovered") for v in results.values()),
+        "faults": results,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+        log(f"wrote {args.out}")
+    print(json.dumps(summary if args.quick else summary, indent=None
+                     if args.quick else 2))
+    return 0 if summary["all_recovered"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
