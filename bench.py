@@ -420,6 +420,27 @@ def main():
     except Exception as e:
         print(f"multi-stage bubble probe failed: {e}", file=sys.stderr)
 
+    # Zero-bubble split probe: 1f1b vs the structural B/W split rows
+    # (hand-rolled TP triple + the auto-derived split) on the cpu8 mesh —
+    # the per-round record behind the zb-h1 cost story
+    # (ZB_SPLIT_PROBE_r{N}.json is the full-size committed artifact).
+    zb_split_summary = None
+    try:
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "zb_split_probe.py"), "--quick"],
+            capture_output=True, text=True, timeout=900, env=env)
+        if out.returncode == 0:
+            zb_split_summary = json.loads(
+                out.stdout.strip().splitlines()[-1])
+        else:
+            print(f"zb split probe rc={out.returncode}: "
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"zb split probe failed: {e}", file=sys.stderr)
+
     # Front-door adapter tax (Pipe(mesh=) vs raw executor), tracked every
     # round: the probe's last stdout line is its summary with the
     # tax_*_vs_raw ratios (cpu8 — the TPU chip is busy being the headline).
@@ -568,6 +589,7 @@ def main():
         "measured_bubble_method": bubble_method,
         "measured_bubble_multistage": bubble_multistage,
         "front_door_tax": front_door_tax,
+        "zb_split": zb_split_summary,
         "serve": serve_summary,
         "chaos": chaos_summary,
         "trend_vs_prior": trend_vs_prior,

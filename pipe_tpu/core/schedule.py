@@ -36,7 +36,9 @@ __all__ = [
     "BWD",
     "WGRAD",
     "ZeroBubbleSchedule",
+    "ZeroBubbleDeepSchedule",
     "verify_zb_op_tables",
+    "zb_joint_capacity",
     "shift_comm_tables",
     "verify_shifted_op_tables",
     "overlap_fifo_capacity",
@@ -407,7 +409,8 @@ def verify_interleaved_op_tables(op, mbi, grp, m: int, d: int,
 
 def verify_op_tables(op: np.ndarray, mbi: np.ndarray, m: int, n: int,
                      stash_slots: Optional[int] = None,
-                     comm_shift: int = 1) -> None:
+                     comm_shift: int = 1,
+                     wstash_slots: Optional[int] = None) -> None:
     """Check the :meth:`Schedule.op_tables` invariants (see docstring there).
 
     A table passing this check — *including* the stash-capacity check, so
@@ -422,10 +425,23 @@ def verify_op_tables(op: np.ndarray, mbi: np.ndarray, m: int, n: int,
     :func:`verify_shifted_op_tables`: sends fly while the next cycle
     computes, so every receive must land ``comm_shift`` cycles after its
     send and the reverse ring becomes an elastic receive FIFO.
+
+    W-bearing (split-backward) tables are first-class here: a table with
+    any ``WGRAD`` op is routed through the split-aware invariants — W
+    strictly after its own B (W consumes B's parked cotangent), and the
+    stash-capacity check accounts activations as freed by W, not B (B
+    alone does not release the stage input; its W still needs the taps).
+    ``wstash_slots`` then additionally bounds the B->W cotangent park.
     """
     if comm_shift > 1:
-        verify_shifted_op_tables(op, mbi, None, m=m, d=n, v=1,
-                                 hop=comm_shift, stash_slots=stash_slots)
+        verify_shifted_op_tables(
+            op, mbi, None, m=m, d=n, v=1, hop=comm_shift,
+            stash_slots=stash_slots,
+            splits_backward=bool((np.asarray(op) == WGRAD).any()))
+        return
+    if (np.asarray(op) == WGRAD).any():
+        verify_zb_op_tables(op, mbi, m, n, stash_slots=stash_slots,
+                            wstash_slots=wstash_slots)
         return
     t_fwd = np.full((m, n), -1)
     t_bwd = np.full((m, n), -1)
@@ -558,7 +574,7 @@ class ZeroBubbleSchedule(Schedule):
                 # its W here, so the cap counts F-done-W-pending
                 placed = False
                 in_flight = int(np.sum((t_fwd[:, j] >= 0) & (t_w[:, j] < 0)))
-                if in_flight < min(m, n + 1):
+                if in_flight < self._in_flight_cap(m, n):
                     for i in range(m):
                         if t_fwd[i, j] >= 0:
                             continue
@@ -580,6 +596,11 @@ class ZeroBubbleSchedule(Schedule):
                 return op[:t + 1], mbi[:t + 1]
         raise AssertionError(
             f"zb-h1 table construction did not converge (m={m}, n={n})")
+
+    def _in_flight_cap(self, m: int, n: int) -> int:
+        """Max forwards admitted per stage before their W retires (keeps
+        stashed inputs 1F1B-bounded; the zb-h2 variant widens this)."""
+        return min(m, n + 1)
 
     def _times(self, m: int, n: int):
         return _zb_times(*self.op_tables(m, n), m, n)
@@ -616,6 +637,26 @@ class ZeroBubbleSchedule(Schedule):
         (F, B, W), so busy = 3mn of T*n."""
         T = self.num_cycles(m, n)
         return (T * n - 3 * m * n) / (T * n)
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroBubbleDeepSchedule(ZeroBubbleSchedule):
+    """The zb-v-ish variant (ZB-H2 lineage, Qi et al. 2023): same greedy
+    constructor and rigid B chains as :class:`ZeroBubbleSchedule`, but the
+    per-stage in-flight cap widens from ``n + 1`` to ``2n - 1`` — extra
+    forwards are admitted during warmup so the fill-side idle slots carry
+    real F work, and their deferred Ws drain into the cooldown. The memory
+    trade is explicit: ``stash_slots`` grows toward ``2n - 1`` stage
+    inputs per device (vs 1F1B's ``n``), which is exactly the knob ZB-H2
+    turns — trade activation memory for bubble. ``bubble()`` at (m=8,
+    n=4) drops below zb-h1's 11.1% (the analytic model in
+    ``obs/zb_model.py`` and ``test_zb_deep_*`` pin the ordering
+    zb-h2 < zb-h1 < 1f1b)."""
+
+    name: str = "zb-h2"
+
+    def _in_flight_cap(self, m: int, n: int) -> int:
+        return min(m, max(2 * n - 1, n + 1))
 
 
 def _zb_times(op: np.ndarray, mbi: np.ndarray, m: int, n: int):
@@ -669,6 +710,39 @@ def verify_zb_op_tables(op: np.ndarray, mbi: np.ndarray, m: int, n: int,
             for i in range(m - Wg):
                 assert t_b[i + Wg, j] > t_w[i, j], \
                     f"wstash slot clobber at stage {j}, mb {i}"
+    if stash_slots is not None and wstash_slots is not None:
+        # Joint capacity: W freeing the stash is what keeps the combined
+        # activation footprint (stashed inputs + parked cotangents) at
+        # Sg + Wg activation-sized buffers. A table whose true joint peak
+        # exceeded the declared slots would alias live values.
+        joint = zb_joint_capacity(op, mbi, m, n)
+        assert joint <= stash_slots + wstash_slots, (
+            f"joint stash+park peak {joint} exceeds declared "
+            f"stash_slots + wstash_slots = "
+            f"{stash_slots} + {wstash_slots}")
+
+
+def zb_joint_capacity(op: np.ndarray, mbi: np.ndarray, m: int,
+                      n: int) -> int:
+    """Peak simultaneous activation-sized live values per stage of a
+    split-backward table: stashed stage inputs (live from arrival until
+    their W — B alone does not free them, its W still reads the taps) plus
+    parked B cotangents (live from B until W). This is the number the
+    W op actually SHRINKS versus a hypothetical stash-to-last-read-at-B
+    accounting with the full combined backward deferred: deferring only
+    the weight-grad half parks one cotangent per in-flight micro-batch
+    instead of holding a second full residual set."""
+    t_fwd, t_b, t_w = _zb_times(op, mbi, m, n)
+    arrive = np.where(np.arange(n)[None, :] == 0, t_fwd,
+                      np.roll(t_fwd, 1, axis=1) + 1)
+    T = op.shape[0]
+    cap = 0
+    for j in range(n):
+        for t in range(T):
+            live_stash = int(np.sum((arrive[:, j] <= t) & (t <= t_w[:, j])))
+            live_park = int(np.sum((t_b[:, j] <= t) & (t < t_w[:, j])))
+            cap = max(cap, live_stash + live_park)
+    return cap
 
 
 # ---------------------------------------------------------------------------
@@ -1194,6 +1268,7 @@ _SCHEDULES = {
     "interleaved": InterleavedSchedule,
     "interleaved-1f1b": InterleavedOneFOneBSchedule,
     "zb-h1": ZeroBubbleSchedule,
+    "zb-h2": ZeroBubbleDeepSchedule,
 }
 
 

@@ -115,6 +115,15 @@ def main(n_stages: int = 4, chunks: int = 8,
                                  remat_policy=jax.checkpoint_policies
                                  .dots_saveable)),
             ("zb-h1", dict(checkpoint="never", schedule="zb-h1")),
+            # The split-table rows: auto-derived structural B/W split
+            # (core/remat.py) so B runs a params-constant vjp and W only
+            # the tap x cotangent contractions — total backward work
+            # equals the fused backward's, unlike the legacy stored-vjp
+            # row above that transposes twice.
+            ("zb-h1-split", dict(checkpoint="never", schedule="zb-h1",
+                                 split_stage="auto")),
+            ("zb-h2-split", dict(checkpoint="never", schedule="zb-h2",
+                                 split_stage="auto")),
         ]
         if compare_transport:
             # Same workload with the packed, software-pipelined boundary
@@ -137,6 +146,9 @@ def main(n_stages: int = 4, chunks: int = 8,
                                     phase_compile=True)),
                 ("zb-h1-phase", dict(checkpoint="never", schedule="zb-h1",
                                      phase_compile=True)),
+                ("zb-h1-split-phase",
+                 dict(checkpoint="never", schedule="zb-h1",
+                      split_stage="auto", phase_compile=True)),
             ]
 
         def step_time_sched(pipe, mm: int) -> float:

@@ -57,7 +57,21 @@ import numpy as np
 
 from ..core.schedule import BWD, FWD, IDLE, WGRAD, get_schedule
 
-__all__ = ["OpCosts", "schedule_wall", "calibrate", "predict", "crossover"]
+__all__ = ["OpCosts", "schedule_wall", "calibrate", "predict", "crossover",
+           "analytic_bubbles"]
+
+
+def analytic_bubbles(m: int, n: int,
+                     names: Sequence[str] = ("1f1b", "zb-h1", "zb-h2"),
+                     ) -> Dict[str, float]:
+    """Analytic idle fractions of the named schedules' op tables at
+    (m, n), per-op-slot (a 1F1B combined backward occupies ONE slot worth
+    two units of work — the same accounting every ``Schedule.bubble``
+    uses, so the numbers are cross-comparable). The split tables' W ops
+    count as real work: this is the table-density claim the zero-bubble
+    schedules make, and ``test_zb_model`` pins zb-h1/zb-h2 strictly below
+    1f1b here."""
+    return {name: float(get_schedule(name).bubble(m, n)) for name in names}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,11 +198,14 @@ def calibrate(measurements: Sequence[dict], n: int) -> dict:
     }
 
 
-def predict(m: int, n: int, costs: OpCosts, mode: str) -> dict:
-    """Wall-clock predictions for 1f1b and zb-h1 under one cost model."""
+def predict(m: int, n: int, costs: OpCosts, mode: str,
+            zb: str = "zb-h1") -> dict:
+    """Wall-clock predictions for 1f1b and a zb table under one cost
+    model (``zb`` picks the split schedule: zb-h1 or zb-h2)."""
     t1 = schedule_wall(_op_counts("1f1b", m, n)[0], costs, mode)
-    tz = schedule_wall(_op_counts("zb-h1", m, n)[0], costs, mode)
-    return {"mode": mode, "m": m, "n": n, "t_1f1b": t1, "t_zb": tz,
+    tz = schedule_wall(_op_counts(zb, m, n)[0], costs, mode)
+    return {"mode": mode, "m": m, "n": n, "zb": zb,
+            "t_1f1b": t1, "t_zb": tz,
             "zb_over_1f1b": tz / t1 if t1 > 0 else float("nan"),
             "zb_wins": tz < t1}
 

@@ -290,7 +290,10 @@ class ScheduledPipeline:
     # Structural B/W split of the stage body for zero-bubble schedules —
     # see :class:`SplitBackwardStage`. Requires checkpoint='never' and a
     # splits_backward schedule; replaces stage_fn for fwd/bwd purposes.
-    split_stage: Optional[SplitBackwardStage] = None
+    # The string "auto" derives the split from stage_fn by jaxpr surgery
+    # (core.remat.split_backward_stage) — works for any stage body whose
+    # params enter linearly (matmuls/scales/casts; see SplitUnsupported).
+    split_stage: Optional[Any] = None
     # Selective rematerialization for the RECOMPUTE micro-batches (a
     # ``jax.checkpoint_policies`` member, e.g. ``dots_saveable``): instead
     # of stashing the stage input and re-running the whole forward at
@@ -371,6 +374,16 @@ class ScheduledPipeline:
                 raise ValueError(
                     f"schedule {self.schedule!r} has no op_tables")
         self.n_stages = self.mesh.shape[STAGE_AXIS]      # devices d
+        if isinstance(self.split_stage, str):
+            if self.split_stage != "auto":
+                raise ValueError(
+                    f"split_stage must be a SplitBackwardStage or 'auto', "
+                    f"got {self.split_stage!r}")
+            # derive the tapped/wgrad/zs triple from the stage fn itself
+            # (core.remat.split_backward_stage) — any model, no hand-rolled
+            # tapped forward
+            from ..core.remat import split_backward_stage
+            self.split_stage = split_backward_stage(self.stage_fn)
         if self.split_stage is not None:
             if not getattr(self.schedule, "splits_backward", False):
                 raise ValueError(
@@ -1608,6 +1621,47 @@ class ScheduledPipeline:
                 self._vjp_wrt, params_g_spec, pre_params, h_spec,
                 x_mb_spec, key_spec, i32, pops_spec)
         res_specs, res_treedef = jax.tree_util.tree_flatten(vjp_fn_spec)
+        # Structural split: the stored B-vjp's residual leaves include pure
+        # PASSTHROUGHS of values the B cycle can already see — the stage
+        # weights (vjp consts: dx = gy @ W^T needs W), the pre params, the
+        # stashed h_in, x_mb. Streaming those through the slot store costs
+        # full leaf-size writes EVERY cycle (the sentinel-write discipline)
+        # for values that never change between F and B; on the serialized
+        # cpu8 probe the weight copies alone are ~30% of the split's res
+        # traffic. Detect them structurally (jaxpr outvar == invar) and
+        # rebuild at B from the branch environment instead of storing.
+        split_res_pt = None
+        if self.split_stage is not None:
+            def _res_leaves_of(pg, pre, hh, xx, kk, ss):
+                _, vjp_fn, _ = self._vjp_wrt_split(pg, pre, hh, xx, kk, ss)
+                return tuple(jax.tree_util.tree_leaves(vjp_fn))
+
+            jpr = jax.make_jaxpr(_res_leaves_of)(
+                params_g_spec, pre_params, h_spec, x_mb_spec, key_spec, i32)
+            srcs = [("pg", params_g_spec), ("pre", pre_params),
+                    ("h", h_spec), ("x", x_mb_spec)]
+            src_of, pos = {}, 0
+            for kind, tree in srcs:
+                leaves_k = jax.tree_util.tree_leaves(tree)
+                for k, iv in enumerate(
+                        jpr.jaxpr.invars[pos:pos + len(leaves_k)]):
+                    src_of[iv] = (kind, k)
+                pos += len(leaves_k)
+            split_res_pt = {}
+            for idx, ov in enumerate(jpr.jaxpr.outvars):
+                hit = (None if isinstance(ov, jax.core.Literal)
+                       else src_of.get(ov))
+                if hit is not None:
+                    sp_ = res_specs[idx]
+                    lv = jax.tree_util.tree_leaves(dict(srcs)[hit[0]])[
+                        hit[1]]
+                    assert (tuple(sp_.shape), sp_.dtype) == \
+                        (tuple(lv.shape), lv.dtype), \
+                        "passthrough residual aval drifted from its source"
+                    split_res_pt[idx] = hit
+            n_res_leaves_full = len(res_specs)
+            res_specs = [sp_ for idx, sp_ in enumerate(res_specs)
+                         if idx not in split_res_pt]
         # Policy-selective remat: the policy vjp's residual pytree (what
         # jax.checkpoint's policy saves) differs from the full set, so the
         # recompute micro-batches get their OWN uniform slot store. At
@@ -1981,11 +2035,19 @@ class ScheduledPipeline:
                                   res_slot_for(i, g)), no_pres, no_taps)
 
                 def split_vjp_and_store():
-                    # structural split: params-constant vjp + taps values
+                    # structural split: params-constant vjp + taps values;
+                    # passthrough residual leaves (weights, pre params,
+                    # h_in, x_mb) are dropped here and rebuilt at B from
+                    # the branch environment — see split_res_pt above
                     out, vjp_fn, taps = self._vjp_wrt_split(
                         params_g, pre_params, h_in, x_mb, kis, s)
-                    return (out, (_vjp_leaves(vjp_fn, res_specs),
-                                  res_slot_for(i, g)), no_pres,
+                    leaves = jax.tree_util.tree_leaves(vjp_fn)
+                    stored = [l for idx, l in enumerate(leaves)
+                              if idx not in split_res_pt]
+                    assert [(l.shape, l.dtype) for l in stored] == \
+                        [(sp_.shape, sp_.dtype) for sp_ in res_specs], \
+                        "split vjp residual structure drifted from spec"
+                    return (out, (stored, res_slot_for(i, g)), no_pres,
                             (taps, g * Sg + i % Sg))
 
                 def policy_vjp_and_store():
@@ -2107,8 +2169,23 @@ class ScheduledPipeline:
                     # in it by construction); per-op output cotangents
                     # park for W, pre grads accumulate here (edge-stage
                     # embed path only).
-                    gpre, gh, gzs = _load_vjp(res_store, res_treedef,
-                                              res_slot_for(i, g))(seed_f0)
+                    slot = res_slot_for(i, g)
+                    stored = iter(
+                        jax.lax.dynamic_index_in_dim(st, slot, 0,
+                                                     keepdims=False)
+                        for st in res_store)
+                    env = {"pg": jax.tree_util.tree_leaves(params_g),
+                           "pre": jax.tree_util.tree_leaves(pre_params),
+                           "h": jax.tree_util.tree_leaves(h_in),
+                           "x": jax.tree_util.tree_leaves(x_mb)}
+                    leaves = [
+                        (next(stored) if idx not in split_res_pt
+                         else env[split_res_pt[idx][0]]
+                         [split_res_pt[idx][1]])
+                        for idx in range(n_res_leaves_full)]
+                    vjp_fn = jax.tree_util.tree_unflatten(res_treedef,
+                                                          leaves)
+                    gpre, gh, gzs = vjp_fn(seed_f0)
                     gh = _vjp_to_ring(gh, h_spec)
                     return (hl_none, (gzs, g * Wg + i % Wg), no_taps,
                             no_res, no_pres, stats_acc, g_sp,

@@ -136,6 +136,7 @@ class Trainer:
             # (both passes from one static table; see core.schedule).
             from ..core.schedule import InterleavedOneFOneBSchedule
             from ..parallel.scheduled import ScheduledPipeline
+            split_kw = {}
             if cfg.schedule == "interleaved-1f1b":
                 sched = InterleavedOneFOneBSchedule(
                     interleave=cfg.interleave)
@@ -145,27 +146,38 @@ class Trainer:
                 # zb-h1's recommendation is GATED on the committed cost
                 # model (docs/zb_crossover.md): it beats 1f1b on parallel
                 # hardware only when the measured split overhead sigma is
-                # below the config's breakeven sigma* — at the cpu8-
-                # measured sigma (~1.9-2.3) it loses at every swept
-                # config, so 1f1b stays the default and zb-h1 is an
-                # explicit, measured-first opt-in.
+                # below the config's breakeven sigma*. With the structural
+                # B/W split (split_stage="auto", core/remat.py) the cpu8
+                # recalibration measures sigma <= 1.41 — below every swept
+                # breakeven (ZB_CROSSOVER_r05.json) — so the Trainer
+                # engages the split whenever the checkpoint mode allows
+                # it; recompute modes fall back to the fused backward at
+                # B (W slots idle) and warn.
                 if cfg.schedule == "zb-h1":
-                    from ..obs.zb_model import crossover
-                    row = crossover(cfg.chunks, cfg.n_stages, sigma=1.0)
-                    warnings.warn(
-                        f"zb-h1 at (m={cfg.chunks}, n={cfg.n_stages}): "
-                        f"wins on parallel hardware only if its split "
-                        f"overhead sigma < {row['breakeven_sigma']:.2f} "
-                        f"(cpu8 measures sigma 1.9-2.3; see "
-                        f"docs/zb_crossover.md). Measure before "
-                        f"preferring it over '1f1b'.", stacklevel=2)
+                    if cfg.checkpoint == "never":
+                        split_kw["split_stage"] = "auto"
+                    else:
+                        from ..obs.zb_model import crossover
+                        row = crossover(cfg.chunks, cfg.n_stages,
+                                        sigma=1.0)
+                        warnings.warn(
+                            f"zb-h1 at (m={cfg.chunks}, "
+                            f"n={cfg.n_stages}) with "
+                            f"checkpoint={cfg.checkpoint!r}: the "
+                            f"structural B/W split needs "
+                            f"checkpoint='never', so the fused backward "
+                            f"runs at B and the zero-bubble advantage "
+                            f"(breakeven sigma* "
+                            f"{row['breakeven_sigma']:.2f}, measured "
+                            f"split sigma <= 1.41 — docs/zb_crossover.md) "
+                            f"is forfeited.", stacklevel=2)
                 sched = cfg.schedule
                 self.n_virtual = cfg.n_stages
             self.model = _mk_model(self.n_virtual)
             self.pipe = ScheduledPipeline(
                 self.mesh, self.model.stage_fn, pre_fn=self.model.pre_fn,
                 post_fn=self.model.loss_post_fn, checkpoint=cfg.checkpoint,
-                schedule=sched)
+                schedule=sched, **split_kw)
         elif cfg.schedule == "gpipe":
             self.n_virtual = cfg.n_stages
             self.model = _mk_model(cfg.n_stages)

@@ -227,7 +227,10 @@ def checkpoint_params_layout(directory: str,
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {directory}")
         path = pathlib.Path(mngr.directory) / str(step) / "default"
-    md = ocp.StandardCheckpointHandler().metadata(path).tree
+    md = ocp.StandardCheckpointHandler().metadata(path)
+    # orbax >= 0.9 wraps the metadata pytree in an object with a ``.tree``
+    # attribute; 0.7.x hands back the pytree itself.
+    md = getattr(md, "tree", md)
     stacked = md["params"][0]
     lps = len(stacked)
     leaf = jax.tree_util.tree_leaves(stacked[0])[0]
@@ -250,8 +253,25 @@ def restore_params(directory: str, params_template: Any,
             step = mngr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {directory}")
-        restored = mngr.restore(
-            step,
-            args=ocp.args.PyTreeRestore(item={"params": params_template},
-                                        partial_restore=True))
+        try:
+            restored = mngr.restore(
+                step,
+                args=ocp.args.PyTreeRestore(item={"params": params_template},
+                                            partial_restore=True))
+        except TypeError:
+            # orbax < 0.9 has no ``partial_restore`` — build a full template
+            # from the saved metadata (ShapeDtypeStructs for the subtrees we
+            # don't care about) and slice ``params`` out of the restore.
+            import pathlib
+
+            path = pathlib.Path(mngr.directory) / str(step) / "default"
+            md = ocp.StandardCheckpointHandler().metadata(path)
+            md = getattr(md, "tree", md)
+            full = {
+                k: (params_template if k == "params" else
+                    jax.tree_util.tree_map(
+                        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), v))
+                for k, v in md.items()
+            }
+            restored = mngr.restore(step, args=ocp.args.StandardRestore(full))
         return restored["params"]

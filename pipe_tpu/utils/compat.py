@@ -16,7 +16,18 @@ from typing import Any, Optional
 
 import jax
 
-__all__ = ["shard_map", "profile_data", "set_num_cpu_devices"]
+__all__ = ["shard_map", "profile_data", "set_num_cpu_devices", "axis_size"]
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` on current jax; on releases that predate the
+    public export (<= 0.4.x) the size comes from the bound axis frame —
+    same static int, no collective."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax.core import axis_frame  # type: ignore[attr-defined]
+    frame = axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):

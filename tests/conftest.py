@@ -59,18 +59,21 @@ _SMOKE = {
     "[1f1b-except_last]",
     "test_overlap_transport.py::"
     "test_verify_op_tables_rejects_misshifted_comm_slot",
-    # the bench-side probes: quick cpu8 transport comparison + the
-    # zero-cost-telemetry HLO pin behind the headline timing
-    "test_overlap_transport.py::"
-    "test_quick_probe_reports_transport_side_by_side",
+    # the zero-cost-telemetry HLO pin behind the headline timing (the
+    # quick cpu8 transport probe itself is a ~60s benchmark — slow tier)
     "test_overlap_transport.py::"
     "test_disabled_telemetry_is_zero_cost_on_hot_path",
     # interleaved (train + the forward/eval executor)
     "test_interleaved.py::test_interleaved_pipe_forward_matches_emulator",
     "test_pipe_1f1b.py::test_interleaved_1f1b_through_pipe",
-    # zero-bubble split tables + the crossover model
+    # zero-bubble split tables + the crossover model; W-op IR verifier
+    # and the auto-derived structural split (round 6)
     "test_zb_split.py::test_zb_split_transparency[2-8]",
     "test_zb_model.py::test_breakeven_sigma_is_the_exact_boundary",
+    "test_zb_tables.py::test_w_tables_verify[8-4-zb-h1]",
+    "test_zb_tables.py::test_verifier_rejects_w_before_its_b",
+    "test_auto_split.py::test_auto_split_transparency[zb-h1-2-8]",
+    "test_custom_schedule.py::test_custom_w_table_runs_split_executor",
     # core data structures + parallelism composition + serving
     "test_microbatch.py::test_scatter_gather_identity",
     "test_schedule.py::test_clock_cycles_matches_reference",
@@ -110,13 +113,188 @@ _SMOKE = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Slow tier: the heaviest parametrizations, excluded from the tier-1 gate
+# (`-m 'not slow'`, 870 s budget — ROADMAP.md) so the default run finishes
+# inside it; `-m slow` (or `-m ''`) runs the full matrix. Every entry here
+# is a heavyweight duplicate of coverage a lighter kept test (often a smoke
+# id) still exercises — nothing is the ONLY test of its feature. Selected
+# by exact nodeid, same contract as _SMOKE; overlap with _SMOKE is a
+# conftest bug and asserted against below.
+_SLOW = {
+    # 520M-config byte accounting: minutes of param init, no exec coverage
+    "test_sharded_params.py::test_tutorial_520m_per_device_bytes",
+    # model-zoo end-to-end trainers; test_model_zoo.py keeps per-family
+    # gradient/training coverage at CI size
+    "test_apps.py::test_zoo_families[gpt2-1f1b]",
+    "test_apps.py::test_zoo_families[bert-interleaved-1f1b]",
+    "test_apps.py::test_zoo_families[vit-gpipe]",
+    # tutorial-driver e2e + heaviest CLI resume paths;
+    # test_generate_cli_single_and_pipelined and the checkpoint roundtrip
+    # tests keep the save/resume contract in tier 1
+    "test_apps.py::test_lm_tutorial_tiny",
+    "test_apps.py::test_generate_cli_resume_roundtrip",
+    "test_apps.py::test_generate_cli_resume_interleaved_layout",
+    "test_apps.py::test_generate_cli_context_shards",
+    # heavyweight duplicates of kept transparency/parity coverage
+    "test_spmd.py::test_remat_post_parity",
+    "test_transformer_lm.py::test_spmd_lm_loss_mode_and_grads",
+    "test_transformer_lm.py::test_spmd_lm_train_step_converges",
+    "test_rng.py::test_rbg_key_through_compiled_pipeline",
+    "test_long_context.py::test_pp_cp_gradient_flows_and_matches",
+    "test_pipe_mesh.py::test_tutorial_lm_through_pipe_mesh",
+    "test_pipe_1f1b.py::test_integer_inputs_through_table_executor",
+    "test_pipe_1f1b.py::test_dropout_determinism_1f1b",
+    "test_resilience.py::test_guarded_no_fault_matches_unguarded_bitwise",
+    "test_model_zoo.py::test_vit_gradients_flow",
+    "test_balance_obs.py::test_profile_trace_writes",
+    # trainer e2e: interleaved + zb-h1 trainers stay, these two are the
+    # slowest of the four near-identical bodies
+    "test_data_train.py::test_1f1b_trainer",
+    "test_data_train.py::test_autosave_on_stop_signal",
+    "test_data_train.py::test_trainer_generate_from_state",
+    # generation: the naive-reforward parity cases at family scale;
+    # test_generate.py keeps the base-model parity + pipelined parity
+    "test_generate.py::test_gpt2_greedy_generation_matches_naive_reforward",
+    "test_generate.py::test_beam_search_scores_are_consistent_and_beat_greedy",
+    "test_moe_gen.py::test_moe_greedy_generation_matches_naive_reforward",
+    "test_quant.py::test_quantized_decode_faithful_on_trained_model",
+    # zb split: the d=1 static-unroll duplicates (the [2-8] dynamic case
+    # and the smoke ids keep the split contract in tier 1)
+    "test_zb_split.py::test_zb_split_transparency[1-4]",
+    "test_auto_split.py::test_auto_split_transparency[zb-h1-1-4]",
+    # ------------------------------------------------------------------
+    # Expansion sized from a clean single-core duration profile
+    # (--durations=0, uncontended): the pre-expansion default run measured
+    # 1256s vs the 870s budget; the entries below cut ~478s of measured
+    # call time. Per entry, the coverage that stays in tier 1 is named.
+    #
+    # the ~60s cpu8 transport benchmark; test_overlap_transparency's
+    # 12-case parity matrix + the telemetry HLO pin stay
+    "test_overlap_transport.py::"
+    "test_quick_probe_reports_transport_side_by_side",
+    # mesh/interleaved BatchNorm: one case per axis layout stays
+    # (skip_interleaved, table_executor_bn smoke + gpipe, running stats,
+    # *_with_data_axis); these are the heavyweight grad-parity dupes
+    "test_norm.py::test_mesh_bn_data_axis_grads_match_emulator",
+    "test_norm.py::test_mesh_bn_training_grads_match_emulator[never]",
+    "test_norm.py::test_mesh_bn_training_grads_match_emulator[always]",
+    "test_norm.py::test_mesh_bn_interleaved_matches_emulator"
+    "[except_last-pp]",
+    "test_norm.py::test_mesh_bn_interleaved_matches_emulator"
+    "[except_last-ppxdp]",
+    "test_norm.py::test_mesh_bn_interleaved_matches_emulator[never-pp]",
+    "test_norm.py::test_mesh_bn_interleaved_matches_emulator"
+    "[never-ppxdp]",
+    "test_norm.py::test_table_executor_bn_matches_emulator[never-1f1b]",
+    # phased executor parity grid: smoke keeps [never-1f1b]/[never-zb-h1]/
+    # interleaved/rejection/front-door; [never-gpipe], skip_lanes[never],
+    # policy_ulp and pp_dp stay as the per-shape reps
+    "test_phase_compile.py::test_phased_bitwise_parity[except_last-gpipe]",
+    "test_phase_compile.py::test_phased_bitwise_parity[except_last-zb-h1]",
+    "test_phase_compile.py::test_phased_bitwise_parity[except_last-1f1b]",
+    "test_phase_compile.py::test_phased_bitwise_parity[always-gpipe]",
+    "test_phase_compile.py::test_phased_bitwise_parity[always-zb-h1]",
+    "test_phase_compile.py::test_phased_bitwise_parity[always-1f1b]",
+    "test_phase_compile.py::test_phased_bitwise_parity_skip_lanes"
+    "[except_last]",
+    "test_phase_compile.py::test_accepted_table_counts_and_gauges",
+    "test_phase_compile.py::test_uniform_probe_failure_warns_and_trains",
+    # serve: the smoke set keeps both-backend parity + the three queue
+    # liveness behaviours; generator_eos_masks / shape_cache_counters stay
+    "test_serve.py::test_serve_eos_retires_early",
+    "test_serve.py::test_chunked_decode_parity",
+    "test_serve.py::test_sampled_decode_parity",
+    "test_serve.py::test_pipelined_eos_matches_single_device",
+    # mesh Pipe grad parametrizations; smoke keeps [except_last] +
+    # skip_through_mesh, and the forward/uneven-matches-plain grid stays
+    "test_pipe_mesh.py::test_gradient_transparency_mesh[always]",
+    "test_pipe_mesh.py::test_gradient_transparency_mesh[never]",
+    "test_pipe_mesh.py::test_skip_gradients_through_mesh[never]",
+    "test_pipe_mesh.py::test_skip_gradients_through_mesh[always]",
+    "test_pipe_mesh.py::test_uneven_balance_mesh_gradients_match_emulator",
+    # table-executor loss/grad grid dupes: smoke keeps [except_last-1f1b];
+    # [never-gpipe]/[always-zb-h1]/[always-1f1b] + test_scheduled's own
+    # 65-case matrix keep every schedule x mode pairing in tier 1
+    "test_pipe_1f1b.py::test_loss_and_grad_transparency[always-gpipe]",
+    "test_pipe_1f1b.py::test_loss_and_grad_transparency[except_last-gpipe]",
+    "test_pipe_1f1b.py::test_loss_and_grad_transparency"
+    "[except_last-zb-h1]",
+    "test_pipe_1f1b.py::test_loss_and_grad_transparency[never-1f1b]",
+    "test_pipe_1f1b.py::test_skippable_interleaved"
+    "[except_last-same-device-lane]",
+    "test_pipe_1f1b.py::test_skippable_interleaved"
+    "[except_last-cross-device-lane]",
+    "test_pipe_1f1b.py::test_loss_and_grad_transparency[never-zb-h1]",
+    # heavyweight exactness dupe of the kept [2-8-except_last-1f1b]
+    # transparency smoke id
+    "test_scheduled.py::test_except_last_is_exact_per_microbatch",
+    # zoo trainers at family scale; *_matches_sequential/_plain + the
+    # embed-skip and loss-stat tests keep each family's math in tier 1
+    "test_model_zoo.py::test_gpt2_trains_through_scheduled_1f1b",
+    "test_model_zoo.py::test_bert_through_interleaved_1f1b",
+    # auto-split: smoke [zb-h1-2-8] + unit_parity_and_censuses +
+    # unused-param-leaf keep the structural-split contract; these are the
+    # bigger-table dupes and the whole-program HLO census
+    "test_auto_split.py::test_phased_auto_split_whole_program_census",
+    "test_auto_split.py::test_auto_split_transparency[zb-h1-4-4]",
+    "test_auto_split.py::test_auto_split_transparency[zb-h2-4-8]",
+    # ZeRO: the smoke loss-parity case keeps the optimizer contract;
+    # these assert sharding layout / dtype composition on top of it
+    "test_zero.py::test_zero_moments_are_data_sharded",
+    "test_zero.py::test_mu_dtype_bf16_composes_with_zero",
+    # pp x cp: gradient_flows is already slow; debug_context_check and
+    # the [2-2]/[2-4]/[4-2] forward params stay
+    "test_long_context.py::test_pp_cp_trains",
+    "test_long_context.py::test_pp_cp_forward_transparency[1-8]",
+    # context-sharded generation: two greedy + two beam params and the
+    # sampling-reproducibility case stay; beam dispatch is also covered
+    # by test_generate.py::test_beam_k1_path_and_generate_dispatch
+    "test_long_context_gen.py::"
+    "test_context_sharded_beam_generate_routes_to_beam",
+    "test_long_context_gen.py::"
+    "test_context_sharded_greedy_matches_single_device[2-2-16-6]",
+    "test_long_context_gen.py::"
+    "test_context_sharded_beam_matches_single_device[4-2-16-4-2]",
+    # parametrized dupes of kept siblings ([2-2] smoke tp case,
+    # gradient_parity[True], beam[2], ffn[1], spmd [except_last] smoke)
+    "test_tp.py::test_pp_tp_loss_and_grad_transparency[1-2]",
+    "test_ring_attention.py::test_gradient_parity[False]",
+    "test_tp_gen.py::test_tp_sharded_beam_matches_unsharded[4]",
+    "test_moe.py::test_moe_ffn_matches_unsharded[2]",
+    "test_spmd.py::test_gradient_transparency[never]",
+    "test_spmd.py::test_gradient_transparency[always]",
+    # quantized decode: beam_runs + the two unit tests keep int8 decode
+    # in tier 1; the faithful-decode e2e above is already slow
+    "test_quant.py::test_quantized_pipelined_decode_runs",
+    # signal-handling e2e; test_data_train's autosave_on_stop_signal
+    # (slow) is the same contract at trainer level, and the smoke
+    # resilience ids keep recovery in tier 1
+    "test_resilience.py::test_sigterm_autosave_resumes_next_step_bitwise",
+    # one grad param stays ([always]); forward grid + smoke forward stay
+    "test_interleaved.py::test_gradient_transparency[never]",
+    # event-file plumbing dupes: telemetry's trainer_emits_events_and_
+    # step_reports and tb's scalar_writer_roundtrip stay
+    "test_tb.py::test_trainer_emits_event_files",
+    "test_telemetry.py::test_uniform_fastpath_taken_and_gauged",
+    # cross-model parity dupe; ulysses_matches_ring + gradient_parity stay
+    "test_ulysses.py::test_pp_cp_ulysses_matches_ring_model",
+    # jit-sharding assertion; all generation-parity cases stay
+    "test_generate.py::test_data_parallel_generation_is_a_jit_sharding",
+}
+
+
 def pytest_collection_modifyitems(config, items):
+    overlap = _SLOW & _SMOKE
+    assert not overlap, f"smoke ids must not be slow-marked: {overlap}"
     found = set()
     for item in items:
         nodeid = item.nodeid.split("tests/")[-1]
         if nodeid in _SMOKE:
             item.add_marker(pytest.mark.smoke)
             found.add(nodeid)
+        if nodeid in _SLOW:
+            item.add_marker(pytest.mark.slow)
     # Enforce completeness PER FILE: a smoke nodeid must exist whenever
     # its file collected at all — catches renames without tripping on
     # legitimate partial runs (single files, --ignore, -k filters leave

@@ -1,0 +1,238 @@
+"""Auto-derived structural B/W split (``core.remat.split_backward_stage``).
+
+PR-3 hand-rolled the split for the TP block (``tp_split_backward_stage``);
+this generalization traces ANY ``stage_fn(params, h, ctx)`` and derives
+the same triple by jaxpr surgery: a tapped forward (bitwise equal to the
+plain one), a params-CONSTANT B vjp, and a contraction-only W. These
+tests pin the contract on the main model-zoo stage (``PipelinedLM`` —
+attention + MLP + dropout, nothing hand-annotated), the failure guards,
+and the phased whole-program HLO census for ``split_stage="auto"``."""
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.core import microbatch as mb
+from pipe_tpu.core.partition import StageCtx
+from pipe_tpu.core.remat import SplitUnsupported, split_backward_stage
+from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.scheduled import ScheduledPipeline
+from pipe_tpu.parallel.spmd import stack_stage_params
+
+
+def _cfg(n_layers, dropout=0.1):
+    return dataclasses.replace(
+        LMConfig().tiny(), n_layers=n_layers, dropout=dropout)
+
+
+def _grad_trees_close(got, exp):
+    for (ka, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(got),
+                               jax.tree_util.tree_leaves_with_path(exp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5, err_msg=str(ka))
+
+
+def test_auto_split_unit_parity_and_censuses():
+    """On the untouched PipelinedLM stage: tapped forward == plain
+    forward bitwise; B's gh and W's param grads match the fused vjp; the
+    COMPILED B contains zero weight-shaped dot outputs; the COMPILED W
+    contains no token-dimension dot outputs (contraction-only)."""
+    cfg = _cfg(2)
+    model = PipelinedLM(cfg, 2)
+    sp, _, _ = model.init(jax.random.key(0))
+    p = sp[0]
+    # batch=3: tokens = 3*seq_len = 48 collides with no weight dim, so a
+    # weight-SHAPED dot output can only be a weight-grad contraction
+    h = jax.random.normal(jax.random.key(1), (3, cfg.seq_len, cfg.d_model))
+    ctx = StageCtx(key=jax.random.key(7))
+    seed = jax.random.normal(jax.random.key(2), h.shape)
+
+    ref_out, ref_vjp = jax.vjp(
+        lambda pp, hh: model.stage_fn(pp, hh, ctx), p, h)
+    gp_ref, gh_ref = ref_vjp(seed)
+
+    split = split_backward_stage(model.stage_fn)
+    zs = split.zs_fn(p, h)
+    out, vjp_fn, taps = jax.vjp(
+        lambda hh, zz: split.tapped_fn(p, hh, ctx, zz), h, zs,
+        has_aux=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_out))
+    gh, gzs = vjp_fn(seed)
+    np.testing.assert_allclose(np.asarray(gh), np.asarray(gh_ref),
+                               rtol=1e-5, atol=1e-6)
+    gp = split.wgrad_fn(taps, gzs)
+    _grad_trees_close(gp, gp_ref)
+
+    weight_shapes = {tuple(l.shape)
+                     for _, l in jax.tree_util.tree_leaves_with_path(p)
+                     if l.ndim >= 2}
+    tokens = 3 * cfg.seq_len
+
+    hlo_b = jax.jit(lambda s: vjp_fn(s)).lower(seed).compile().as_text()
+    dots_b = re.findall(r"= f32\[([\d,]+)\][^ ]* dot\(", hlo_b)
+    assert dots_b, "census regex matched no dots at all — HLO drifted?"
+    bad_b = [d for d in dots_b
+             if tuple(int(x) for x in d.split(",")) in weight_shapes]
+    assert not bad_b, f"B compiled weight-grad-shaped matmuls: {bad_b}"
+
+    hlo_w = jax.jit(split.wgrad_fn).lower(taps, gzs).compile().as_text()
+    dots_w = re.findall(r"= f32\[([\d,]+)\][^ ]* dot\(", hlo_w)
+    assert dots_w, "W pass compiled no dots — not a contraction pass?"
+    bad_w = [d for d in dots_w
+             if tokens in tuple(int(x) for x in d.split(","))]
+    assert not bad_w, f"W compiled token-dim (activation) matmuls: {bad_w}"
+
+
+@pytest.mark.parametrize("schedule,n_stages,m",
+                         [("zb-h1", 1, 4), ("zb-h1", 2, 8),
+                          ("zb-h1", 4, 4), ("zb-h2", 4, 8)])
+def test_auto_split_transparency(schedule, n_stages, m):
+    """zb-h1/zb-h2 + split_stage="auto" on PipelinedLM: loss and every
+    grad leaf equal the fused-backward 1f1b run of the same params."""
+    cfg = _cfg(n_stages)
+    model = PipelinedLM(cfg, n_stages)
+    sp, prep, postp = model.init(jax.random.key(0))
+    stacked = stack_stage_params(sp)
+    tokens = jax.random.randint(jax.random.key(1), (2 * m, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    x, n_rows = mb.stack_scatter(
+        {"tokens": tokens, "targets": jnp.roll(tokens, -1, -1)}, m)
+    w = mb.valid_row_mask(x, n_rows)
+    mesh = make_mesh(n_stages, 1, devices=jax.devices()[:n_stages])
+
+    ref = ScheduledPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
+                            post_fn=model.loss_post_fn, checkpoint="never",
+                            schedule="1f1b")
+    l_ref, g_ref = jax.jit(ref.loss_and_grad)(
+        stacked, prep, postp, x, w, key=jax.random.key(9))
+
+    zb = ScheduledPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
+                           post_fn=model.loss_post_fn, checkpoint="never",
+                           schedule=schedule, split_stage="auto")
+    l_zb, g_zb = jax.jit(zb.loss_and_grad)(
+        stacked, prep, postp, x, w, key=jax.random.key(9))
+
+    np.testing.assert_allclose(float(l_zb), float(l_ref), rtol=1e-5)
+    for got, exp in zip(g_zb, g_ref):
+        _grad_trees_close(got, exp)
+
+
+def test_auto_split_unused_param_leaf_gets_zero_grad():
+    """A param leaf the stage never touches still appears in W's output
+    tree, as zeros — same contract as the fused vjp."""
+    def stage(p, h, ctx):
+        return jnp.tanh(h @ p["w"])
+
+    p = {"w": jax.random.normal(jax.random.key(0), (8, 8)),
+         "dead": jnp.ones((5,))}
+    h = jax.random.normal(jax.random.key(1), (3, 8))
+    ctx = StageCtx(key=jax.random.key(2))
+    seed = jnp.ones_like(h)
+
+    split = split_backward_stage(stage)
+    zs = split.zs_fn(p, h)
+    _, vjp_fn, taps = jax.vjp(
+        lambda hh, zz: split.tapped_fn(p, hh, ctx, zz), h, zs,
+        has_aux=True)
+    _, gzs = vjp_fn(seed)
+    gp = split.wgrad_fn(taps, gzs)
+    gp_ref, _ = jax.vjp(lambda pp, hh: stage(pp, hh, ctx), p, h)[1](seed)
+    np.testing.assert_array_equal(np.asarray(gp["dead"]),
+                                  np.zeros((5,)))
+    _grad_trees_close(gp, gp_ref)
+
+
+def test_auto_split_chain_free_fallback_for_cascaded_contractions():
+    """A region output whose only consumer is ANOTHER param contraction
+    ((h @ w1) @ w2 — the shape of the TP block's attention internals)
+    cannot chain through the W replay: the replayed product would be
+    param-dependent x param-dependent, which has no linear transpose.
+    The plan must detect this at build time and fall back to injecting
+    every region output, staying gradient-exact."""
+    def stage(p, h, ctx):
+        return (h @ p["w1"]) @ p["w2"]
+
+    p = {"w1": jax.random.normal(jax.random.key(0), (8, 8)),
+         "w2": jax.random.normal(jax.random.key(1), (8, 8))}
+    h = jax.random.normal(jax.random.key(2), (3, 8))
+    ctx = StageCtx(key=jax.random.key(3))
+    seed = jnp.ones_like(h)
+
+    split = split_backward_stage(stage)
+    zs = split.zs_fn(p, h)
+    assert len(zs) == 2, "both contractions must inject — nothing chained"
+    _, vjp_fn, taps = jax.vjp(
+        lambda hh, zz: split.tapped_fn(p, hh, ctx, zz), h, zs,
+        has_aux=True)
+    gh, gzs = vjp_fn(seed)
+    gp = split.wgrad_fn(taps, gzs)
+    gp_ref, gh_ref = jax.vjp(
+        lambda pp, hh: stage(pp, hh, ctx), p, h)[1](seed)
+    np.testing.assert_allclose(np.asarray(gh), np.asarray(gh_ref),
+                               rtol=1e-5, atol=1e-6)
+    _grad_trees_close(gp, gp_ref)
+
+
+def test_auto_split_rejects_param_only_output():
+    """A stage returning a params-derived value (no data dependence)
+    cannot split — B would need the params it is constant in."""
+    def bad(p, h, ctx):
+        return p["w"] * 2.0
+
+    p = {"w": jnp.ones((3, 4))}
+    h = jnp.ones((3, 4))
+    split = split_backward_stage(bad)
+    with pytest.raises(SplitUnsupported):
+        split.zs_fn(p, h)
+
+
+def test_auto_split_rejects_nonlinear_param_entry():
+    """Params must enter linearly up to the first param*data contraction
+    — ``h @ exp(w)`` has no linear-transpose W region."""
+    def bad(p, h, ctx):
+        return h @ jnp.exp(p["w"])
+
+    p = {"w": jnp.ones((4, 4))}
+    h = jnp.ones((3, 4))
+    split = split_backward_stage(bad)
+    with pytest.raises(SplitUnsupported):
+        split.zs_fn(p, h)
+
+
+def test_phased_auto_split_whole_program_census():
+    """Acceptance: zb-h1 + split_stage="auto" + phase_compile=True — the
+    phase program is accepted (fbw3 steady state) and the compiled
+    whole-program HLO contains ZERO dispatch conditionals (arity >= 3
+    ``conditional``s from lax.switch); role conditionals (arity 2) from
+    masking may remain."""
+    n_stages, m = 2, 4
+    cfg = _cfg(n_stages, dropout=0.0)
+    model = PipelinedLM(cfg, n_stages)
+    sp, prep, postp = model.init(jax.random.key(0))
+    stacked = stack_stage_params(sp)
+    tokens = jax.random.randint(jax.random.key(1), (2 * m, cfg.seq_len),
+                                0, cfg.vocab, jnp.int32)
+    x, n_rows = mb.stack_scatter(
+        {"tokens": tokens, "targets": jnp.roll(tokens, -1, -1)}, m)
+    w = mb.valid_row_mask(x, n_rows)
+    mesh = make_mesh(n_stages, 1, devices=jax.devices()[:n_stages])
+    pipe = ScheduledPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
+                             post_fn=model.loss_post_fn,
+                             checkpoint="never", schedule="zb-h1",
+                             split_stage="auto", phase_compile=True)
+    assert pipe._phase_program(m) is not None
+
+    hlo = jax.jit(pipe.loss_and_grad).lower(
+        stacked, prep, postp, x, w, key=jax.random.key(9)
+    ).compile().as_text()
+    dispatch = [g for g in re.findall(r"branch_computations=\{([^}]*)\}",
+                                      hlo)
+                if g.count(",") + 1 >= 3]
+    assert not dispatch, (
+        f"phased zb-h1 split program kept {len(dispatch)} dispatch "
+        f"conditionals")

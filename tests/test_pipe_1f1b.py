@@ -194,11 +194,29 @@ def test_jit_train_step_1f1b():
         upd, opt = tx.update(g, opt, pk)
         return optax.apply_updates(pk, upd), opt, loss
 
-    losses = []
+    ref_p = params
+    ref_opt = tx.init(params)
+
+    @jax.jit
+    def ref_step(p, opt):
+        def f(p):
+            return jnp.mean(mse_loss(seq.apply(p, x), y))
+        loss, g = jax.value_and_grad(f)(p)
+        upd, opt = tx.update(g, opt, p)
+        return optax.apply_updates(p, upd), opt, loss
+
+    losses, ref_losses = [], []
     for _ in range(30):
         packed, opt, loss = step(packed, opt)
+        ref_p, ref_opt, ref_loss = ref_step(ref_p, ref_opt)
         losses.append(float(loss))
-    assert losses[-1] < losses[0] / 3, (losses[0], losses[-1])
+        ref_losses.append(float(ref_loss))
+    # Trajectory parity with the UNPIPELINED reference under the same
+    # optimizer is the train-step property; a fixed "drops k-fold" bar on
+    # this tiny linear model is init-sensitive and says nothing about the
+    # pipeline. Progress still asserted so a frozen step can't pass.
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
 
 
 # ---------- validation ----------

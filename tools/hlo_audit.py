@@ -262,8 +262,8 @@ def _region_census(hlo: str, roots):
 
 
 def phases(n_stages: int = 4, chunks: int = 8, checkpoint: str = "never",
-           schedules=("1f1b", "zb-h1", "gpipe"), d_model: int = 64,
-           d_ff: int = 128, seq_len: int = 32) -> dict:
+           schedules=("1f1b", "zb-h1", "zb-h1-split", "gpipe"),
+           d_model: int = 64, d_ff: int = 128, seq_len: int = 32) -> dict:
     """Census of the PHASE-COMPILED program vs the interpreted executor.
 
     For each schedule, compiles one ``loss_and_grad`` step with
@@ -312,12 +312,19 @@ def phases(n_stages: int = 4, chunks: int = 8, checkpoint: str = "never",
            "checkpoint": checkpoint, "d_model": d_model, "programs": {}}
     violations = []
     for name in schedules:
+        # pseudo-schedule: "<name>-split" = the real schedule with the
+        # auto-derived structural B/W split (W ops dispatch through the
+        # same phased ramps/steady-state machinery)
+        sched_kw = {"schedule": name}
+        if name.endswith("-split"):
+            sched_kw = {"schedule": name[:-len("-split")],
+                        "split_stage": "auto"}
         row = {}
         for mode, phase in (("phased", True), ("interpreted", False)):
             pipe = ScheduledPipeline(
                 mesh, model.stage_fn, pre_fn=model.pre_fn,
                 post_fn=model.loss_post_fn, checkpoint=checkpoint,
-                schedule=name, phase_compile=phase)
+                phase_compile=phase, **sched_kw)
             hlo = jax.jit(
                 lambda s, pipe=pipe: pipe.loss_and_grad(s, prep, postp,
                                                         x, w)

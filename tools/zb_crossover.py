@@ -28,7 +28,8 @@ import time
 sys.path.insert(0, ".")
 
 
-def measure(n_stages: int, chunks_list, widths, iters: int = 4):
+def measure(n_stages: int, chunks_list, widths, iters: int = 4,
+            split: str = "auto"):
     """One (width, m) measurement row per combination — >= 2 distinct m
     values are what identify the per-cycle overhead in the fit (op counts
     scale with m; the fill/drain cycle surplus does not)."""
@@ -62,10 +63,17 @@ def measure(n_stages: int, chunks_list, widths, iters: int = 4):
             w = mb.valid_row_mask(x, n_rows)
             row = {"width": width, "m": chunks}
             for name, key_out in (("1f1b", "t_1f1b"), ("zb-h1", "t_zb")):
+                kw = {}
+                if name == "zb-h1" and split != "none":
+                    # the structural B/W split (params-constant B +
+                    # contraction-only W) is the real zb-h1 cost since the
+                    # auto split landed; --split none re-measures the
+                    # legacy stored-vjp path for trend comparison
+                    kw["split_stage"] = split
                 pipe = ScheduledPipeline(
                     mesh, model.stage_fn, pre_fn=model.pre_fn,
                     post_fn=model.loss_post_fn, checkpoint="never",
-                    schedule=name)
+                    schedule=name, **kw)
                 lg = jax.jit(lambda s_, pipe=pipe: pipe.loss_and_grad(
                     s_, prep, postp, x, w))
                 jax.block_until_ready(lg(sp))
@@ -87,10 +95,14 @@ def main(argv=None) -> int:
     # violating the linear cost model (the fit flags it with f <= 0)
     p.add_argument("--widths", default="64,128")
     p.add_argument("--iters", type=int, default=4)
+    p.add_argument("--split", default="auto", choices=("auto", "none"),
+                   help="zb-h1 backward: 'auto' = structural B/W split "
+                        "(the shipping path), 'none' = legacy stored-vjp")
     args = p.parse_args(argv)
     widths = [int(w) for w in args.widths.split(",")]
 
-    rows = measure(args.n, [args.m, 2 * args.m], widths, iters=args.iters)
+    rows = measure(args.n, [args.m, 2 * args.m], widths, iters=args.iters,
+                   split=args.split)
 
     from pipe_tpu.obs.zb_model import OpCosts, calibrate, crossover, predict
 
@@ -127,6 +139,7 @@ def main(argv=None) -> int:
         sweep.append(crossover(mm, nn, sigma))
 
     out = {
+        "split": args.split,
         "measurements": rows,
         "calibration": cal,
         "serialized_check": checks,

@@ -1,12 +1,17 @@
 """Head-to-head: 1f1b vs zb-h1 (legacy stored-vjp) vs zb-h1 structural
-split, same TP-block model, cpu8 virtual mesh.
+split (hand-rolled and auto-derived), same TP-block model, cpu8 mesh.
 
 The round-3 audit measured the legacy split at 1.70-1.83x 1f1b sec/step —
 both B and W execute the full stored transpose. The structural split
 (SplitBackwardStage) makes B params-constant and W contraction-only, so
 total compute returns to one backward per micro-batch; on the serialized
 single-core host the remaining gap vs 1f1b is extra cycles x machinery
-only. Prints one JSON line; committed as the honest zb-h1 cost record.
+only. The ``*-auto`` rows run the generalized jaxpr-surgery split
+(``core/remat.py``, ``split_stage="auto"``) with its residual
+passthrough dedup — weight leaves never ride the per-cycle slot store —
+and a zb-h2 (deep-warmup) row rides along. ``--quick`` is the trimmed
+variant ``bench.py`` embeds. Prints one JSON line; committed as the
+honest zb-h1 cost record.
 """
 
 from __future__ import annotations
@@ -18,7 +23,8 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-def main(n_stages=4, m=8, d_model=128, d_ff=512, seq_len=32, iters=3):
+def main(n_stages=4, m=8, d_model=128, d_ff=512, seq_len=32, iters=3,
+         quick=False):
     from pipe_tpu.utils.platform import force_cpu_platform
     force_cpu_platform(8)
 
@@ -53,9 +59,21 @@ def main(n_stages=4, m=8, d_model=128, d_ff=512, seq_len=32, iters=3):
         "zb-h1-legacy": dict(schedule="zb-h1"),
         "zb-h1-split": dict(schedule="zb-h1",
                             split_stage=tp_split_backward_stage(cfg)),
+        # auto-derived structural split (core/remat.py jaxpr surgery) —
+        # same table, no hand-rolled triple; the residual passthrough
+        # dedup (weights never ride the slot store) applies to both
+        "zb-h1-split-auto": dict(schedule="zb-h1", split_stage="auto"),
+        "zb-h2-split-auto": dict(schedule="zb-h2", split_stage="auto"),
     }
+    if quick:
+        # bench.py embed: skip the legacy row (its 1.6x story is already
+        # committed) and keep one hand-rolled + one auto split row
+        variants.pop("zb-h1-legacy")
+        iters = min(iters, 2)
     out = {"platform": "cpu8", "n_stages": n_stages, "chunks": m,
            "d_model": d_model, "variants": {}}
+    if quick:
+        out["mode"] = "quick-cpu8"
     for name, kw in variants.items():
         pipe = ScheduledPipeline(mesh, model.stage_fn, pre_fn=model.pre_fn,
                                  post_fn=model.loss_post_fn,
@@ -78,6 +96,9 @@ def main(n_stages=4, m=8, d_model=128, d_ff=512, seq_len=32, iters=3):
 if __name__ == "__main__":
     kw = {}
     for a in sys.argv[1:]:
+        if a == "--quick":
+            kw["quick"] = True
+            continue
         k, v = a.lstrip("-").split("=", 1)
         kw[k.replace("-", "_")] = int(v)
     print(json.dumps(main(**kw)))
