@@ -508,6 +508,26 @@ def main():
     except Exception as e:
         print(f"chaos probe failed: {e}", file=sys.stderr)
 
+    # Fleet probe: replica-count goodput scaling plus the kill-one-of-3
+    # failover proof (drop <= ~1/N, recovery, exactly-once ledger) —
+    # fleet_ok must stay true every round (quick mode of
+    # tools/fleet_bench.py; FLEET_r{N}.json is the full record).
+    fleet_summary = None
+    try:
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "fleet_bench.py"), "--quick"],
+            capture_output=True, text=True, timeout=900, env=env)
+        if out.returncode == 0:
+            fleet_summary = json.loads(out.stdout.strip().splitlines()[-1])
+        else:
+            print(f"fleet probe rc={out.returncode}: "
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"fleet probe failed: {e}", file=sys.stderr)
+
     trend_vs_prior = None
     try:
         trend_vs_prior = trend_vs_prior_round(here, bubble_multistage)
@@ -592,6 +612,7 @@ def main():
         "zb_split": zb_split_summary,
         "serve": serve_summary,
         "chaos": chaos_summary,
+        "fleet": fleet_summary,
         "trend_vs_prior": trend_vs_prior,
         "final_loss": round(loss, 4),
         "step_report": report.to_json(),

@@ -13,11 +13,17 @@ rejected counters, TTFT percentiles, queue-depth/occupancy gauges).
 ``--events`` additionally writes the request-span EventLog
 (docs/observability.md).
 
+``--replicas N`` (with ``--stages 1``) serves through the fleet
+:class:`~pipe_tpu.serve.Router` instead: N engine replicas behind one
+front queue with health-gated failover; the summary gains per-replica
+lines and a fleet rollup, and SIGTERM drains the whole fleet.
+
 Usage:
     python -m pipe_tpu.apps.serve [--resume DIR] [--requests N --rate R]
-        [--prompts-file F] [--slots S] [--stages N] [--eos ID]
-        [--queue-capacity C] [--policy fifo|priority] [--timeout-s T]
-        [--decode-chunk K] [--events F.jsonl] [--tiny] [--cpu N]
+        [--prompts-file F] [--slots S] [--stages N] [--replicas N]
+        [--eos ID] [--queue-capacity C] [--policy fifo|priority]
+        [--timeout-s T] [--decode-chunk K] [--events F.jsonl] [--tiny]
+        [--cpu N]
 """
 
 from __future__ import annotations
@@ -50,6 +56,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--eos", type=int, default=None)
     p.add_argument("--stages", type=int, default=1,
                    help=">1: serve through the pipeline ring")
+    p.add_argument("--replicas", type=int, default=1,
+                   help=">1: run N engine replicas behind the fleet "
+                        "Router (health-gated failover; single-device "
+                        "backend only)")
     p.add_argument("--slots", type=int, default=4,
                    help="decode slots (single-device backend; the ring "
                         "always has one slot per stage)")
@@ -103,6 +113,11 @@ def main(argv=None) -> int:
     if model_cfg.n_layers % n_stages:
         print(f"--stages {n_stages} must divide the model's "
               f"{model_cfg.n_layers} layers", file=sys.stderr)
+        return 2
+    replicas = max(args.replicas, 1)
+    if replicas > 1 and n_stages > 1:
+        print("--replicas > 1 requires --stages 1 (the fleet router "
+              "shards single-device engines)", file=sys.stderr)
         return 2
 
     if args.prompts_file:
@@ -167,17 +182,43 @@ def main(argv=None) -> int:
             gen=gen_cfg, buckets=buckets, decode_chunk=args.decode_chunk)
 
     events = EventLog(args.events) if args.events else NULL_EVENT_LOG
-    queue = RequestQueue(capacity=args.queue_capacity,
-                         policy=args.policy)
-    watchdog = None
-    if args.tick_budget_s is not None or args.shed_ewma is not None:
+
+    def _make_watchdog():
+        if args.tick_budget_s is None and args.shed_ewma is None:
+            return None
         from ..resilience import TickWatchdog
-        watchdog = TickWatchdog(tick_budget_s=args.tick_budget_s,
-                                shed_ewma_threshold=args.shed_ewma)
-    eng = ServeEngine(backend, queue, event_log=events, watchdog=watchdog)
+        return TickWatchdog(tick_budget_s=args.tick_budget_s,
+                            shed_ewma_threshold=args.shed_ewma)
+
+    if replicas > 1:
+        # fleet path: one front queue, N engines each with its own
+        # queue/watchdog, the Router in between. The single-replica path
+        # below stays byte-for-byte what it was — Router absent means
+        # zero overhead.
+        from ..serve import Router, SingleDeviceSlotBackend
+        backends = [backend] + [
+            SingleDeviceSlotBackend(
+                model, params, num_slots=args.slots, max_len=max_len,
+                gen=gen_cfg, buckets=buckets,
+                decode_chunk=args.decode_chunk)
+            for _ in range(replicas - 1)]
+        engines = [ServeEngine(b,
+                               RequestQueue(capacity=args.queue_capacity),
+                               event_log=events,
+                               watchdog=_make_watchdog())
+                   for b in backends]
+        queue = RequestQueue(capacity=args.queue_capacity,
+                             policy=args.policy)
+        eng = Router(engines, queue, event_log=events)
+    else:
+        queue = RequestQueue(capacity=args.queue_capacity,
+                             policy=args.policy)
+        eng = ServeEngine(backend, queue, event_log=events,
+                          watchdog=_make_watchdog())
 
     # Graceful drain on SIGTERM/SIGINT: live slots finish, queued work is
     # shed back to callers, new admissions stop — then a clean summary.
+    # With --replicas this drains the WHOLE fleet (every engine).
     import signal as _signal
 
     def _drain_handler(signum, frame):
@@ -230,12 +271,22 @@ def main(argv=None) -> int:
 
     snap = {k: v for k, v in get_registry().scalars().items()
             if k.startswith(("serve.", "resilience."))}
-    print(json.dumps({"summary": {
-        "backend": type(backend).__name__,
+    summary = {
+        "backend": (f"Router({type(backend).__name__} x {replicas})"
+                    if replicas > 1 else type(backend).__name__),
         "finished": done, "rejected": rejected,
         "drained": eng.draining,
         "elapsed_s": round(elapsed, 3),
-        "buckets": list(buckets.lengths), "metrics": snap}}))
+        "buckets": list(buckets.lengths), "metrics": snap}
+    if replicas > 1:
+        summary["fleet"] = {
+            "rollup": eng.counts(),
+            "per_replica": [
+                {"replica": rep.index, "state": rep.state,
+                 "queue_depth": rep.engine.queue.depth,
+                 "live_slots": rep.engine.live_slots}
+                for rep in eng.replicas]}
+    print(json.dumps({"summary": summary}))
     events.close()
     return 0
 

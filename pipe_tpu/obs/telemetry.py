@@ -35,9 +35,23 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "EwmaTimer", "Histogram", "MetricsRegistry",
     "StepReport", "get_registry", "set_registry", "null_registry",
-    "percentile_exact",
+    "labelled", "percentile_exact",
     "train_flops_per_token", "peak_flops_per_chip", "device_memory_peaks",
 ]
+
+
+def labelled(name: str, **labels) -> str:
+    """Canonical labelled-instrument name: ``name{k=v,k2=v2}`` with keys
+    sorted, so every call site derives the same registry key. The
+    registry itself stays flat (one instrument per string) — labels are
+    a *naming convention*, which keeps the null-registry fast path and
+    the ``scalars()`` dump untouched while letting fleet consumers
+    filter per-replica series by prefix (e.g.
+    ``serve.fleet.replica.queue_depth{replica=2}``)."""
+    if not labels:
+        return name
+    body = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{body}}}"
 
 
 # --------------------------------------------------------------------------

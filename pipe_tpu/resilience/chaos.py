@@ -26,6 +26,15 @@ Injection sites, by fault kind:
 ``queue_flood``     the serve queue force-filled to capacity with junk
 ``backend_raise``   :class:`ChaosError` raised at the next backend
                     prefill (exercises the slot-error containment path)
+``wedge_replica``   router fleet: replica ``stage``'s decode raises
+                    :class:`ChaosError` while the fault covers the tick
+                    (transient wedge — clears when the window ends)
+``kill_replica``    router fleet: replica ``stage``'s decode raises
+                    permanently from ``step`` onward (the replica never
+                    comes back; the router must fail work over)
+``slow_replica``    router fleet: replica ``stage``'s decode sleeps
+                    ``magnitude`` seconds per tick while covered (the
+                    watchdog sees the overrun; drives SUSPECT)
 ==================  =======================================================
 
 Train-step faults ride a *traced* ``inject`` code (one int32 scalar
@@ -59,7 +68,9 @@ TRAIN_KINDS = ("nan_grads", "inf_grads", "nan_loss", "loss_spike",
 DATA_KINDS = ("data_raise",)
 TRANSPORT_KINDS = ("transport_drop", "transport_corrupt")
 SERVE_KINDS = ("stall_tick", "queue_flood", "backend_raise")
-KINDS = TRAIN_KINDS + DATA_KINDS + TRANSPORT_KINDS + SERVE_KINDS
+REPLICA_KINDS = ("wedge_replica", "kill_replica", "slow_replica")
+KINDS = TRAIN_KINDS + DATA_KINDS + TRANSPORT_KINDS + SERVE_KINDS \
+    + REPLICA_KINDS
 
 # Traced inject codes (the int32 scalar argument of the guarded step).
 INJECT_NONE = 0
@@ -170,6 +181,27 @@ class ChaosPlan:
         if kind not in SERVE_KINDS:
             raise ValueError(f"{kind!r} is not a serve fault kind")
         return self.active(kind, tick)
+
+    # -- router fleet -------------------------------------------------------
+
+    def replica_fault(self, kind: str, tick: int,
+                      replica: int) -> Optional[Fault]:
+        """The first ``kind`` fault hitting ``replica`` (addressed via
+        ``Fault.stage``) at router tick ``tick``. ``kill_replica`` is
+        permanent — it matches every tick from ``step`` onward, however
+        small ``count`` is; a killed replica never recovers."""
+        if kind not in REPLICA_KINDS:
+            raise ValueError(f"{kind!r} is not a replica fault kind; "
+                             f"one of {REPLICA_KINDS}")
+        for f in self.faults:
+            if f.kind != kind or f.stage != replica:
+                continue
+            if kind == "kill_replica":
+                if tick >= f.step:
+                    return f
+            elif f.covers(tick):
+                return f
+        return None
 
     def flood_prompt(self, i: int) -> list:
         """Deterministic junk prompt ``i`` for queue_flood (content from

@@ -71,12 +71,31 @@ class TickWatchdog:
     ``shed_ewma_threshold`` — deadline-miss EWMA (per retirement,
     ``shed_ewma_alpha`` horizon) above which the engine enters degraded
     mode and sheds lowest-priority queued requests; None disables.
+
+    Beyond the thresholds, the watchdog is the engine's health ledger:
+    the engine feeds every verdict back through ``record_tick`` /
+    ``record_outcome`` / ``record_stuck``, and the read-only properties
+    (``miss_ewma``, ``slow_streak``, ``slow_ticks``, ``stuck_slots``,
+    ``last_tick_s``) are the public health surface the fleet router's
+    state machine consumes — no reaching into engine privates, and the
+    signals are all host-side bookkeeping the engine already computed
+    (never an extra device sync). One watchdog instance per engine.
     """
 
     tick_budget_s: Optional[float] = None
     stuck_slack_ticks: Optional[int] = 8
     shed_ewma_threshold: Optional[float] = None
     shed_ewma_alpha: float = 0.1
+    _miss_ewma: float = dataclasses.field(default=0.0, init=False,
+                                          repr=False, compare=False)
+    _slow_streak: int = dataclasses.field(default=0, init=False,
+                                          repr=False, compare=False)
+    _slow_ticks: int = dataclasses.field(default=0, init=False,
+                                         repr=False, compare=False)
+    _stuck_slots: int = dataclasses.field(default=0, init=False,
+                                          repr=False, compare=False)
+    _last_tick_s: float = dataclasses.field(default=0.0, init=False,
+                                            repr=False, compare=False)
 
     def __post_init__(self):
         if self.tick_budget_s is not None and self.tick_budget_s <= 0:
@@ -100,3 +119,61 @@ class TickWatchdog:
             return None
         need = math.ceil(max_new_tokens / max(decode_chunk, 1))
         return need + self.stuck_slack_ticks
+
+    # -- recording (engine-side feed) ---------------------------------------
+
+    def record_tick(self, duration_s: float) -> bool:
+        """Fold one tick's wall clock. Returns True when the tick blew
+        ``tick_budget_s`` (always False with the budget disabled);
+        consecutive overruns accumulate in ``slow_streak``, a healthy
+        tick resets it."""
+        self._last_tick_s = float(duration_s)
+        over = self.tick_budget_s is not None \
+            and duration_s > self.tick_budget_s
+        if over:
+            self._slow_ticks += 1
+            self._slow_streak += 1
+        else:
+            self._slow_streak = 0
+        return over
+
+    def record_outcome(self, missed_deadline: bool) -> float:
+        """Fold one served retirement into the deadline-miss EWMA
+        (``shed_ewma_alpha`` horizon) and return the new value. Only
+        *served* outcomes (ok/timeout) belong here — shed work is the
+        response to misses and must not latch degraded mode."""
+        miss = 1.0 if missed_deadline else 0.0
+        a = self.shed_ewma_alpha
+        self._miss_ewma = a * miss + (1.0 - a) * self._miss_ewma
+        return self._miss_ewma
+
+    def record_stuck(self) -> None:
+        """Count one stuck-slot retirement."""
+        self._stuck_slots += 1
+
+    # -- read-only health surface (what the router consumes) ----------------
+
+    @property
+    def miss_ewma(self) -> float:
+        """Deadline-miss EWMA over served retirements."""
+        return self._miss_ewma
+
+    @property
+    def slow_streak(self) -> int:
+        """Consecutive ticks over ``tick_budget_s`` (0 = on budget)."""
+        return self._slow_streak
+
+    @property
+    def slow_ticks(self) -> int:
+        """Total ticks over budget since construction."""
+        return self._slow_ticks
+
+    @property
+    def stuck_slots(self) -> int:
+        """Total stuck-slot retirements since construction."""
+        return self._stuck_slots
+
+    @property
+    def last_tick_s(self) -> float:
+        """Wall-clock duration of the most recent tick."""
+        return self._last_tick_s

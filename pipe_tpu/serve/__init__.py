@@ -10,15 +10,22 @@ prompt-length shapes. Two slot backends:
 :class:`~.engine.SingleDeviceSlotBackend` (replicated weights, S
 arbitrary) and :class:`~.ring.RingSlotBackend` (stage-sharded weights —
 slots are the pipeline ring's request groups, kept continuously full
-across admissions/retirements). See ``docs/serving.md`` ("Online
-serving") and ``apps/serve.py`` for the driver.
+across admissions/retirements). At fleet scale, :class:`~.router.Router`
+shards one front queue across N engine replicas with health-gated
+failover, retry budgets, and exactly-once response delivery. See
+``docs/serving.md`` ("Online serving" / "Fleet serving") and
+``apps/serve.py`` for the driver.
 """
 
 from .buckets import BucketSpec
 from .engine import EngineDraining, ServeEngine, SingleDeviceSlotBackend
 from .queue import QueueFull, Request, RequestQueue, Response
 from .ring import RingSlotBackend
+from .router import (DRAINING, HEALTHY, RETIRED, SUSPECT, WEDGED, Replica,
+                     Router, RouterPolicy)
 
 __all__ = ["BucketSpec", "ServeEngine", "SingleDeviceSlotBackend",
            "RingSlotBackend", "QueueFull", "Request", "RequestQueue",
-           "Response", "EngineDraining"]
+           "Response", "EngineDraining", "Router", "RouterPolicy",
+           "Replica", "HEALTHY", "SUSPECT", "WEDGED", "DRAINING",
+           "RETIRED"]

@@ -399,6 +399,25 @@ class ServeEngine:
         reg.gauge("serve.engine.queue_depth").set(self.queue.depth)
         return req
 
+    def place(self, req: Request) -> Request:
+        """Router placement: admit an EXISTING :class:`~.queue.Request`
+        into this engine's queue, preserving its id, arrival and
+        deadline (no new deadline credit) and counting the placement in
+        ``req.attempts`` — the router's retry-budget ledger. Raises
+        like ``submit`` (:class:`EngineDraining`, ``ValueError``,
+        :class:`~.queue.QueueFull`)."""
+        reg = get_registry()
+        if self._draining:
+            raise EngineDraining(
+                "engine is draining: live requests are finishing and no "
+                "new work is admitted")
+        self.backend.validate(len(req.prompt), req.max_new_tokens)
+        self.queue.requeue(req)
+        req.attempts += 1
+        reg.counter("serve.engine.placed").inc()
+        reg.gauge("serve.engine.queue_depth").set(self.queue.depth)
+        return req
+
     def cancel(self, request_id: int) -> bool:
         return self.queue.cancel(request_id)
 
@@ -413,6 +432,14 @@ class ServeEngine:
     def idle(self) -> bool:
         return self.live_slots == 0 and self.queue.depth == 0
 
+    @property
+    def consecutive_decode_errors(self) -> int:
+        """Consecutive failed decode ticks (reset by any success) — a
+        fleet-health signal the router reads alongside the watchdog
+        properties; ``decode_error_limit`` of these retires the live
+        set."""
+        return self._decode_errors
+
     # -- graceful drain ------------------------------------------------------
 
     def drain(self) -> None:
@@ -424,6 +451,21 @@ class ServeEngine:
             self._draining = True
             self.events.event("resilience", action="drain",
                               live=self.live_slots, queued=self.queue.depth)
+
+    def evict_queued(self) -> List[Request]:
+        """Remove and return this engine's queued requests INTACT — no
+        terminal record, no status change — so a router can re-place
+        them on a healthy replica. Live slots are untouched. Contrast
+        :meth:`drain`, which sheds queued work terminally
+        (``finish_reason="drain"``)."""
+        evicted = self.queue.evict_all()
+        if evicted:
+            reg = get_registry()
+            reg.counter("serve.engine.evicted").inc(len(evicted))
+            reg.gauge("serve.engine.queue_depth").set(self.queue.depth)
+            self.events.event("resilience", action="evict_queued",
+                              count=len(evicted))
+        return evicted
 
     @property
     def draining(self) -> bool:
@@ -450,13 +492,10 @@ class ServeEngine:
         elif resp.status == "shed":
             reg.counter("serve.engine.shed").inc()
         wd = self.watchdog
-        if wd is not None and wd.shed_ewma_threshold is not None \
-                and resp.status in ("ok", "timeout"):
+        if wd is not None and resp.status in ("ok", "timeout"):
             # only served outcomes move the deadline-miss EWMA: shedding
             # is the *response* to misses and must not latch degraded mode
-            miss = 1.0 if resp.status == "timeout" else 0.0
-            a = wd.shed_ewma_alpha
-            self._miss_ewma = a * miss + (1.0 - a) * self._miss_ewma
+            self._miss_ewma = wd.record_outcome(resp.status == "timeout")
             reg.gauge("resilience.deadline_miss_ewma").set(self._miss_ewma)
         self.events.event(
             REQUEST, request=resp.request_id, status=resp.status,
@@ -562,6 +601,7 @@ class ServeEngine:
                 limit = wd.stuck_after(st.req.max_new_tokens, chunk)
                 if tick_idx - st.admitted_tick >= limit:
                     reg.counter("resilience.stuck_slots").inc()
+                    wd.record_stuck()
                     self.events.event("resilience", action="stuck_slot",
                                       request=st.req.id, slot=slot,
                                       age_ticks=tick_idx - st.admitted_tick)
@@ -652,8 +692,7 @@ class ServeEngine:
             self.live_slots / self.backend.num_slots)
         dur = self.clock() - t_start
         reg.gauge("resilience.tick_sec").set(dur)
-        if wd is not None and wd.tick_budget_s is not None \
-                and dur > wd.tick_budget_s:
+        if wd is not None and wd.record_tick(dur):
             reg.counter("resilience.watchdog_slow_ticks").inc()
             self.events.event("resilience", action="slow_tick",
                               tick=tick_idx, duration_s=dur,

@@ -55,7 +55,11 @@ class Request:
     ``max_new_tokens`` caps this request below the engine-wide limit;
     ``seed`` drives the per-request sampling key chain; ``deadline`` is
     absolute in the queue's clock domain (set from ``timeout_s`` at
-    submit)."""
+    submit). ``attempts`` counts placements onto an engine replica —
+    the router's retry budget; a request served directly by one engine
+    keeps it at 0. ``submitted_at`` and ``deadline`` are set exactly
+    once, at the original submit: a failed-over request keeps them
+    through every re-queue, so it never regains deadline credit."""
 
     id: int
     prompt: List[int]
@@ -65,6 +69,7 @@ class Request:
     deadline: Optional[float] = None
     submitted_at: float = 0.0
     cancelled: bool = False
+    attempts: int = 0
 
 
 @dataclasses.dataclass
@@ -73,8 +78,10 @@ class Response:
     | ``cancelled`` | ``error`` (backend failure or stuck slot) |
     ``shed`` (pushed back unserved — degraded mode or drain).
     ``finish_reason``: ``eos`` | ``length`` | ``deadline`` |
-    ``cancelled`` | ``backend_error`` | ``stuck`` | ``shed`` | ``drain``.
-    ``tokens`` holds whatever was generated
+    ``cancelled`` | ``backend_error`` | ``stuck`` | ``shed`` | ``drain``
+    | ``retries_exhausted`` (router: retry budget spent on retryable
+    backend failures) | ``no_replicas`` (router: no replica can ever
+    serve again). ``tokens`` holds whatever was generated
     before the request finished (possibly empty when it never reached a
     slot). ``ttft`` is first-token latency (None when no token was
     produced); ``latency`` is submit-to-retire."""
@@ -142,6 +149,34 @@ class RequestQueue:
         self._by_id[req.id] = req
         return req
 
+    def requeue(self, req: Request) -> Request:
+        """Re-enqueue an EXISTING request (router placement/failover),
+        preserving its identity: id, ``submitted_at`` and ``deadline``
+        are untouched, so a failed-over request keeps its original
+        arrival and never regains deadline credit. Raises
+        :class:`QueueFull` at capacity, exactly like ``submit``."""
+        if len(self._waiting) >= self.capacity:
+            age = self.oldest_age()
+            raise QueueFull(
+                f"admission queue at capacity (depth "
+                f"{len(self._waiting)}/{self.capacity}) re-queueing "
+                f"request {req.id}",
+                depth=len(self._waiting), capacity=self.capacity,
+                oldest_age_s=age)
+        self._waiting.append(req)
+        self._by_id[req.id] = req
+        return req
+
+    def evict_all(self) -> List[Request]:
+        """Remove and return every queued request INTACT — no terminal
+        record, no status change. The router uses this to reclaim a
+        wedged replica's backlog for re-placement; contrast
+        ``shed_lowest``/``reap``, which end the requests they remove."""
+        evicted, self._waiting = self._waiting, []
+        for req in evicted:
+            self._by_id.pop(req.id, None)
+        return evicted
+
     def cancel(self, request_id: int) -> bool:
         """Mark a queued or running request cancelled. Returns False for
         unknown/already-retired ids."""
@@ -183,13 +218,19 @@ class RequestQueue:
     def shed_lowest(self, n: int) -> List[Request]:
         """Degraded-mode load shedding: remove and return up to ``n``
         queued requests, lowest ``priority`` first (ties: youngest
-        first — the oldest of a priority level has waited longest and
-        keeps its place). Used by the engine when the deadline-miss
-        EWMA crosses its threshold and during drain."""
+        arrival first — the oldest of a priority level has waited
+        longest and keeps its place; exact-arrival ties fall to the
+        highest ``id``). The key is ``(priority, arrival, id)`` — pure
+        request identity, never list position — so the shed set is
+        deterministic even after router re-queues reorder the backing
+        list. Used by the engine when the deadline-miss EWMA crosses
+        its threshold and during drain."""
         if n < 1 or not self._waiting:
             return []
         order = sorted(range(len(self._waiting)),
-                       key=lambda i: (self._waiting[i].priority, -i))
+                       key=lambda i: (self._waiting[i].priority,
+                                      -self._waiting[i].submitted_at,
+                                      -self._waiting[i].id))
         drop = set(order[:n])
         shed = [self._waiting[i] for i in sorted(drop)]
         self._waiting = [r for i, r in enumerate(self._waiting)

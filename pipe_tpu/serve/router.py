@@ -1,0 +1,707 @@
+"""Fleet front door: N serve-engine replicas behind one request queue.
+
+One :class:`~.engine.ServeEngine` is S slots on one device (or one
+ring); the north star serves heavy traffic, which means N replicas and
+the question PR 5 left open: what happens when one of them wedges? The
+:class:`Router` answers it the same way the rest of the stack answers
+everything — host-side table maintenance over signals the hot path
+already produces:
+
+* **One front queue, N replica queues.** Callers submit to the router's
+  bounded :class:`~.queue.RequestQueue` (ids are fleet-unique — replica
+  queues never mint ids); each tick the router places waiting requests
+  onto HEALTHY replicas, least-loaded or session-affine. Deadlines,
+  priorities and cancellation ride the *same* :class:`~.queue.Request`
+  object end-to-end: ``submitted_at``/``deadline`` are set once at
+  submit and survive every re-queue, so a failed-over request never
+  regains deadline credit, and ``cancel`` is one flag flip wherever the
+  request currently sits (front, parked for retry, replica queue, or a
+  live slot).
+
+* **A health state machine per replica**, driven entirely by signals
+  the engines already export — the :class:`~..resilience.TickWatchdog`
+  read-only surface (``slow_streak``, ``miss_ewma``) plus
+  ``ServeEngine.consecutive_decode_errors`` and retryable-failure
+  responses. No extra device syncs: health is decided from host
+  bookkeeping, keeping the per-replica hot path as host-free as the SET
+  stream-event-triggered direction demands. States::
+
+      HEALTHY --(slow streak / decode error / retryable failure)--> SUSPECT
+      SUSPECT --(recover_healthy_ticks clean ticks)--> HEALTHY
+      HEALTHY|SUSPECT --(wedge thresholds)--> WEDGED
+      WEDGED --(queued work evicted, drain() issued)--> DRAINING
+      DRAINING --(engine.drained)--> RETIRED
+
+  SUSPECT only stops *placement* (hysteresis: transient stalls must not
+  flap work across the fleet); WEDGED is one-way — the replica's queued
+  requests are reclaimed intact (``evict_queued``) and its live slots
+  run out under ``drain()``.
+
+* **Retry budgets, not retry storms.** A request bounced by a wedged or
+  erroring replica (``finish_reason`` ``backend_error``/``stuck``) is
+  parked with exponential backoff (``backoff_base_s * 2^(attempts-1)``,
+  capped) and re-placed on a healthy replica while
+  ``attempts < retry_budget`` (attempts counts placements). Budget
+  exhausted → one terminal ``status="error"`` /
+  ``finish_reason="retries_exhausted"`` response. Every submitted id
+  yields **exactly one** terminal :class:`~.queue.Response` through the
+  router — a duplicate delivery raises, and ``tests/test_router.py``
+  pins the exactly-once ledger under ``kill_replica`` chaos.
+
+* **Lifecycle**: ``spawn_fn`` adds a replica after the front queue sits
+  at ``spawn_depth`` for ``spawn_sustain_ticks`` consecutive ticks;
+  ``retire_idle_ticks`` drains replicas the traffic no longer needs
+  (never below ``min_replicas``). Both are host decisions between
+  ticks; compiled programs are untouched.
+
+The router is strictly additive: not constructing one changes nothing
+anywhere (``apps/serve.py`` keeps the direct single-engine path, and
+the engines' decode HLO is byte-identical — same opt-out-is-absent
+discipline as the resilience layer). Single-threaded like the engine
+tick loop; replica chaos (``wedge_replica``/``kill_replica``/
+``slow_replica``) wraps the replica backends only when a
+:class:`~..resilience.ChaosPlan` is passed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..obs.events import NULL_EVENT_LOG, REQUEST
+from ..obs.telemetry import get_registry, labelled
+from .engine import EngineDraining, ServeEngine
+from .queue import QueueFull, Request, RequestQueue, Response
+
+__all__ = ["Router", "RouterPolicy", "Replica",
+           "HEALTHY", "SUSPECT", "WEDGED", "DRAINING", "RETIRED"]
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+WEDGED = "wedged"
+DRAINING = "draining"
+RETIRED = "retired"
+STATES = (HEALTHY, SUSPECT, WEDGED, DRAINING, RETIRED)
+_STATE_CODE = {s: i for i, s in enumerate(STATES)}
+
+# Engine finish_reasons the router may retry on another replica; every
+# other terminal outcome is delivered as-is.
+RETRYABLE_REASONS = ("backend_error", "stuck")
+
+
+@dataclasses.dataclass
+class RouterPolicy:
+    """Fleet policy knobs. Defaults are deliberately conservative —
+    quick to stop placing on a sick replica (SUSPECT is cheap: work
+    just goes elsewhere), slow to wedge (WEDGED is one-way).
+
+    ``placement`` — ``least_loaded`` picks the replica with the fewest
+    queued+live requests (ties: lowest index); ``session`` pins each
+    ``session`` key to its first replica while that replica is HEALTHY
+    (KV-cache/prefix locality for multi-turn traffic) and falls back to
+    least-loaded — remapping the session — when it isn't.
+
+    ``retry_budget`` — max *placements* per request (``Request.attempts``
+    is the ledger); a retryable failure at ``attempts >= retry_budget``
+    is terminal. ``backoff_base_s``/``backoff_max_s`` shape the parked
+    delay ``min(base * 2^(attempts-1), max)``; base 0 retries on the
+    next tick (what deterministic fake-clock tests want — a parked
+    request is only eligible once the queue clock passes its delay).
+
+    SUSPECT triggers: ``suspect_slow_streak`` consecutive over-budget
+    ticks (watchdog), any decode error, any retryable failure this
+    tick, or ``suspect_miss_ewma`` (None disables the EWMA trigger).
+    ``recover_healthy_ticks`` clean ticks clear SUSPECT. WEDGE
+    triggers: ``wedge_slow_streak`` consecutive slow ticks,
+    ``wedge_decode_errors`` consecutive decode errors (keep it below
+    the engine's ``decode_error_limit``, which resets the streak), or
+    ``wedge_error_ticks`` *cumulative* ticks that produced retryable
+    failures (catches prefill-side death, where no decode streak ever
+    forms).
+
+    Lifecycle: ``spawn_depth``/``spawn_sustain_ticks``/``max_replicas``
+    gate the spawn hook; ``retire_idle_ticks``/``min_replicas`` gate
+    idle retirement (None disables).
+    """
+
+    placement: str = "least_loaded"
+    retry_budget: int = 3
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    suspect_slow_streak: int = 2
+    suspect_miss_ewma: Optional[float] = None
+    recover_healthy_ticks: int = 3
+    wedge_slow_streak: int = 6
+    wedge_decode_errors: int = 2
+    wedge_error_ticks: int = 3
+    spawn_depth: Optional[int] = None
+    spawn_sustain_ticks: int = 10
+    max_replicas: int = 8
+    retire_idle_ticks: Optional[int] = None
+    min_replicas: int = 1
+
+    def __post_init__(self):
+        if self.placement not in ("least_loaded", "session"):
+            raise ValueError(
+                f"placement must be least_loaded|session, got "
+                f"{self.placement!r}")
+        if self.retry_budget < 1:
+            raise ValueError(
+                f"retry_budget must be >= 1, got {self.retry_budget}")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("backoff seconds must be >= 0")
+        for fld in ("suspect_slow_streak", "recover_healthy_ticks",
+                    "wedge_slow_streak", "wedge_decode_errors",
+                    "wedge_error_ticks", "spawn_sustain_ticks",
+                    "max_replicas", "min_replicas"):
+            if getattr(self, fld) < 1:
+                raise ValueError(f"{fld} must be >= 1")
+
+
+class Replica:
+    """Router-side record of one engine replica: health state plus the
+    hysteresis counters the state machine runs on."""
+
+    __slots__ = ("index", "engine", "state", "healthy_streak",
+                 "idle_ticks", "error_ticks", "had_error_this_tick")
+
+    def __init__(self, index: int, engine: ServeEngine):
+        self.index = index
+        self.engine = engine
+        self.state = HEALTHY
+        self.healthy_streak = 0
+        self.idle_ticks = 0
+        self.error_ticks = 0          # cumulative ticks with retryable fails
+        self.had_error_this_tick = False
+
+    @property
+    def load(self) -> int:
+        return self.engine.queue.depth + self.engine.live_slots
+
+    def __repr__(self) -> str:
+        return (f"Replica({self.index}, state={self.state}, "
+                f"load={self.load})")
+
+
+class Router:
+    """Shard one front :class:`~.queue.RequestQueue` across N
+    :class:`~.engine.ServeEngine` replicas with health-gated failover.
+
+    ``engines`` must be homogeneous (same model/buckets/caps — admission
+    validation uses replica 0's backend) and each must own its own
+    queue on the *same clock* as the front queue. ``spawn_fn`` (if
+    given) builds one more engine on demand for the spawn hook.
+    ``chaos`` arms replica-level fault injection
+    (:data:`~..resilience.chaos.REPLICA_KINDS`, addressed by
+    ``Fault.stage`` = replica index); None leaves the backends
+    untouched.
+
+    The surface mirrors :class:`~.engine.ServeEngine` — ``submit`` /
+    ``tick`` / ``cancel`` / ``response`` / ``drain`` / ``idle`` /
+    ``run_until_idle`` — so drivers (``apps/serve.py``) swap one for
+    the other without restructuring their loop.
+    """
+
+    def __init__(self, engines: Sequence[ServeEngine],
+                 queue: Optional[RequestQueue] = None, *,
+                 policy: RouterPolicy = RouterPolicy(),
+                 spawn_fn: Optional[Callable[[], ServeEngine]] = None,
+                 chaos=None, event_log=None,
+                 clock: Optional[Callable[[], float]] = None):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("Router needs at least one engine replica")
+        if queue is None:
+            queue = RequestQueue(clock=clock or time.monotonic)
+        elif clock is not None and clock is not queue.clock:
+            raise ValueError(
+                "pass the clock on the queue (router adopts queue.clock)")
+        seen = set()
+        for eng in engines:
+            if eng.queue is queue:
+                raise ValueError(
+                    "a replica engine may not share the router's front "
+                    "queue (the router owns placement)")
+            if id(eng.queue) in seen:
+                raise ValueError(
+                    "replica engines may not share a queue with each "
+                    "other (each replica owns its backlog)")
+            seen.add(id(eng.queue))
+            if eng.clock is not queue.clock:
+                raise ValueError(
+                    "every replica engine must run on the front queue's "
+                    "clock (deadlines are absolute in one clock domain)")
+        self.queue = queue
+        self.clock = queue.clock
+        self.policy = policy
+        self.spawn_fn = spawn_fn
+        self.chaos = chaos
+        self.events = event_log if event_log is not None else NULL_EVENT_LOG
+        self.replicas: List[Replica] = []
+        for eng in engines:
+            self._add_replica(eng)
+        self._responses: Dict[int, Response] = {}
+        self._tracked: Dict[int, Request] = {}
+        self._parked: List[Tuple[float, Request]] = []
+        self._session_of: Dict[int, str] = {}
+        self._session_map: Dict[str, int] = {}
+        self._placed_on: Dict[int, int] = {}
+        self._tick_index = 0
+        self._depth_streak = 0
+        self._draining = False
+
+    # -- construction helpers ----------------------------------------------
+
+    def _add_replica(self, engine: ServeEngine) -> Replica:
+        rep = Replica(len(self.replicas), engine)
+        if self.chaos is not None:
+            self._install_chaos(rep)
+        self.replicas.append(rep)
+        return rep
+
+    def _install_chaos(self, rep: Replica) -> None:
+        """Wrap this replica's backend so planned replica faults fire at
+        the router tick they cover. Kill/wedge raise from BOTH prefill
+        and decode (a dead box fails everything); slow sleeps inside
+        decode so the replica's own watchdog sees the overrun — chaos
+        manifests only through the signals real faults would produce."""
+        from ..resilience.chaos import ChaosError
+        plan, router, idx = self.chaos, self, rep.index
+        backend = rep.engine.backend
+        orig_decode, orig_prefill = backend.decode, backend.prefill
+
+        def _dead() -> Optional[str]:
+            t = router._tick_index
+            if plan.replica_fault("kill_replica", t, idx) is not None:
+                return "kill_replica"
+            if plan.replica_fault("wedge_replica", t, idx) is not None:
+                return "wedge_replica"
+            return None
+
+        def chaotic_decode(live):
+            kind = _dead()
+            if kind is not None:
+                raise ChaosError(
+                    f"injected {kind} on replica {idx} at router tick "
+                    f"{router._tick_index}")
+            f = plan.replica_fault("slow_replica", router._tick_index, idx)
+            if f is not None:
+                time.sleep(f.magnitude)
+            return orig_decode(live)
+
+        def chaotic_prefill(slot, prompt, seed):
+            kind = _dead()
+            if kind is not None:
+                raise ChaosError(
+                    f"injected {kind} on replica {idx} at router tick "
+                    f"{router._tick_index}")
+            return orig_prefill(slot, prompt, seed)
+
+        backend.decode = chaotic_decode
+        backend.prefill = chaotic_prefill
+
+    # -- front door --------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None, seed: int = 0,
+               priority: int = 0, timeout_s: Optional[float] = None,
+               session: Optional[str] = None) -> Request:
+        """Validate + enqueue at the fleet front door. Raises
+        ``ValueError`` on an unservable request,
+        :class:`~.engine.EngineDraining` after :meth:`drain`, and
+        :class:`~.queue.QueueFull` when the front queue is at capacity —
+        which is exactly what happens when every replica is SUSPECT or
+        worse: placement stops, the front fills, callers feel
+        backpressure instead of silent loss."""
+        reg = get_registry()
+        if self._draining:
+            raise EngineDraining(
+                "fleet is draining: live requests are finishing and no "
+                "new work is admitted")
+        backend = self.replicas[0].engine.backend
+        if max_new_tokens is None:
+            max_new_tokens = backend.gen.max_new_tokens
+        backend.validate(len(prompt), max_new_tokens)
+        try:
+            req = self.queue.submit(prompt, max_new_tokens=max_new_tokens,
+                                    seed=seed, priority=priority,
+                                    timeout_s=timeout_s)
+        except QueueFull:
+            reg.counter("serve.fleet.rejected").inc()
+            raise
+        self._tracked[req.id] = req
+        if session is not None:
+            self._session_of[req.id] = str(session)
+        reg.counter("serve.fleet.submitted").inc()
+        reg.gauge("serve.fleet.front_depth").set(self.queue.depth)
+        return req
+
+    def cancel(self, request_id: int) -> bool:
+        """Mark a live request cancelled wherever it currently sits —
+        front queue, parked for retry, a replica's queue, or a running
+        slot. One flag flip on the shared :class:`~.queue.Request`;
+        whichever sweep sees it first emits the single terminal
+        ``cancelled`` response. False for unknown/terminal ids."""
+        req = self._tracked.get(request_id)
+        if req is None:
+            return False
+        req.cancelled = True
+        return True
+
+    def response(self, request_id: int) -> Optional[Response]:
+        return self._responses.get(request_id)
+
+    # -- drain / status ----------------------------------------------------
+
+    def drain(self) -> None:
+        """Fleet-wide graceful shutdown: ``submit`` starts raising, the
+        next tick sheds front-queued and parked work
+        (``finish_reason="drain"``) and every replica drains its live
+        slots. Idempotent."""
+        if not self._draining:
+            self._draining = True
+            self.events.event("resilience", action="fleet_drain",
+                              front=self.queue.depth,
+                              parked=len(self._parked))
+            for rep in self.replicas:
+                if rep.state != RETIRED:
+                    rep.engine.drain()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        return self._draining and self.idle
+
+    @property
+    def idle(self) -> bool:
+        return (self.queue.depth == 0 and not self._parked
+                and all(r.engine.idle for r in self.replicas))
+
+    def counts(self) -> Dict[str, int]:
+        """Replica count per health state (``{state: n}``)."""
+        out = {s: 0 for s in STATES}
+        for rep in self.replicas:
+            out[rep.state] += 1
+        return out
+
+    # -- delivery (the exactly-once ledger) --------------------------------
+
+    def _deliver(self, resp: Response) -> Response:
+        if resp.request_id in self._responses:
+            raise RuntimeError(
+                f"duplicate terminal response for request "
+                f"{resp.request_id} (exactly-once delivery violated)")
+        self._responses[resp.request_id] = resp
+        req = self._tracked.pop(resp.request_id, None)
+        self._session_of.pop(resp.request_id, None)
+        self._placed_on.pop(resp.request_id, None)
+        self.queue.forget(resp.request_id)
+        reg = get_registry()
+        reg.counter("serve.fleet.delivered").inc()
+        if resp.status == "ok":
+            reg.counter("serve.fleet.ok").inc()
+        if req is not None and req.attempts > 1:
+            reg.counter("serve.fleet.failed_over").inc()
+        return resp
+
+    def _finish_unplaced(self, req: Request, status: str, reason: str,
+                         now: float) -> Response:
+        """Terminal record for a request that never (re)reached a
+        replica: front-reaped, parked-reaped, shed on fleet drain, or
+        retries exhausted."""
+        resp = Response(request_id=req.id, tokens=[], status=status,
+                        finish_reason=reason, prompt_len=len(req.prompt),
+                        ttft=None, latency=now - req.submitted_at)
+        self.events.event(REQUEST, request=req.id, status=status,
+                          finish_reason=reason, replica=None,
+                          attempts=req.attempts)
+        return self._deliver(resp)
+
+    # -- retry parking -----------------------------------------------------
+
+    def _park(self, req: Request, now: float) -> None:
+        p = self.policy
+        delay = min(p.backoff_base_s * (2.0 ** max(req.attempts - 1, 0)),
+                    p.backoff_max_s)
+        self._parked.append((now + delay, req))
+        get_registry().counter("serve.fleet.retried").inc()
+        self.events.event("resilience", action="retry_parked",
+                          request=req.id, attempts=req.attempts,
+                          delay_s=delay)
+
+    # -- placement ---------------------------------------------------------
+
+    def _placeable(self) -> List[Replica]:
+        return [r for r in self.replicas
+                if r.state == HEALTHY
+                and r.engine.queue.depth < r.engine.queue.capacity]
+
+    def _choose(self, req: Request, candidates: List[Replica]) -> Replica:
+        if self.policy.placement == "session":
+            sess = self._session_of.get(req.id)
+            if sess is not None:
+                home = self._session_map.get(sess)
+                for rep in candidates:
+                    if rep.index == home:
+                        return rep
+        return min(candidates, key=lambda r: (r.load, r.index))
+
+    def _try_place(self, req: Request, now: float) -> bool:
+        candidates = self._placeable()
+        if not candidates:
+            return False
+        rep = self._choose(req, candidates)
+        rep.engine.place(req)               # increments req.attempts
+        self._placed_on[req.id] = rep.index
+        sess = self._session_of.get(req.id)
+        if sess is not None and rep.state == HEALTHY:
+            self._session_map[sess] = rep.index
+        return True
+
+    # -- health state machine ----------------------------------------------
+
+    def _wedge(self, rep: Replica, reason: str, now: float) -> None:
+        """WEDGED: reclaim the backlog intact, re-place or park it under
+        the retry budget, and start draining the live slots. One-way."""
+        reg = get_registry()
+        rep.state = WEDGED
+        reg.counter("serve.fleet.wedged").inc()
+        evicted = rep.engine.evict_queued()
+        self.events.event("resilience", action="replica_wedged",
+                          replica=rep.index, reason=reason,
+                          evicted=len(evicted))
+        for req in evicted:
+            if req.attempts >= self.policy.retry_budget:
+                self._finish_unplaced(req, "error", "retries_exhausted",
+                                      now)
+                reg.counter("serve.fleet.retries_exhausted").inc()
+            else:
+                self._park(req, now)
+        rep.engine.drain()
+        rep.state = DRAINING
+
+    def _update_health(self, rep: Replica, now: float) -> None:
+        p = self.policy
+        if rep.state == RETIRED:
+            return
+        if rep.state == DRAINING:
+            if rep.engine.drained:
+                rep.state = RETIRED
+                get_registry().counter("serve.fleet.retired").inc()
+                self.events.event("resilience", action="replica_retired",
+                                  replica=rep.index)
+            return
+
+        wd = rep.engine.watchdog
+        slow = wd.slow_streak if wd is not None else 0
+        ewma = wd.miss_ewma if wd is not None else 0.0
+        derr = rep.engine.consecutive_decode_errors
+        if rep.had_error_this_tick:
+            rep.error_ticks += 1
+
+        if (slow >= p.wedge_slow_streak or derr >= p.wedge_decode_errors
+                or rep.error_ticks >= p.wedge_error_ticks):
+            self._wedge(rep, f"slow_streak={slow} decode_errors={derr} "
+                             f"error_ticks={rep.error_ticks}", now)
+            return
+
+        bad = (slow >= p.suspect_slow_streak or derr > 0
+               or rep.had_error_this_tick
+               or (p.suspect_miss_ewma is not None
+                   and ewma > p.suspect_miss_ewma))
+        if rep.state == HEALTHY and bad:
+            rep.state = SUSPECT
+            rep.healthy_streak = 0
+            get_registry().counter("serve.fleet.suspected").inc()
+            self.events.event("resilience", action="replica_suspect",
+                              replica=rep.index, slow_streak=slow,
+                              decode_errors=derr, miss_ewma=ewma)
+        elif rep.state == SUSPECT:
+            if bad:
+                rep.healthy_streak = 0
+            else:
+                rep.healthy_streak += 1
+                if rep.healthy_streak >= p.recover_healthy_ticks:
+                    rep.state = HEALTHY
+                    rep.healthy_streak = 0
+                    get_registry().counter("serve.fleet.recovered").inc()
+                    self.events.event("resilience",
+                                      action="replica_recovered",
+                                      replica=rep.index)
+
+    def _lifecycle(self, now: float) -> None:
+        """Spawn on sustained front-queue depth; retire sustained-idle
+        replicas (never below ``min_replicas`` placeable ones)."""
+        p = self.policy
+        active = [r for r in self.replicas if r.state in (HEALTHY, SUSPECT)]
+        if p.spawn_depth is not None and self.spawn_fn is not None:
+            if self.queue.depth >= p.spawn_depth:
+                self._depth_streak += 1
+            else:
+                self._depth_streak = 0
+            if self._depth_streak >= p.spawn_sustain_ticks \
+                    and len(active) < p.max_replicas:
+                rep = self._add_replica(self.spawn_fn())
+                self._depth_streak = 0
+                get_registry().counter("serve.fleet.spawned").inc()
+                self.events.event("resilience", action="replica_spawned",
+                                  replica=rep.index,
+                                  front_depth=self.queue.depth)
+        if p.retire_idle_ticks is None:
+            return
+        for rep in self.replicas:
+            if rep.state != HEALTHY:
+                continue
+            if rep.engine.idle and self.queue.depth == 0 \
+                    and not self._parked:
+                rep.idle_ticks += 1
+            else:
+                rep.idle_ticks = 0
+            active = [r for r in self.replicas
+                      if r.state in (HEALTHY, SUSPECT)]
+            if rep.idle_ticks >= p.retire_idle_ticks \
+                    and len(active) > p.min_replicas:
+                rep.engine.drain()
+                rep.state = DRAINING
+                rep.idle_ticks = 0
+                get_registry().counter("serve.fleet.idle_retired").inc()
+                self.events.event("resilience",
+                                  action="replica_idle_retired",
+                                  replica=rep.index)
+
+    # -- the fleet tick ----------------------------------------------------
+
+    def tick(self) -> List[Response]:
+        """One fleet scheduling round: sweep the front/parked sets,
+        advance every replica's health machine, place onto HEALTHY
+        replicas, tick the replicas, then deliver-or-retry their
+        terminal responses. Returns the responses DELIVERED this tick
+        (retried failures are not delivered — they park)."""
+        reg = get_registry()
+        now = self.clock()
+        tick_idx = self._tick_index
+        delivered: List[Response] = []
+
+        # 0) fleet drain — push back everything not yet on a replica
+        if self._draining:
+            for req in self.queue.evict_all():
+                delivered.append(
+                    self._finish_unplaced(req, "shed", "drain", now))
+            for _, req in self._parked:
+                delivered.append(
+                    self._finish_unplaced(req, "shed", "drain", now))
+            self._parked = []
+
+        # 1) front + parked sweeps — deaths that never cost a replica
+        for req, reason in self.queue.reap(now):
+            status = "cancelled" if reason == "cancelled" else "timeout"
+            delivered.append(
+                self._finish_unplaced(req, status, reason, now))
+        still = []
+        for eligible_at, req in self._parked:
+            if req.cancelled:
+                delivered.append(
+                    self._finish_unplaced(req, "cancelled", "cancelled",
+                                          now))
+            elif req.deadline is not None and now >= req.deadline:
+                delivered.append(
+                    self._finish_unplaced(req, "timeout", "deadline", now))
+            else:
+                still.append((eligible_at, req))
+        self._parked = still
+
+        # 2) health transitions + lifecycle (uses last tick's signals)
+        for rep in self.replicas:
+            self._update_health(rep, now)
+            rep.had_error_this_tick = False
+        if not self._draining:
+            self._lifecycle(now)
+
+        # 2b) dead fleet — no replica can ever serve again (none healthy
+        # or recoverable, no spawn hook armed): fail the stranded work
+        # now instead of parking it forever
+        recoverable = any(r.state in (HEALTHY, SUSPECT)
+                          for r in self.replicas)
+        can_spawn = (self.spawn_fn is not None
+                     and self.policy.spawn_depth is not None)
+        if not recoverable and not can_spawn and not self._draining:
+            stranded = self.queue.evict_all() + [r for _, r in self._parked]
+            self._parked = []
+            for req in stranded:
+                reg.counter("serve.fleet.retries_exhausted").inc()
+                delivered.append(self._finish_unplaced(
+                    req, "error", "no_replicas", now))
+
+        # 3) placement — parked retries first (oldest work), then front
+        if not self._draining:
+            still = []
+            for eligible_at, req in self._parked:
+                if eligible_at > now or not self._try_place(req, now):
+                    still.append((eligible_at, req))
+            self._parked = still
+            while self.queue.depth and self._placeable():
+                req = self.queue.pop()
+                self._try_place(req, now)
+
+        # 4) tick the replicas, deliver-or-retry what they finish
+        for rep in self.replicas:
+            if rep.state == RETIRED:
+                continue
+            for resp in rep.engine.tick():
+                req = self._tracked.get(resp.request_id)
+                if (resp.status == "error"
+                        and resp.finish_reason in RETRYABLE_REASONS
+                        and req is not None):
+                    rep.had_error_this_tick = True
+                    if req.cancelled or (req.deadline is not None
+                                         and now >= req.deadline):
+                        # next tick's parked sweep emits the terminal
+                        # cancelled/timeout record
+                        self._parked.append((now, req))
+                    elif req.attempts < self.policy.retry_budget:
+                        self._park(req, now)
+                    else:
+                        reg.counter("serve.fleet.retries_exhausted").inc()
+                        delivered.append(self._finish_unplaced(
+                            req, "error", "retries_exhausted", now))
+                    continue
+                delivered.append(self._deliver(resp))
+
+        # 5) fleet gauges
+        counts = self.counts()
+        for state, n in counts.items():
+            reg.gauge(f"serve.fleet.replicas_{state}").set(n)
+        reg.gauge("serve.fleet.front_depth").set(self.queue.depth)
+        reg.gauge("serve.fleet.parked").set(len(self._parked))
+        for rep in self.replicas:
+            reg.gauge(labelled("serve.fleet.replica.state",
+                               replica=rep.index)).set(
+                _STATE_CODE[rep.state])
+            reg.gauge(labelled("serve.fleet.replica.queue_depth",
+                               replica=rep.index)).set(
+                rep.engine.queue.depth)
+            reg.gauge(labelled("serve.fleet.replica.live_slots",
+                               replica=rep.index)).set(
+                rep.engine.live_slots)
+        self._tick_index = tick_idx + 1
+        return delivered
+
+    # -- convenience loops -------------------------------------------------
+
+    def run_until_idle(self, max_ticks: int = 1_000_000) -> List[Response]:
+        """Tick until every tracked request delivered. With every
+        replica dead this still terminates: retries exhaust their
+        budgets and the dead-fleet sweep fails anything stranded."""
+        delivered: List[Response] = []
+        for _ in range(max_ticks):
+            if self.idle:
+                return delivered
+            delivered.extend(self.tick())
+        raise RuntimeError(
+            f"fleet not idle after {max_ticks} ticks (front="
+            f"{self.queue.depth}, parked={len(self._parked)}, "
+            f"replicas={self.counts()})")

@@ -1,0 +1,251 @@
+"""Fleet bench: goodput vs replica count + kill-one-of-N failover proof.
+
+Two questions, answered with the tiny LM on whatever backend is
+available (the numbers of record are the committed ``FLEET_r10.json``):
+
+1. **Scaling** — saturated fleet goodput (ok tokens/s through the
+   Router's exactly-once ledger) at N = 1, 2, 3 replicas. On a real pod
+   each replica is its own device and the curve is ~linear; on the CPU
+   host the replicas share one processor, so the artifact records the
+   honest (flat-ish) curve plus per-N slot counts for context.
+2. **Kill one of N** — N = 3 replicas, a ``kill_replica`` chaos fault
+   fires mid-stream. The per-delivery timeline is split into
+   before/failover/after windows around the kill: goodput must drop by
+   <= ~1/N (plus the retried work's lost progress), NOT to zero, and
+   recover in the tail as the router re-places the dead replica's
+   backlog onto the survivors. The ledger check rides along: every
+   submitted request id yields exactly one terminal response.
+
+Usage:
+  python tools/fleet_bench.py                 # full run -> FLEET_r10.json
+  python tools/fleet_bench.py --quick         # small run, one JSON line
+Progress goes to stderr; the last stdout line is always the summary
+object, so ``bench.py`` embeds the --quick summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from pipe_tpu.inference import GenerationConfig  # noqa: E402
+from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM  # noqa: E402
+from pipe_tpu.resilience import ChaosPlan, Fault, TickWatchdog  # noqa: E402
+from pipe_tpu.serve import (BucketSpec, RequestQueue, Router,  # noqa: E402
+                            RouterPolicy, ServeEngine,
+                            SingleDeviceSlotBackend)
+
+CFG = LMConfig(vocab=67, d_model=16, nhead=2, d_ff=32, n_layers=4,
+               seq_len=64, dropout=0.0)
+BUCKETS = BucketSpec.of(8, 16)
+MAX_NEW = 32                 # engine cap; per-request budgets vary below
+MAX_LEN = BUCKETS.max_len + MAX_NEW
+SLOTS = 2
+CHUNK = 4
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_workload(n, rng):
+    """(prompt, max_new) pairs with varied generation lengths, so
+    retirements/admissions stagger across ticks and deliveries form a
+    continuous stream instead of synchronized waves — the kill trial's
+    windowing needs a nonzero pre-kill baseline."""
+    lens = rng.choice((6, 8, 12, 16), size=n)
+    news = rng.choice((8, 12, 16, 24, 32), size=n)
+    return [(rng.randint(1, CFG.vocab, size=int(p)).tolist(), int(m))
+            for p, m in zip(lens, news)]
+
+
+def make_fleet(model, params, n_replicas, *, chaos=None, capacity=256):
+    gen_cfg = GenerationConfig(max_new_tokens=MAX_NEW, temperature=0.0)
+    engines = []
+    for _ in range(n_replicas):
+        backend = SingleDeviceSlotBackend(
+            model, params, num_slots=SLOTS, max_len=MAX_LEN, gen=gen_cfg,
+            buckets=BUCKETS, decode_chunk=CHUNK)
+        engines.append(ServeEngine(
+            backend, RequestQueue(capacity=capacity),
+            watchdog=TickWatchdog(stuck_slack_ticks=None)))
+    return Router(engines, RequestQueue(capacity=capacity),
+                  policy=RouterPolicy(backoff_base_s=0.0), chaos=chaos)
+
+
+def warm(router, n_replicas):
+    """Compile both prefill buckets + decode on every replica before
+    the clock matters (least-loaded placement round-robins equal-load
+    replicas, so 2N warm requests touch all of them)."""
+    for _ in range(n_replicas):
+        router.submit([1] * 8, max_new_tokens=1)
+        router.submit([1] * 16, max_new_tokens=1)
+    router.run_until_idle()
+
+
+def timed_run(router, workload):
+    """Submit everything, tick to idle, stamp each delivery with the
+    router tick index it arrived on. Returns (records, elapsed_s,
+    total_ticks) where records are (tick, status, n_tokens). Also runs
+    the exactly-once ledger check: every submitted id, one terminal
+    response."""
+    submitted = [router.submit(p, max_new_tokens=m, seed=i).id
+                 for i, (p, m) in enumerate(workload)]
+    t0 = time.monotonic()
+    records = []
+    ticks = 0
+    while not router.idle:
+        tick = ticks
+        ticks += 1
+        for r in router.tick():
+            records.append((tick, r.status, len(r.tokens)))
+    elapsed = time.monotonic() - t0
+    missing = [i for i in submitted if router.response(i) is None]
+    assert not missing, f"requests with no terminal response: {missing}"
+    return records, elapsed, ticks
+
+
+def tokens_per_tick(records, lo, hi):
+    """ok tokens delivered per tick over tick window [lo, hi)."""
+    toks = sum(n for t, status, n in records
+               if status == "ok" and lo <= t < hi)
+    return toks / max(hi - lo, 1)
+
+
+def scaling_trial(model, params, n_replicas, n_requests, seed):
+    rng = np.random.RandomState(seed)
+    router = make_fleet(model, params, n_replicas)
+    warm(router, n_replicas)
+    records, elapsed, ticks = timed_run(router,
+                                        make_workload(n_requests, rng))
+    ok = sum(1 for _, s, _ in records if s == "ok")
+    ok_tokens = sum(n for _, s, n in records if s == "ok")
+    return {
+        "replicas": n_replicas,
+        "slots_total": n_replicas * SLOTS,
+        "requests": n_requests,
+        "ok": ok,
+        "ticks": ticks,
+        "elapsed_s": round(elapsed, 3),
+        "goodput_tokens_s": round(ok_tokens / max(elapsed, 1e-9), 1),
+        "goodput_tokens_per_tick": round(ok_tokens / max(ticks, 1), 2),
+    }
+
+
+def kill_trial(model, params, n_replicas, n_requests, seed, kill_tick,
+               window):
+    """N replicas, kill one mid-stream; window the delivery timeline
+    (in router ticks — tick wall time is roughly constant, and tick
+    indexing keeps the windows deterministic) around the kill to show
+    degrade-and-recover."""
+    rng = np.random.RandomState(seed)
+    chaos = ChaosPlan([Fault("kill_replica", step=kill_tick,
+                             stage=n_replicas - 1)])
+    router = make_fleet(model, params, n_replicas, chaos=chaos)
+    warm(router, n_replicas)
+    records, elapsed, ticks = timed_run(router,
+                                        make_workload(n_requests, rng))
+    assert ticks > kill_tick + window, (
+        f"run finished in {ticks} ticks; needs > "
+        f"{kill_tick + window} — raise the load")
+    before = tokens_per_tick(records, max(kill_tick - window, 0),
+                             kill_tick)
+    during = tokens_per_tick(records, kill_tick, kill_tick + window)
+    after = tokens_per_tick(records, kill_tick + window, ticks)
+    by_status = {}
+    for _, s, _ in records:
+        by_status[s] = by_status.get(s, 0) + 1
+    return {
+        "replicas": n_replicas,
+        "killed_replica": n_replicas - 1,
+        "kill_tick": kill_tick,
+        "window_ticks": window,
+        "requests": n_requests,
+        "ticks": ticks,
+        "elapsed_s": round(elapsed, 3),
+        "tokens_per_tick_before": round(before, 2),
+        "tokens_per_tick_failover": round(during, 2),
+        "tokens_per_tick_after": round(after, 2),
+        "drop_frac": round(1.0 - during / max(before, 1e-9), 3),
+        "recovered_frac": round(after / max(before, 1e-9), 3),
+        "survived_failover": during > 0.0,
+        "responses_by_status": by_status,
+        "exactly_once": len(records) == n_requests,
+        "replica_states": router.counts(),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="small run; single-line JSON summary")
+    ap.add_argument("--out", default=None,
+                    help="also write the summary JSON here")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    model = PipelinedLM(CFG, 1)
+    params = model.init(jax.random.key(0))
+
+    n_requests = 24 if args.quick else 48
+    replica_counts = (1, 3) if args.quick else (1, 2, 3)
+
+    scaling = []
+    for n in replica_counts:
+        log(f"== scaling: {n} replica(s), {n_requests} requests")
+        r = scaling_trial(model, params, n, n_requests, args.seed)
+        scaling.append(r)
+        log(f"   {r}")
+
+    log("== kill one of 3 mid-stream")
+    kill = kill_trial(model, params, 3, n_requests * 2, args.seed + 1,
+                      kill_tick=6, window=4)
+    log(f"   {kill}")
+
+    ok = bool(kill["exactly_once"] and kill["survived_failover"]
+              and kill["recovered_frac"] > 0.3)
+    summary = {
+        "bench": "fleet", "rev": "r10",
+        "quick": bool(args.quick),
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "slots_per_replica": SLOTS,
+        "decode_chunk": CHUNK,
+        "max_new_tokens": MAX_NEW,
+        "scaling": scaling,
+        "kill_one_of_n": kill,
+        "fleet_ok": ok,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+        log(f"wrote {args.out}")
+    if args.quick:
+        print(json.dumps({
+            "goodput_1_replica_tokens_s":
+                scaling[0]["goodput_tokens_s"],
+            "goodput_3_replicas_tokens_s":
+                scaling[-1]["goodput_tokens_s"],
+            "kill_drop_frac": kill["drop_frac"],
+            "kill_recovered_frac": kill["recovered_frac"],
+            "exactly_once": kill["exactly_once"],
+            "fleet_ok": ok,
+        }))
+    else:
+        print(json.dumps(summary, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
