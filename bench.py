@@ -528,6 +528,52 @@ def main():
     except Exception as e:
         print(f"fleet probe failed: {e}", file=sys.stderr)
 
+    # Elastic probe: kill 1 of 4 stages mid-run -> heartbeat detection,
+    # re-plan to 3, buddy restore, and the bitwise pin against the
+    # from-snapshot reference — all_ok must stay true every round
+    # (quick mode of tools/elastic_bench.py; ELASTIC_r{N}.json is the
+    # full record).
+    elastic_summary = None
+    try:
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "elastic_bench.py"), "--quick"],
+            capture_output=True, text=True, timeout=900, env=env)
+        if out.returncode == 0:
+            elastic_summary = json.loads(out.stdout.strip().splitlines()[-1])
+        else:
+            print(f"elastic probe rc={out.returncode}: "
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"elastic probe failed: {e}", file=sys.stderr)
+
+    # Chaos smoke lane: the pytest-marked elastic drill (kill stage 1/4,
+    # resumed loss trajectory vs the unkilled run) as the repo's own
+    # test suite runs it — the bench proves the committed test passes,
+    # not just the bench-local drill.
+    chaos_smoke = None
+    try:
+        import subprocess
+        smoke_test = os.path.join(
+            "tests", "test_elastic.py") + \
+            "::test_elastic_drill_loss_trajectory"
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+        t0 = time.time()
+        out = subprocess.run(
+            [sys.executable, "-m", "pytest", "-m", "chaos", "-q",
+             "-p", "no:cacheprovider", smoke_test],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=here)
+        chaos_smoke = {"ok": out.returncode == 0, "test": smoke_test,
+                       "wall_s": round(time.time() - t0, 1)}
+        if out.returncode != 0:
+            print(f"chaos smoke rc={out.returncode}: "
+                  f"{out.stdout[-2000:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"chaos smoke failed: {e}", file=sys.stderr)
+
     trend_vs_prior = None
     try:
         trend_vs_prior = trend_vs_prior_round(here, bubble_multistage)
@@ -613,6 +659,8 @@ def main():
         "serve": serve_summary,
         "chaos": chaos_summary,
         "fleet": fleet_summary,
+        "elastic": elastic_summary,
+        "chaos_smoke": chaos_smoke,
         "trend_vs_prior": trend_vs_prior,
         "final_loss": round(loss, 4),
         "step_report": report.to_json(),

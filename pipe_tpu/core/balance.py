@@ -29,7 +29,7 @@ from ..ops.layers import Sequential
 from .partition import BalanceError, StageCtx
 
 __all__ = ["profile_times", "profile_sizes", "balance_by_time",
-           "balance_by_size", "balance_cost"]
+           "balance_by_size", "balance_cost", "rebalance_stage_loss"]
 
 
 def _layer_specs(module: Sequential, params: Sequence[Any], sample) -> List:
@@ -156,6 +156,33 @@ def balance_by_size(n_stages: int, module: Sequential,
     """Stage balance from parameter+activation bytes (torchgpipe parity API)."""
     return _bottleneck_split(
         profile_sizes(module, params, sample), n_stages)
+
+
+def rebalance_stage_loss(balance: Sequence[int],
+                         costs: Optional[Sequence[float]] = None
+                         ) -> List[int]:
+    """Re-cut an existing stage balance over one fewer stage.
+
+    The elastic recovery path: a stage died, its layers must be
+    redistributed over the ``n - 1`` survivors. The layer sequence is
+    unchanged — only the cut points move — so the same contiguous
+    bottleneck solver applies, fed either the per-layer ``costs`` the
+    caller measured (``profile_times``/``profile_sizes``) or uniform
+    unit costs when none are known. Raises :class:`BalanceError` when
+    the original balance has fewer than two stages (nothing to fail
+    over to).
+    """
+    n = len(balance)
+    if n < 2:
+        raise BalanceError(
+            f"cannot rebalance a {n}-stage pipeline over stage loss")
+    total = sum(int(w) for w in balance)
+    if costs is None:
+        costs = [1.0] * total
+    elif len(costs) != total:
+        raise BalanceError(
+            f"costs cover {len(costs)} layers but balance sums to {total}")
+    return _bottleneck_split(costs, n - 1)
 
 
 def balance_cost(balance: Sequence[int], costs: Sequence[float]) -> float:

@@ -50,6 +50,8 @@ __all__ = [
     "PhaseVerdict",
     "PHASE_KLASS_FB",
     "PHASE_KLASS_FBW",
+    "ElasticPlan",
+    "replan_stage_loss",
 ]
 
 # Op codes for the (cycle, stage) tables driving the manual fwd+bwd executor
@@ -1276,3 +1278,65 @@ def get_schedule(name: str, **kwargs) -> Schedule:
     if name not in _SCHEDULES:
         raise ValueError(f"unknown schedule {name!r}; options: {sorted(_SCHEDULES)}")
     return _SCHEDULES[name](**kwargs)
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticPlan:
+    """A verified degraded-topology plan produced by
+    :func:`replan_stage_loss`: the survivor stage count, the re-cut
+    layer balance (None when the caller gave no balance to re-cut),
+    freshly emitted + verified op tables for the new width, and the
+    phase-compiler verdict (advisory — a table that phase-rejects still
+    executes on the generic path)."""
+
+    n_stages: int
+    balance: Optional[Tuple[int, ...]]
+    op: np.ndarray
+    mbi: np.ndarray
+    phase: "PhaseVerdict"
+
+
+def replan_stage_loss(m: int, n_stages: int, lost_stage: int, *,
+                      schedule: str = "1f1b",
+                      balance: Optional[List[int]] = None,
+                      costs: Optional[List[float]] = None,
+                      hop: int = 2) -> ElasticPlan:
+    """Re-plan a pipeline after losing one stage: emit, verify, and
+    phase-compile the op table for the surviving ``n_stages - 1`` width.
+
+    This is the schedules-as-data payoff the elastic controller rides:
+    the schedule family regenerates its table for ANY stage count, so
+    recovery is a fresh emission plus the same proofs every table must
+    pass (:func:`verify_op_tables` with the schedule's own stash/wstash
+    capacities) — not a hand-patched topology. ``balance``/``costs``
+    re-cut the layer assignment via
+    :func:`~pipe_tpu.core.balance.rebalance_stage_loss`. Raises
+    ``ValueError`` when no survivor topology exists (n_stages < 2, a
+    lost stage out of range, or an interleaved schedule — re-plan those
+    as their v=1 base family first).
+    """
+    if n_stages < 2:
+        raise ValueError(
+            f"cannot re-plan stage loss with n_stages={n_stages}: "
+            f"no survivor topology exists")
+    if not 0 <= lost_stage < n_stages:
+        raise ValueError(
+            f"lost_stage={lost_stage} out of range for {n_stages} stages")
+    sched = get_schedule(schedule)
+    if sched.v != 1:
+        raise ValueError(
+            f"schedule {schedule!r} interleaves v={sched.v} virtual "
+            f"stages; re-plan via its v=1 base family")
+    n_new = n_stages - 1
+    op, mbi = sched.op_tables(m, n_new)
+    verify_op_tables(op, mbi, m, n_new,
+                     stash_slots=sched.stash_slots(m, n_new),
+                     wstash_slots=(sched.wstash_slots(m, n_new)
+                                   if sched.splits_backward else None))
+    new_balance = None
+    if balance is not None:
+        from .balance import rebalance_stage_loss
+        new_balance = tuple(rebalance_stage_loss(balance, costs))
+    phase = compile_phases(op, mbi, m=m, d=n_new, hop=hop)
+    return ElasticPlan(n_stages=n_new, balance=new_balance,
+                       op=op, mbi=mbi, phase=phase)

@@ -39,7 +39,8 @@ import time
 from typing import Any, Dict, IO, List, Optional
 
 __all__ = ["EventLog", "NullEventLog", "NULL_EVENT_LOG", "SPAN_KINDS",
-           "STEP", "STAGE", "MICROBATCH", "COMM", "RECOMPUTE", "REQUEST"]
+           "STEP", "STAGE", "MICROBATCH", "COMM", "RECOMPUTE", "REQUEST",
+           "RECOVERY"]
 
 STEP = "step"
 STAGE = "stage"
@@ -49,6 +50,11 @@ RECOMPUTE = "checkpoint-recompute"
 # serving: one record per retired request, written by the serve engine at
 # retirement (see docs/observability.md "Request spans" for the schema)
 REQUEST = "request"
+# resilience: instantaneous records (not spans) written at every rung of
+# the recovery ladder — skip/rewind (action=...) and the elastic path
+# (stage_lost, replan, buddy_restore) — so a post-mortem can replay the
+# escalation from the event log alone
+RECOVERY = "recovery"
 SPAN_KINDS = (STEP, STAGE, MICROBATCH, COMM, RECOMPUTE, REQUEST)
 
 

@@ -126,7 +126,8 @@ def run(stages: Sequence[Stage],
         key: Optional[jax.Array] = None,
         remat_policy=None,
         skip_tracker=None,
-        chaos=None) -> List[mb.Batch]:
+        chaos=None,
+        hop_health=None) -> List[mb.Batch]:
     """Execute the clock-cycle schedule serially; returns transformed batches.
 
     Mirrors ``Pipeline.run`` (reference ``pipeline.py:100-117``): iterate the
@@ -141,6 +142,11 @@ def run(stages: Sequence[Stage],
     planned ``transport_drop``/``transport_corrupt`` at ``(i, j)``
     zeroes/NaN-poisons the hop before stage ``j+1`` consumes it —
     deterministic, and absent from the program when no plan is given.
+    A ``persistent_hop_drop`` fault matches every micro-batch crossing
+    its hop. ``hop_health`` (a
+    :class:`~pipe_tpu.resilience.HopHealth`) records every crossing —
+    faulted or clean — so persistent hop failure accumulates a streak
+    the elastic controller can escalate on, while one-shot faults reset.
     """
     validate_mode(checkpoint)
     schedule = schedule or GPipeSchedule()
@@ -160,8 +166,11 @@ def run(stages: Sequence[Stage],
                 stages[j], params_per_stage[j], batches[i], ctx,
                 remat=i < stop, remat_policy=remat_policy,
                 skip_tracker=skip_tracker)
-            if chaos is not None and j < n - 1:
-                mode = chaos.transport_fault(i, j)
+            if j < n - 1:
+                mode = (chaos.transport_fault(i, j)
+                        if chaos is not None else None)
                 if mode is not None:
                     batches[i] = _corrupt_hop(batches[i], mode)
+                if hop_health is not None:
+                    hop_health.record(j, mode is not None)
     return batches

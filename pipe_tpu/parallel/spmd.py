@@ -294,7 +294,11 @@ class SpmdPipeline:
                     l, idx, 0, keepdims=False), x_fill)
 
         def body(p, k, h):
+            # ctx.stage carries this device's (traced) stage index so
+            # stage-aware wrappers (resilience.chaos.wrap_stage_fn) can
+            # target one stage; the model itself never reads it.
             return self.stage_fn(p, h, StageCtx(key=k, train=train,
+                                                stage=j,
                                                 data_axis=self.bn_axis))
 
         if stop > 0:

@@ -22,24 +22,44 @@ usable alone:
   recompiles); :class:`recover.ResilienceController` adds host-side
   bounded rewind-to-snapshot with exponential backoff;
   :class:`recover.RetryingIterator` retries the data iterator.
+* :mod:`.elastic` — the top rung: survive the *loss of a pipeline
+  stage*. :class:`elastic.BuddyStore` replicates every stage's
+  params/optimizer shard to its ring buddy on a cadence (one ppermute
+  hop, sha256-pinned bitwise against the source);
+  :class:`elastic.ElasticController` reads a per-stage gradient
+  heartbeat from the step's aux carry and raises
+  :class:`elastic.StageLost` when a stage goes persistently silent;
+  :func:`elastic.replan_after_loss` re-cuts the balance over the
+  ``n-1`` survivors, re-verifies the op table, restores from the
+  buddy, and resumes — :func:`elastic.train_elastic` drives the whole
+  ladder, aborting (:class:`recover.TrainingAborted`) past
+  ``max_replans``.
 
 The whole subsystem is strictly opt-in and the opt-out is bitwise: with
-``TrainerConfig.resilience=None`` (the default) and no
-:class:`ChaosPlan`, every lowered program is byte-identical to the
-unwired build — pinned by ``tests/test_resilience.py``'s HLO equality
-tests. See ``docs/resilience.md`` for the fault model and the recovery
-state machine.
+``TrainerConfig.resilience=None`` and ``TrainerConfig.elastic=None``
+(the defaults) and no :class:`ChaosPlan`, every lowered program is
+byte-identical to the unwired build — pinned by
+``tests/test_resilience.py`` and ``tests/test_elastic.py``'s HLO
+equality tests. See ``docs/resilience.md`` for the fault model and the
+recovery state machine.
 """
 
-from .chaos import ChaosError, ChaosPlan, Fault
-from .detect import TickWatchdog, step_guard
+from .chaos import (KILL_NONE, ChaosError, ChaosPlan, Fault, current_kill,
+                    kill_scope, wrap_stage_fn)
+from .detect import HopHealth, TickWatchdog, stage_heartbeat, step_guard
+from .elastic import (BuddyStore, ElasticConfig, ElasticController,
+                      StageLost, replan_after_loss, restack_state,
+                      train_elastic)
 from .recover import (DataIteratorFailed, ResilienceConfig,
                       ResilienceController, RetryingIterator,
                       TrainingAborted)
 
 __all__ = [
-    "ChaosError", "ChaosPlan", "Fault",
-    "TickWatchdog", "step_guard",
+    "ChaosError", "ChaosPlan", "Fault", "KILL_NONE", "current_kill",
+    "kill_scope", "wrap_stage_fn",
+    "HopHealth", "TickWatchdog", "stage_heartbeat", "step_guard",
+    "BuddyStore", "ElasticConfig", "ElasticController", "StageLost",
+    "replan_after_loss", "restack_state", "train_elastic",
     "DataIteratorFailed", "ResilienceConfig", "ResilienceController",
     "RetryingIterator", "TrainingAborted",
 ]

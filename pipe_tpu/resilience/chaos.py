@@ -35,6 +35,14 @@ Injection sites, by fault kind:
 ``slow_replica``    router fleet: replica ``stage``'s decode sleeps
                     ``magnitude`` seconds per tick while covered (the
                     watchdog sees the overrun; drives SUSPECT)
+``kill_stage``      pipeline stage ``stage``'s output zeroed permanently
+                    from ``step`` onward (rides the wrapped ``stage_fn``
+                    via a traced kill code; the stage never comes back —
+                    the elastic controller must re-plan around it)
+``persistent_hop_drop`` the stage-boundary hop leaving ``stage`` zeroed
+                    for EVERY micro-batch from the moment the fault
+                    arms (emulator executor; feeds the per-hop
+                    failure-streak counter)
 ==================  =======================================================
 
 Train-step faults ride a *traced* ``inject`` code (one int32 scalar
@@ -55,8 +63,9 @@ from typing import Optional, Sequence, Tuple
 __all__ = ["ChaosError", "Fault", "ChaosPlan",
            "INJECT_NONE", "INJECT_NAN_GRADS", "INJECT_INF_GRADS",
            "INJECT_NAN_LOSS", "INJECT_LOSS_SPIKE", "INJECT_NAN_ACT",
-           "inject_scope", "current_inject", "apply_train_faults",
-           "wrap_pre_fn"]
+           "KILL_NONE", "inject_scope", "current_inject",
+           "kill_scope", "current_kill", "apply_train_faults",
+           "wrap_pre_fn", "wrap_stage_fn"]
 
 
 class ChaosError(RuntimeError):
@@ -66,11 +75,13 @@ class ChaosError(RuntimeError):
 TRAIN_KINDS = ("nan_grads", "inf_grads", "nan_loss", "loss_spike",
                "nan_activations")
 DATA_KINDS = ("data_raise",)
-TRANSPORT_KINDS = ("transport_drop", "transport_corrupt")
+TRANSPORT_KINDS = ("transport_drop", "transport_corrupt",
+                   "persistent_hop_drop")
 SERVE_KINDS = ("stall_tick", "queue_flood", "backend_raise")
 REPLICA_KINDS = ("wedge_replica", "kill_replica", "slow_replica")
+STAGE_KINDS = ("kill_stage",)
 KINDS = TRAIN_KINDS + DATA_KINDS + TRANSPORT_KINDS + SERVE_KINDS \
-    + REPLICA_KINDS
+    + REPLICA_KINDS + STAGE_KINDS
 
 # Traced inject codes (the int32 scalar argument of the guarded step).
 INJECT_NONE = 0
@@ -84,6 +95,10 @@ _TRAIN_CODE = {"nan_grads": INJECT_NAN_GRADS,
                "nan_loss": INJECT_NAN_LOSS,
                "loss_spike": INJECT_LOSS_SPIKE,
                "nan_activations": INJECT_NAN_ACT}
+
+# Traced kill code (the int32 scalar argument of the elastic step):
+# the stage index to silence, or KILL_NONE for a healthy step.
+KILL_NONE = -1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -136,6 +151,16 @@ class ChaosPlan:
                 return f
         return None
 
+    def without(self, kind: str) -> "ChaosPlan":
+        """A new plan minus every ``kind`` fault (same seed). The
+        elastic recovery driver uses this to rebuild the survivor
+        topology's plan: the killed stage no longer exists, so its
+        ``kill_stage`` fault must not re-fire against the new indices."""
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
+        return ChaosPlan([f for f in self.faults if f.kind != kind],
+                         seed=self.seed)
+
     # -- train step ---------------------------------------------------------
 
     def train_inject(self, step: int) -> Tuple[int, float]:
@@ -145,6 +170,16 @@ class ChaosPlan:
             if f.kind in _TRAIN_CODE and f.covers(step):
                 return _TRAIN_CODE[f.kind], float(f.magnitude)
         return INJECT_NONE, 1.0
+
+    def train_kill(self, step: int) -> int:
+        """The stage index a ``kill_stage`` fault silences at ``step``,
+        or :data:`KILL_NONE`. Like ``kill_replica``, a stage kill is
+        permanent: it matches every step from ``step`` onward regardless
+        of ``count`` — a dead stage never comes back on its own."""
+        for f in self.faults:
+            if f.kind == "kill_stage" and step >= f.step:
+                return int(f.stage)
+        return KILL_NONE
 
     def last_train_fault_step(self) -> int:
         """Last step index any train-visible fault covers (-1 if none) —
@@ -168,10 +203,15 @@ class ChaosPlan:
 
     def transport_fault(self, microbatch: int, stage: int) -> Optional[str]:
         """'drop' | 'corrupt' | None for the hop leaving ``stage`` with
-        micro-batch ``microbatch`` (emulator executor only)."""
+        micro-batch ``microbatch`` (emulator executor only). A
+        ``persistent_hop_drop`` on the hop matches EVERY micro-batch —
+        the failure-streak counter sees it never clear."""
         for f in self.faults:
-            if f.kind in TRANSPORT_KINDS and f.stage == stage \
-                    and f.microbatch == microbatch:
+            if f.kind not in TRANSPORT_KINDS or f.stage != stage:
+                continue
+            if f.kind == "persistent_hop_drop":
+                return "drop"
+            if f.microbatch == microbatch:
                 return "drop" if f.kind == "transport_drop" else "corrupt"
         return None
 
@@ -242,6 +282,31 @@ def current_inject():
     return getattr(_trace_local, "code", None)
 
 
+class kill_scope:
+    """Context manager installing the traced kill code (a stage index,
+    or :data:`KILL_NONE`) for the duration of one elastic-step trace,
+    so wrapped stage fns (:func:`wrap_stage_fn`) can read it. Same
+    discipline as :class:`inject_scope`: ``code=None`` installs nothing
+    and the wrapped fns compile to the identity."""
+
+    def __init__(self, code):
+        self.code = code
+
+    def __enter__(self):
+        self._prev = getattr(_trace_local, "kill", None)
+        _trace_local.kill = self.code
+        return self
+
+    def __exit__(self, *exc):
+        _trace_local.kill = self._prev
+
+
+def current_kill():
+    """The traced kill code installed by :class:`kill_scope`, or None
+    outside any scope (including every non-elastic trace)."""
+    return getattr(_trace_local, "kill", None)
+
+
 def apply_train_faults(inject, magnitude, loss, grads):
     """Apply the grad/loss fault selected by the traced ``inject`` code.
     One scalar select + one broadcast multiply per tree — the program
@@ -279,3 +344,28 @@ def wrap_pre_fn(pre_fn):
         return h * scale.astype(h.dtype)
 
     return chaos_pre_fn
+
+
+def wrap_stage_fn(stage_fn):
+    """Wrap a model ``stage_fn`` so a traced ``kill_stage`` code zeroes
+    the killed stage's entire output (activations, stashes, stats — a
+    dead chip emits nothing). ``ctx.stage`` is a Python int in the
+    emulator and a traced ``axis_index`` in the compiled executors; the
+    ``==`` compare works in both. Outside a :class:`kill_scope` (every
+    non-elastic trace) the wrapper is a transparent pass-through — no
+    program change. Multiplying by the 1.0 branch is bitwise-exact, so
+    pre-kill steps match an unarmed run exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    def chaos_stage_fn(params, h, ctx, *rest):
+        out = stage_fn(params, h, ctx, *rest)
+        code = current_kill()
+        if code is None:
+            return out
+        scale = jnp.where(ctx.stage == code, jnp.float32(0.0),
+                          jnp.float32(1.0))
+        return jax.tree_util.tree_map(
+            lambda o: o * scale.astype(o.dtype), out)
+
+    return chaos_stage_fn

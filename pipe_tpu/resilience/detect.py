@@ -25,7 +25,7 @@ import dataclasses
 import math
 from typing import Optional
 
-__all__ = ["step_guard", "TickWatchdog"]
+__all__ = ["step_guard", "stage_heartbeat", "HopHealth", "TickWatchdog"]
 
 
 def step_guard(loss, grads, ewma, step, *, spike_factor: float,
@@ -56,6 +56,82 @@ def step_guard(loss, grads, ewma, step, *, spike_factor: float,
                   loss32),
         ewma)
     return ok, new_ewma
+
+
+def stage_heartbeat(stage_grads, n_stages: int):
+    """Per-stage gradient power — the elastic controller's liveness
+    signal, traced into the elastic train step.
+
+    ``stage_grads`` is the stage-stacked gradient pytree (every leaf
+    carries the ``n_stages`` leading axis). Returns a ``[n_stages]``
+    float32 vector of summed squared gradient magnitude per stage. A
+    killed stage ``j`` (output zeroed) contributes exactly 0.0 for every
+    stage ``<= j`` — the zero scale annihilates the backward signal into
+    and through the dead stage — while survivors downstream keep
+    nonzero grads (their params still shape the loss). The controller
+    localizes the kill as the LARGEST persistently-silent index. Like
+    :func:`step_guard`, this adds one reduction per leaf and no host
+    sync of its own: the vector rides the step aux carry.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(stage_grads)
+    total = jnp.zeros((n_stages,), jnp.float32)
+    for g in leaves:
+        g32 = g.astype(jnp.float32)
+        axes = tuple(range(1, g32.ndim))
+        total = total + jnp.sum(g32 * g32, axis=axes)
+    return total
+
+
+@dataclasses.dataclass
+class HopHealth:
+    """Per-hop failure-streak ledger for the emulator executor.
+
+    The emulator records every stage-boundary crossing
+    (:meth:`record`): a chaos-faulted hop bumps that hop's consecutive
+    streak, a clean crossing resets it. A transient ``transport_drop``
+    (one micro-batch) therefore never accumulates, while a
+    ``persistent_hop_drop`` marches the streak up by the full
+    micro-batch count every run — once it reaches ``dead_after`` the
+    hop lands in :attr:`dead_hops` and the caller escalates to the
+    elastic rung instead of retrying forever.
+    """
+
+    dead_after: int = 2
+    _streaks: dict = dataclasses.field(default_factory=dict, init=False,
+                                       repr=False, compare=False)
+    _faults: dict = dataclasses.field(default_factory=dict, init=False,
+                                      repr=False, compare=False)
+
+    def __post_init__(self):
+        if self.dead_after < 1:
+            raise ValueError(
+                f"dead_after must be >= 1, got {self.dead_after}")
+
+    def record(self, stage: int, faulted: bool) -> None:
+        """Fold one crossing of the hop leaving ``stage``."""
+        if faulted:
+            self._streaks[stage] = self._streaks.get(stage, 0) + 1
+            self._faults[stage] = self._faults.get(stage, 0) + 1
+        else:
+            self._streaks[stage] = 0
+
+    def streak(self, stage: int) -> int:
+        """Current consecutive-fault streak for the hop leaving
+        ``stage`` (0 = healthy or never crossed)."""
+        return self._streaks.get(stage, 0)
+
+    def faults(self, stage: int) -> int:
+        """Total faulted crossings of the hop since construction."""
+        return self._faults.get(stage, 0)
+
+    @property
+    def dead_hops(self) -> list:
+        """Hops whose streak has reached ``dead_after``, ascending."""
+        return sorted(j for j, s in self._streaks.items()
+                      if s >= self.dead_after)
 
 
 @dataclasses.dataclass

@@ -192,7 +192,8 @@ class RetryingIterator:
     def __init__(self, factory: Callable[[int], Iterator], *,
                  retries: int = 3, backoff_s: float = 0.05,
                  chaos=None, registry=None, events=None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 start: int = 0):
         self._factory = factory
         self._retries = retries
         self._backoff_s = backoff_s
@@ -201,7 +202,9 @@ class RetryingIterator:
         self._events = events
         self._sleep = sleep
         self._it: Optional[Iterator] = None
-        self._pos = 0
+        # ``start`` seeds the position for mid-epoch resumption (the
+        # elastic path): chaos/data indices stay GLOBAL batch indices.
+        self._pos = int(start)
 
     def __iter__(self) -> "RetryingIterator":
         return self

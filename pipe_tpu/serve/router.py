@@ -423,6 +423,33 @@ class Router:
 
     # -- retry parking -----------------------------------------------------
 
+    def reclaim(self, requests: List[Request], now: float) -> List[Response]:
+        """Re-absorb requests knocked off a replica — the ONE
+        park-or-finish decision both recovery paths share (a wedged
+        replica's evicted backlog and per-request retryable failures
+        from a live tick), so the exactly-once ledger has a single
+        writer. Per request: cancelled or past its deadline → parked
+        for the next sweep's terminal cancelled/timeout record; retry
+        budget remaining → parked with exponential backoff; else ONE
+        terminal ``retries_exhausted`` error. Returns the terminal
+        responses (already recorded in the ledger); parked requests
+        surface through later ticks."""
+        reg = get_registry()
+        finished: List[Response] = []
+        for req in requests:
+            if req.cancelled or (req.deadline is not None
+                                 and now >= req.deadline):
+                # next tick's parked sweep emits the terminal
+                # cancelled/timeout record
+                self._parked.append((now, req))
+            elif req.attempts < self.policy.retry_budget:
+                self._park(req, now)
+            else:
+                reg.counter("serve.fleet.retries_exhausted").inc()
+                finished.append(self._finish_unplaced(
+                    req, "error", "retries_exhausted", now))
+        return finished
+
     def _park(self, req: Request, now: float) -> None:
         p = self.policy
         delay = min(p.backoff_base_s * (2.0 ** max(req.attempts - 1, 0)),
@@ -467,20 +494,15 @@ class Router:
     def _wedge(self, rep: Replica, reason: str, now: float) -> None:
         """WEDGED: reclaim the backlog intact, re-place or park it under
         the retry budget, and start draining the live slots. One-way."""
-        reg = get_registry()
         rep.state = WEDGED
-        reg.counter("serve.fleet.wedged").inc()
+        get_registry().counter("serve.fleet.wedged").inc()
         evicted = rep.engine.evict_queued()
         self.events.event("resilience", action="replica_wedged",
                           replica=rep.index, reason=reason,
                           evicted=len(evicted))
-        for req in evicted:
-            if req.attempts >= self.policy.retry_budget:
-                self._finish_unplaced(req, "error", "retries_exhausted",
-                                      now)
-                reg.counter("serve.fleet.retries_exhausted").inc()
-            else:
-                self._park(req, now)
+        # terminal responses land in the ledger; tick's delivered list
+        # picks them up via response() like any mid-health-pass finish
+        self.reclaim(evicted, now)
         rep.engine.drain()
         rep.state = DRAINING
 
@@ -657,17 +679,7 @@ class Router:
                         and resp.finish_reason in RETRYABLE_REASONS
                         and req is not None):
                     rep.had_error_this_tick = True
-                    if req.cancelled or (req.deadline is not None
-                                         and now >= req.deadline):
-                        # next tick's parked sweep emits the terminal
-                        # cancelled/timeout record
-                        self._parked.append((now, req))
-                    elif req.attempts < self.policy.retry_budget:
-                        self._park(req, now)
-                    else:
-                        reg.counter("serve.fleet.retries_exhausted").inc()
-                        delivered.append(self._finish_unplaced(
-                            req, "error", "retries_exhausted", now))
+                    delivered.extend(self.reclaim([req], now))
                     continue
                 delivered.append(self._deliver(resp))
 

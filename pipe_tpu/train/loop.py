@@ -95,6 +95,17 @@ class TrainerConfig:
     # keeps the train step program byte-identical to the unguarded build
     # (pinned in tests/test_resilience.py).
     resilience: Optional[Any] = None
+    # Elastic degraded-mode training (docs/resilience.md): a
+    # resilience.ElasticConfig arms the elastic train step — the guarded
+    # step plus a traced stage-kill channel and a per-stage gradient
+    # heartbeat in the aux carry — and the buddy-replication controller
+    # that snapshots every stage's shard to its ring neighbor. On a
+    # persistently-silent stage the epoch raises
+    # resilience.StageLost; resilience.replan_after_loss rebuilds the
+    # run over the n-1 survivors (see tools/elastic_bench.py). Requires
+    # ``resilience``; None — the default — adds nothing to the program
+    # (pinned in tests/test_elastic.py).
+    elastic: Optional[Any] = None
 
 
 class Trainer:
@@ -113,8 +124,9 @@ class Trainer:
         def _mk_model(n_stages: int) -> PipelinedLM:
             m = PipelinedLM(model_cfg, n_stages)
             if chaos is not None:
-                from ..resilience.chaos import wrap_pre_fn
+                from ..resilience.chaos import wrap_pre_fn, wrap_stage_fn
                 m.pre_fn = wrap_pre_fn(m.pre_fn)
+                m.stage_fn = wrap_stage_fn(m.stage_fn)
             return m
 
         self.mesh = make_mesh(cfg.n_stages, cfg.n_data, devices=devices)
@@ -225,7 +237,19 @@ class Trainer:
         # placed params). The jitted step traces on first call, after that.
         self._zero_shardings = None
         self._param_shardings = None
-        if cfg.resilience is not None:
+        if cfg.elastic is not None:
+            if cfg.resilience is None:
+                raise ValueError(
+                    "TrainerConfig.elastic requires resilience= (the "
+                    "elastic rung extends the guarded step's ladder)")
+            if cfg.schedule in ("interleaved", "interleaved-1f1b"):
+                raise ValueError(
+                    "elastic training needs one stage per device "
+                    f"(schedule {cfg.schedule!r} interleaves "
+                    f"{cfg.interleave} virtual stages per device)")
+            self._step_fn = jax.jit(self._train_step_elastic,
+                                    donate_argnums=(0,))
+        elif cfg.resilience is not None:
             self._step_fn = jax.jit(self._train_step_guarded,
                                     donate_argnums=(0,))
         else:
@@ -488,6 +512,60 @@ class Trainer:
         return TrainState(params=params, opt_state=opt_state,
                           step=state.step + 1), loss, new_aux
 
+    def _train_step_elastic(self, state: TrainState, aux, x, w, key, lr,
+                            inject, magnitude, kill):
+        """The elastic step: the guarded step plus (a) a traced ``kill``
+        code (a stage index, or KILL_NONE) that zeroes the killed
+        stage's output through the wrapped stage fn, and (b) a
+        per-stage gradient heartbeat appended to the aux carry — a
+        ``[n_stages]`` int32 silent-streak vector the elastic
+        controller reads on its host cadence. Killing stage ``j``
+        silences grads for every stage ``<= j`` (the zero scale
+        annihilates the backward signal), so the controller localizes
+        the kill as the largest persistently-silent index. Streaks fold
+        only guard-accepted steps: a NaN/spike step must escalate
+        through the numeric ladder, never masquerade as a dead stage."""
+        from ..resilience.chaos import inject_scope, kill_scope
+        from ..resilience.detect import stage_heartbeat, step_guard
+
+        rc = self.cfg.resilience
+        ewma, consec, total, hb = aux
+        with inject_scope(inject), kill_scope(kill):
+            params, opt_state, loss, grads = self._compute_update(
+                state, x, w, key, lr, inject=inject, magnitude=magnitude)
+        ok, new_ewma = step_guard(
+            loss, grads, ewma, state.step, spike_factor=rc.spike_factor,
+            warmup_steps=rc.warmup_steps, ewma_alpha=rc.ewma_alpha)
+        beat = stage_heartbeat(grads[0], self.n_virtual)
+        silent = beat == jnp.float32(0.0)
+        new_hb = jnp.where(ok, jnp.where(silent, hb + 1, jnp.int32(0)), hb)
+
+        def select(new, old):
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), new, old)
+
+        params = select(params, state.params)
+        opt_state = select(opt_state, state.opt_state)
+        bad = (~ok).astype(jnp.int32)
+        new_aux = (new_ewma, jnp.where(ok, jnp.int32(0), consec + 1),
+                   total + bad, new_hb)
+        return TrainState(params=params, opt_state=opt_state,
+                          step=state.step + 1), loss, new_aux
+
+    def elastic_store(self):
+        """The trainer's buddy-replication store, created on first use.
+        Lives on the Trainer (not the epoch) so the snapshot survives
+        the ``StageLost`` raise and ``replan_after_loss`` can restore
+        from it."""
+        if getattr(self, "_buddy_store", None) is None:
+            from ..resilience.elastic import BuddyStore
+            self._buddy_store = BuddyStore(
+                self.mesh, self.cfg.n_stages,
+                verify=getattr(self.cfg.elastic, "verify_replication", True),
+                registry=self.registry, events=self.events,
+                snapshot_dir=getattr(self.cfg.elastic, "snapshot_dir", None))
+        return self._buddy_store
+
     def _eval_loss(self, params, x, w):
         return self._loss(params, x, w, make_key(0), False)
 
@@ -540,8 +618,16 @@ class Trainer:
                     state: Optional[TrainState] = None,
                     max_steps: Optional[int] = None,
                     log_every: int = 10,
-                    log_fn: Callable[[str], None] = print):
-        """One pass over ``source`` (a ``batchify``'d id matrix)."""
+                    log_fn: Callable[[str], None] = print,
+                    start_step: int = 0):
+        """One pass over ``source`` (a ``batchify``'d id matrix).
+
+        ``start_step`` resumes the epoch mid-pass at a global batch
+        index (the elastic recovery hook): batches, per-step PRNG folds
+        and chaos indices all replay from the GLOBAL index, so a run
+        rewound to step ``s`` and resumed with ``start_step=s`` walks
+        the identical tape an uninterrupted run would.
+        """
         cfg = self.cfg
         state = state if state is not None else self.init_state()
         lr = cfg.lr * cfg.lr_gamma ** epoch  # StepLR, main.py:185
@@ -566,6 +652,7 @@ class Trainer:
         # default loop touches none of these objects.
         rc = cfg.resilience
         resil = None
+        elastic = None
         aux = None
         if rc is not None:
             from ..resilience.recover import (ResilienceController,
@@ -573,18 +660,29 @@ class Trainer:
             resil = ResilienceController(rc, self.registry, self.events,
                                          log_fn=log_fn)
             aux = (jnp.float32(0.0), jnp.int32(0), jnp.int32(0))
+            if cfg.elastic is not None:
+                from ..resilience.elastic import ElasticController
+                elastic = ElasticController(
+                    cfg.elastic, self.elastic_store(),
+                    registry=self.registry, events=self.events,
+                    log_fn=log_fn)
+                aux = aux + (jnp.zeros((self.n_virtual,), jnp.int32),)
             batch_iter = RetryingIterator(
                 lambda pos: self._batches(source, n, start=pos),
                 retries=rc.data_retries, backoff_s=rc.data_backoff_s,
                 chaos=self.chaos, registry=self.registry,
-                events=self.events)
+                events=self.events, start=start_step)
         else:
-            batch_iter = self._batches(source, n)
+            batch_iter = self._batches(source, n, start=start_step)
 
         t_first = t0 = time.perf_counter()
         losses = []
         w = None
-        for b, (data, target) in enumerate(batch_iter):
+        for i, (data, target) in enumerate(batch_iter):
+            # b is the GLOBAL batch index (data position, PRNG fold,
+            # chaos index); i counts this call's iterations (compile
+            # sync, steady-state timing).
+            b = start_step + i
             x, mask = self._make_x(data, target)
             # Row count is constant until the tail-batch break, so the valid-
             # row mask is too — build it once, not per step.
@@ -599,7 +697,17 @@ class Trainer:
                     trace_dir = os.path.join(cfg.telemetry_dir,
                                              f"trace_step{b + 1}")
                     scopes.enter_context(profile_trace(trace_dir))
-                if rc is not None:
+                if elastic is not None:
+                    inject, mag = (self.chaos.train_inject(b)
+                                   if self.chaos is not None else (0, 1.0))
+                    from ..resilience.chaos import KILL_NONE
+                    kill = (self.chaos.train_kill(b)
+                            if self.chaos is not None else KILL_NONE)
+                    state, loss, aux = self._step_fn(
+                        state, aux, x, w, jax.random.fold_in(key, b),
+                        jnp.float32(lr), jnp.int32(inject),
+                        jnp.float32(mag), jnp.int32(kill))
+                elif rc is not None:
                     inject, mag = (self.chaos.train_inject(b)
                                    if self.chaos is not None else (0, 1.0))
                     state, loss, aux = self._step_fn(
@@ -641,8 +749,8 @@ class Trainer:
                     model_cfg=self.model_cfg,
                     analytic_bubble=self.analytic_bubble(),
                     memory=(device_memory_peaks()
-                            if at_log or b == 0 else {}),
-                    compile_inclusive=(b == 0), peak_flops=peak,
+                            if at_log or i == 0 else {}),
+                    compile_inclusive=(i == 0), peak_flops=peak,
                     platform=jax.default_backend(),
                     device_kind=device_kind, epoch=epoch)
                 self.events.step_report(report)
@@ -652,12 +760,21 @@ class Trainer:
             if resil is not None:
                 # Rewind/abort policy on the host cadence; may replace
                 # (state, aux) with known-good copies or raise
-                # TrainingAborted after the rewind budget.
-                state, aux = resil.after_step(b, state, aux)
+                # TrainingAborted after the rewind budget. The elastic
+                # heartbeat streak rides outside the numeric triple —
+                # it survives a numeric rewind untouched.
+                if elastic is not None:
+                    state, aux3 = resil.after_step(b, state, aux[:3])
+                    aux = aux3 + (aux[3],)
+                    # Buddy capture on healthy cadence; raises StageLost
+                    # once a stage's silent streak crosses dead_after.
+                    state, aux = elastic.after_step(b, state, aux)
+                else:
+                    state, aux = resil.after_step(b, state, aux)
             if self._autosave_pending():
                 self._autosave(state, log_fn)
                 break
-            if b == 0:
+            if i == 0:
                 float(loss)               # sync out the compile
                 t0 = time.perf_counter()  # steady-state timing from step 2
             if at_log:
@@ -665,7 +782,7 @@ class Trainer:
                 # Steady-state ms/batch from step 2 on; the step-1 line has no
                 # steady-state sample yet, so it reports the compile-inclusive
                 # first-step time instead of a meaningless ~0.
-                dt = ((time.perf_counter() - t0) / b if b >= 1
+                dt = ((time.perf_counter() - t0) / i if i >= 1
                       else time.perf_counter() - t_first)
                 log_fn(f"| epoch {epoch} | step {b+1}/{n} "
                        f"| lr {lr:.3f} "
@@ -701,6 +818,13 @@ class Trainer:
             info["anomalies"] = resil.anomalies
             info["rewinds"] = resil.rewinds
             info["loss_ewma"] = float(aux[0])
+        if elastic is not None:
+            info["buddy_snapshots"] = elastic.snapshots
+            # per-step loss series keyed by GLOBAL batch index, so a
+            # resumed segment's trajectory can be compared against an
+            # uninterrupted run's (tests + tools/elastic_bench.py)
+            info["loss_by_step"] = {start_step + i: float(l)
+                                    for i, l in enumerate(losses)}
         return state, info
 
     def evaluate(self, source: np.ndarray, state: TrainState,

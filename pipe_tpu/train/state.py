@@ -17,7 +17,9 @@ import jax
 
 __all__ = ["TrainState", "CheckpointCorrupt", "save_checkpoint",
            "restore_checkpoint", "latest_step", "checkpoint_params_layout",
-           "restore_params", "read_params_layout", "state_manifest"]
+           "restore_params", "read_params_layout", "state_manifest",
+           "stage_shard_manifest", "write_buddy_manifest",
+           "read_buddy_manifest"]
 
 
 class CheckpointCorrupt(RuntimeError):
@@ -60,28 +62,111 @@ def state_manifest(state: Any) -> dict:
     return leaves
 
 
+def stage_shard_manifest(staged_leaves: Any, n_stages: int) -> dict:
+    """Per-STAGE sha256 hashes of a stage-stacked pytree (every leaf
+    leads with the ``n_stages`` axis) — the buddy-replication pin. Each
+    stage's digest covers the dtype, shape and raw bytes of that
+    stage's slice of every leaf in flattening order, so a buddy copy of
+    shard ``j`` can be verified bitwise against the source shard
+    without shipping the source around."""
+    import hashlib
+
+    import numpy as np
+
+    digests = {}
+    leaves = jax.tree_util.tree_leaves(staged_leaves)
+    for j in range(n_stages):
+        h = hashlib.sha256()
+        for leaf in leaves:
+            arr = np.asarray(leaf)[j]
+            h.update(str(arr.dtype).encode())
+            h.update(str(arr.shape).encode())
+            h.update(arr.tobytes())
+        digests[str(j)] = h.hexdigest()
+    return digests
+
+
 def _manifest_path(directory: str, step: int):
     from etils import epath
 
     return epath.Path(directory) / f"manifest_step{step}.json"
 
 
-def _write_manifest(directory: str, step: int, manifest: dict) -> None:
-    """Write the manifest atomically: temp name + rename, so a crash
-    mid-write leaves either no manifest (restore skips verification with
-    a warning) or a complete one — never a torn file."""
+def _atomic_write_json(target, doc: dict) -> None:
+    """Write ``doc`` to ``target`` atomically AND durably: temp name,
+    fsync the data, rename, fsync the directory. A host crash at any
+    point leaves either no file or a complete one — never a torn file,
+    and never a rename that outlives its (unsynced) content. Non-local
+    epath backends (gs:// etc.) have no fd to fsync; those fall back to
+    the plain temp+rename, whose stores are already atomic."""
     import json
+    import os
 
-    target = _manifest_path(directory, step)
+    payload = json.dumps(doc, indent=0, sort_keys=True)
     tmp = target.parent / f".{target.name}.tmp"
-    tmp.write_text(json.dumps({"step": step, "leaves": manifest},
-                              indent=0, sort_keys=True))
+    try:
+        fd = os.open(os.fspath(tmp), os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                     0o644)
+        try:
+            os.write(fd, payload.encode())
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(os.fspath(tmp), os.fspath(target))
+        dfd = os.open(os.fspath(target.parent), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        return
+    except (OSError, TypeError, ValueError):
+        pass
+    tmp.write_text(payload)
     try:
         tmp.rename(target)
     except OSError:
         # some epath backends lack rename; fall back to direct write
         target.write_text(tmp.read_text())
         tmp.unlink(missing_ok=True)
+
+
+def _write_manifest(directory: str, step: int, manifest: dict) -> None:
+    """Write the manifest atomically (see :func:`_atomic_write_json`),
+    so a crash mid-write leaves either no manifest (restore skips
+    verification with a warning) or a complete one — never a torn
+    file."""
+    _atomic_write_json(_manifest_path(directory, step),
+                       {"step": step, "leaves": manifest})
+
+
+def _buddy_manifest_path(directory: str, step: int):
+    from etils import epath
+
+    return epath.Path(directory) / f"buddy_step{step}.json"
+
+
+def write_buddy_manifest(directory: str, step: int,
+                         shards: dict, n_stages: int) -> None:
+    """Persist a buddy-snapshot manifest (per-stage shard digests from
+    :func:`stage_shard_manifest`) with the same fsync'd tmp+rename
+    discipline as checkpoint manifests. The elastic controller writes
+    one per capture when given a directory, so a post-crash operator
+    can audit which buddy generation was consistent."""
+    _atomic_write_json(
+        _buddy_manifest_path(directory, step),
+        {"step": step, "n_stages": n_stages, "stage_shards": shards})
+
+
+def read_buddy_manifest(directory: str, step: int) -> Optional[dict]:
+    """Read a buddy-snapshot manifest, or None when absent. Leftover
+    temp files from a torn write (``.buddy_step{N}.json.tmp``) are
+    never consulted — only a completed rename counts."""
+    import json
+
+    record = _buddy_manifest_path(directory, step)
+    if not record.exists():
+        return None
+    return json.loads(record.read_text())
 
 
 def _manager(directory: str, max_to_keep: int = 3):

@@ -1,0 +1,333 @@
+"""pipe_tpu.resilience.elastic: survive stage loss, re-plan, resume.
+
+The pins that frame the elastic rung:
+
+* **Bitwise opt-out** — ``TrainerConfig.elastic=None`` lowers the train
+  step byte-identical before and after the elastic machinery exists in
+  the process (``test_train_step_hlo_unchanged_by_elastic``).
+* **Bitwise replication** — every buddy capture re-hashes the copies
+  against the source shards, and restore reassembles the exact state.
+* **Bitwise regrouping** — restacking an n-stage state over n-1 stages
+  equals a born-(n-1)-stage initialization (global-layer init keys),
+  and a resumed segment equals the uninterrupted run on the same
+  global batch indices.
+* **Verified re-planning** — the degraded op table passes the same
+  emission proofs (verify_op_tables + compile_phases) every table must.
+* **The drill** — kill a stage mid-run: heartbeat detection, re-plan,
+  buddy restore, resumed loss trajectory tracking the unkilled run
+  (this is the ``pytest -m chaos`` smoke lane bench.py executes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pipe_tpu.core.balance import BalanceError, rebalance_stage_loss
+from pipe_tpu.core.schedule import replan_stage_loss
+from pipe_tpu.data import lm_text
+from pipe_tpu.models.transformer_lm import LMConfig
+from pipe_tpu.resilience import (KILL_NONE, ChaosPlan, ElasticConfig,
+                                 Fault, HopHealth, ResilienceConfig,
+                                 restack_state, stage_heartbeat)
+from pipe_tpu.resilience.chaos import INJECT_NONE, inject_scope, kill_scope
+from pipe_tpu.resilience.elastic import train_elastic
+from pipe_tpu.train.loop import Trainer, TrainerConfig
+from pipe_tpu.utils.rng import make_key
+
+pytestmark = pytest.mark.chaos
+
+CFG = LMConfig(vocab=67, d_model=16, nhead=2, d_ff=32, n_layers=4,
+               seq_len=32, dropout=0.0)
+# 12 layers regroup uniformly over 4 AND 3 stages — the drill geometry
+DRILL_CFG = LMConfig(vocab=67, d_model=16, nhead=2, d_ff=32, n_layers=12,
+                     seq_len=32, dropout=0.0)
+RC = ResilienceConfig(warmup_steps=100, rewind_after=3, snapshot_every=3,
+                      data_backoff_s=0.0, rewind_backoff_s=0.0)
+
+
+def _tc(n_stages=2, elastic="default", **kw):
+    base = dict(batch_size=8, bptt=16, chunks=2, n_stages=n_stages,
+                schedule="gpipe", checkpoint="never", lr=0.01,
+                resilience=RC)
+    if elastic == "default":
+        base["elastic"] = ElasticConfig(snapshot_every=3, dead_after=2)
+    elif elastic is not None:
+        base["elastic"] = elastic
+    base.update(kw)
+    return TrainerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def source():
+    ids = np.random.RandomState(0).randint(0, CFG.vocab, size=20000)
+    return lm_text.batchify(ids, 8)
+
+
+def _host(tree):
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a) if isinstance(a, jax.Array) else a, tree)
+
+
+def _trees_equal(a, b):
+    al, bl = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(al) == len(bl) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(al, bl))
+
+
+# ---------------------------------------------------------------------------
+# plan / balance units
+
+
+def test_rebalance_stage_loss():
+    assert rebalance_stage_loss([3, 3, 3, 3]) == [4, 4, 4]
+    # cost-weighted: the expensive layer ends up alone-ish
+    assert rebalance_stage_loss([2, 2, 2],
+                                costs=[1, 1, 5, 1, 1, 1]) == [3, 3]
+    with pytest.raises(BalanceError):
+        rebalance_stage_loss([4])                   # nothing to shrink to
+    with pytest.raises(BalanceError):
+        rebalance_stage_loss([2, 2], costs=[1.0])   # costs/layers mismatch
+
+
+def test_replan_stage_loss_emits_verified_tables():
+    for schedule in ("gpipe", "1f1b", "zb-h1"):
+        plan = replan_stage_loss(8, 4, 1, schedule=schedule,
+                                 balance=[3, 3, 3, 3])
+        assert plan.n_stages == 3
+        assert plan.balance == (4, 4, 4)
+        assert plan.op is not None      # table emitted for the new width
+        assert plan.phase.accepted, plan.phase.reason
+
+
+def test_replan_stage_loss_rejects_bad_topologies():
+    with pytest.raises(ValueError):
+        replan_stage_loss(8, 1, 0)                  # n_stages < 2
+    with pytest.raises(ValueError):
+        replan_stage_loss(8, 4, 7)                  # lost stage out of range
+    with pytest.raises(ValueError):
+        replan_stage_loss(8, 4, 1, schedule="interleaved-1f1b")
+
+
+def test_chaos_kill_plan_units():
+    plan = ChaosPlan([Fault("kill_stage", step=6, stage=1),
+                      Fault("nan_grads", step=2)])
+    assert plan.train_kill(5) == KILL_NONE
+    assert plan.train_kill(6) == 1
+    assert plan.train_kill(99) == 1                 # permanent
+    survivor = plan.without("kill_stage")
+    assert survivor.train_kill(99) == KILL_NONE
+    assert any(f.kind == "nan_grads" for f in survivor.faults)
+    with pytest.raises(ValueError):
+        plan.without("not_a_kind")
+
+
+def test_persistent_hop_drop_and_hop_health():
+    from pipe_tpu.core import microbatch as mb
+    from pipe_tpu.parallel import emulator
+
+    def stage(p, x, ctx):
+        return jnp.tanh(x @ p)
+
+    key = jax.random.key(7)
+    params = [jax.random.normal(jax.random.fold_in(key, s), (8, 8))
+              for s in range(2)]
+    xs = [mb.Batch(jax.random.normal(jax.random.fold_in(key, 10 + i),
+                                     (4, 8)), atomic=True)
+          for i in range(3)]
+
+    def run(chaos, hh=None):
+        out = emulator.run([stage, stage], params, list(xs), chaos=chaos,
+                           hop_health=hh)
+        return [np.asarray(b.values[0]) for b in out]
+
+    clean = run(None)
+    hh = HopHealth(dead_after=2)
+    faulted = run(ChaosPlan([Fault("persistent_hop_drop", step=0,
+                                   stage=0)]), hh)
+    # EVERY micro-batch dropped (a transient drop hits exactly one)
+    assert all(not np.array_equal(a, b) for a, b in zip(faulted, clean))
+    assert hh.streak(0) == 3
+    assert hh.dead_hops == [0]
+    # transient drop by contrast: streak resets, hop never declared dead
+    hh2 = HopHealth(dead_after=2)
+    run(ChaosPlan([Fault("transport_drop", step=0, stage=0,
+                         microbatch=1)]), hh2)
+    assert hh2.streak(0) == 0 and hh2.dead_hops == []
+    assert all(np.array_equal(a, b) for a, b in zip(run(None), clean))
+
+
+# ---------------------------------------------------------------------------
+# detection physics
+
+
+def test_kill_heartbeat_localizes_stage(source):
+    """Killing stage j zeroes grads for every stage <= j and none
+    above — the largest silent index IS the dead stage."""
+    tr = Trainer(CFG, _tc(), chaos=ChaosPlan([]))
+    state = tr.init_state()
+    data, target = next(tr._batches(source, 2))
+    x, w = tr._make_x(data, target)
+
+    def beat(kill):
+        with inject_scope(jnp.int32(INJECT_NONE)), \
+                kill_scope(jnp.int32(kill)):
+            _, _, _, grads = tr._compute_update(
+                state, x, w, make_key(0), jnp.float32(0.01),
+                inject=jnp.int32(INJECT_NONE), magnitude=jnp.float32(0.0))
+        return np.asarray(stage_heartbeat(grads[0], 2))
+
+    clean = beat(KILL_NONE)
+    assert (clean > 0).all()
+    k0 = beat(0)
+    assert k0[0] == 0.0 and k0[1] > 0.0
+    k1 = beat(1)
+    assert (k1 == 0.0).all()        # last stage kill silences everything
+
+
+# ---------------------------------------------------------------------------
+# buddy replication
+
+
+def test_buddy_capture_restore_bitwise(source):
+    tr = Trainer(CFG, _tc(), chaos=ChaosPlan([]))
+    state = tr.init_state()
+    store = tr.elastic_store()
+    store.capture(state, 3)          # verify=True re-hashes vs source
+    assert store.has_snapshot and store.step == 3
+    restored = store.restore_state()
+    assert _trees_equal(_host(state), restored)
+
+
+def test_buddy_restore_detects_corruption(source):
+    tr = Trainer(CFG, _tc(), chaos=ChaosPlan([]))
+    store = tr.elastic_store()
+    store.capture(tr.init_state(), 0)
+    store._buddy[0] = np.array(store._buddy[0], copy=True)
+    store._buddy[0].reshape(-1)[0] += 1.0
+    with pytest.raises(RuntimeError, match="manifest"):
+        store.restore_state()
+
+
+# ---------------------------------------------------------------------------
+# restacking
+
+
+def test_restack_matches_born_narrow_init():
+    """4-stage init regrouped over 2 stages == born-2-stage init,
+    bitwise — PipelinedLM keys every block by GLOBAL layer index."""
+    tr4 = Trainer(CFG, _tc(4, chunks=4),
+                  devices=jax.devices()[:4])
+    tr2 = Trainer(CFG, _tc(2))
+    s4, s2 = tr4.init_state(), tr2.init_state()
+    restacked = restack_state(_host(s4), 4, 2)
+    assert _trees_equal(restacked.params, _host(s2.params))
+    with pytest.raises(ValueError):
+        restack_state(_host(s4), 4, 3)   # 4 layers don't regroup over 3
+
+
+def test_restack_roundtrip_identity():
+    tr2 = Trainer(CFG, _tc(2))
+    s2 = _host(tr2.init_state())
+    again = restack_state(restack_state(s2, 2, 4), 4, 2)
+    assert _trees_equal(again, s2)
+
+
+# ---------------------------------------------------------------------------
+# the HLO pin (acceptance criterion)
+
+
+def test_train_step_hlo_unchanged_by_elastic(source):
+    """elastic=None => the train step lowers byte-identical before and
+    after the elastic machinery exists in the process."""
+    tr = Trainer(CFG, _tc(elastic=None, resilience=None))
+    state = tr.init_state()
+    data, target = next(tr._batches(source, 1))
+    x, w = tr._make_x(data, target)
+    args = (state, x, w, jax.random.key(0), jnp.float32(0.01))
+    base = tr._step_fn.lower(*args).as_text()
+
+    etr = Trainer(CFG, _tc(), chaos=ChaosPlan([Fault("kill_stage",
+                                                     step=0, stage=0)]))
+    es = etr.init_state()
+    aux = (jnp.float32(0.0), jnp.int32(0), jnp.int32(0),
+           jnp.zeros((2,), jnp.int32))
+    etr._step_fn.lower(es, aux, x, w, jax.random.key(0),
+                       jnp.float32(0.01), jnp.int32(-1),
+                       jnp.float32(0.0), jnp.int32(0)).as_text()
+    etr.elastic_store().capture(es, 0)
+
+    assert tr._step_fn.lower(*args).as_text() == base
+
+
+def test_elastic_requires_resilience_and_flat_schedules():
+    with pytest.raises(ValueError, match="resilience"):
+        Trainer(CFG, _tc(resilience=None))
+    with pytest.raises(ValueError, match="interleave"):
+        Trainer(CFG, _tc(schedule="interleaved-1f1b", chunks=4))
+
+
+# ---------------------------------------------------------------------------
+# mid-epoch resumption
+
+
+@pytest.mark.slow
+def test_resume_start_step_bitwise(source):
+    """Splitting an epoch at a step boundary (the elastic resume path)
+    reproduces the uninterrupted run bitwise: batches, PRNG folds, and
+    chaos indices all key on the GLOBAL batch index."""
+    tr = Trainer(CFG, _tc(), chaos=ChaosPlan([]))
+    straight, _ = tr.train_epoch(source, 0, tr.init_state(), max_steps=6,
+                                 log_every=0)
+    tr2 = Trainer(CFG, _tc(), chaos=ChaosPlan([]))
+    part, _ = tr2.train_epoch(source, 0, tr2.init_state(), max_steps=4,
+                              log_every=0)
+    resumed, _ = tr2.train_epoch(source, 0, part, max_steps=6,
+                                 log_every=0, start_step=4)
+    assert _trees_equal(_host(straight.params), _host(resumed.params))
+    assert _trees_equal(_host(straight.opt_state),
+                        _host(resumed.opt_state))
+
+
+# ---------------------------------------------------------------------------
+# the drill (bench.py's ``pytest -m chaos`` smoke lane)
+
+
+@pytest.mark.slow
+def test_elastic_drill_loss_trajectory():
+    """Kill stage 1 of 4 mid-run: detection + re-plan + buddy restore,
+    and the resumed loss trajectory tracks the unkilled 4-stage run
+    step-for-step after the rewind point."""
+    ids = np.random.RandomState(0).randint(0, DRILL_CFG.vocab, size=20000)
+    src = lm_text.batchify(ids, 8)
+
+    def cfg(n):
+        return _tc(n, chunks=4,
+                   elastic=ElasticConfig(snapshot_every=3, dead_after=2))
+
+    plan = ChaosPlan([Fault("kill_stage", step=6, stage=1)])
+    tr = Trainer(DRILL_CFG, cfg(4), chaos=plan)
+    tr2, state, info = train_elastic(tr, src, max_steps=10,
+                                     log_fn=lambda m: None)
+    assert info["replans"] == 1
+    rec = info["recoveries"][0]
+    assert rec["stage"] == 1
+    assert rec["snapshot_step"] == 5 and rec["detected_step"] == 7
+    assert tr2.cfg.n_stages == 3
+    assert all(bool(jnp.isfinite(l).all())
+               for l in jax.tree_util.tree_leaves(state.params)
+               if jnp.issubdtype(l.dtype, jnp.inexact))
+
+    # unkilled reference on the same global batches
+    ref = Trainer(DRILL_CFG, cfg(4), chaos=ChaosPlan([]))
+    _, ref_info = ref.train_epoch(src, 0, ref.init_state(), max_steps=10,
+                                  log_every=0)
+    got = info["loss_by_step"]
+    want = ref_info["loss_by_step"]
+    resumed_steps = sorted(got)
+    assert resumed_steps == [6, 7, 8, 9]   # resumed from snapshot 5 + 1
+    for b in resumed_steps:
+        assert got[b] == pytest.approx(want[b], rel=1e-4), (
+            f"step {b}: resumed loss {got[b]} vs unkilled {want[b]}")

@@ -173,6 +173,81 @@ def test_retrying_iterator_exhausts_budget():
         next(it)
 
 
+def _flaky_factory(fails):
+    """Factory whose source raises ``fails['left']`` times, then yields
+    0..2 from the requested position."""
+    def factory(pos):
+        def gen():
+            if fails["left"] > 0:
+                fails["left"] -= 1
+                raise ChaosError("flaky source")
+            for i in range(pos, 3):
+                yield i
+        return gen()
+    return factory
+
+
+def test_retrying_iterator_delivers_at_exact_retry_cap():
+    # the source fails exactly `retries` times: the last permitted
+    # rebuild must deliver, not abort one attempt early
+    fails = {"left": 2}
+    it = RetryingIterator(_flaky_factory(fails), retries=2, backoff_s=0.0,
+                          sleep=lambda s: None)
+    assert list(it) == [0, 1, 2]
+    assert fails["left"] == 0
+
+
+def test_retrying_iterator_one_past_cap_aborts():
+    # one more failure than the budget allows — even though the next
+    # rebuild would have succeeded, the cap is the cap
+    fails = {"left": 3}
+    it = RetryingIterator(_flaky_factory(fails), retries=2, backoff_s=0.0,
+                          sleep=lambda s: None)
+    with pytest.raises(DataIteratorFailed, match="failed 3 times"):
+        next(it)
+
+
+def test_retrying_iterator_backoffs_double_under_fake_clock():
+    def factory(pos):
+        def gen():
+            raise ChaosError("always")
+            yield  # pragma: no cover
+        return gen()
+
+    slept = []
+    it = RetryingIterator(factory, retries=3, backoff_s=0.25,
+                          sleep=slept.append)
+    with pytest.raises(DataIteratorFailed):
+        next(it)
+    # one sleep per burnt retry (none after the final failure), each
+    # exactly double the last — strictly monotone, no wall clock read
+    assert slept == [0.25 * 2 ** k for k in range(3)]
+    assert all(b > a for a, b in zip(slept, slept[1:]))
+
+
+def test_controller_rewind_backoffs_double_and_pin_oldest_snapshot():
+    cfg = ResilienceConfig(rewind_after=1, max_rewinds=3,
+                           snapshot_every=100, warmup_steps=100,
+                           rewind_backoff_s=0.5)
+    slept, lines = [], []
+    ctl = ResilienceController(cfg, get_registry(), NULL_EVENT_LOG,
+                               log_fn=lines.append, sleep=slept.append)
+    good = {"w": jnp.arange(3.0)}
+    ctl.after_step(0, good, _aux(0, 0))          # the ONLY snapshot: step 0
+    bad = {"w": jnp.full((3,), jnp.nan)}
+    for k in range(3):
+        state, aux = ctl.after_step(k + 1, bad, _aux(1, k + 1))
+        assert np.array_equal(np.asarray(state["w"]), np.arange(3.0))
+    # every rewind targeted the oldest (and only) in-memory snapshot —
+    # there is nothing older to reach — and each backoff doubled
+    assert ctl.rewinds == 3
+    assert all("snapshot of step 0" in l for l in lines)
+    assert slept == [0.5 * 2 ** k for k in range(3)]
+    assert all(b > a for a, b in zip(slept, slept[1:]))
+    with pytest.raises(TrainingAborted, match="after 3 rewinds"):
+        ctl.after_step(4, bad, _aux(1, 4))
+
+
 # ---------------------------------------------------------------------------
 # guarded trainer: parity, skip-step, data retry
 
@@ -543,6 +618,34 @@ def test_checkpoint_manifest_verifies_and_names_corrupt_leaf(tmp_path,
     assert leaf in str(ei.value)
 
     restore_checkpoint(ckpt, tr.init_state(), verify=False)  # opt-out
+
+
+def test_torn_manifest_tmp_files_ignored_on_restore(tmp_path):
+    """A crash between tmp-write and rename leaves ``.*.tmp`` droppings;
+    only a completed rename may ever be read back."""
+    from pipe_tpu.train.state import (read_buddy_manifest,
+                                      restore_checkpoint, save_checkpoint,
+                                      write_buddy_manifest)
+
+    shards = {"stage0": "a" * 64, "stage1": "b" * 64}
+    write_buddy_manifest(str(tmp_path), 5, shards, 2)
+    # torn writes: a truncated tmp NEXT TO the good step-5 record, and
+    # a step-7 write that died before its rename
+    (tmp_path / ".buddy_step5.json.tmp").write_text('{"step": 5, "n_st')
+    (tmp_path / ".buddy_step7.json.tmp").write_text('{"step": 7')
+    doc = read_buddy_manifest(str(tmp_path), 5)
+    assert doc == {"step": 5, "n_stages": 2, "stage_shards": shards}
+    assert read_buddy_manifest(str(tmp_path), 7) is None
+
+    # checkpoint side: a leftover torn manifest tmp must neither block
+    # nor pollute verification of the completed manifest
+    tr = Trainer(CFG, _tc())
+    state = tr.init_state()
+    ckpt = tmp_path / "ck"
+    save_checkpoint(str(ckpt), state, 0)
+    (ckpt / ".manifest_step0.json.tmp").write_text('{"step": 0, "leav')
+    restored = restore_checkpoint(str(ckpt), tr.init_state())
+    assert _params_equal(restored.params, state.params)
 
 
 # ---------------------------------------------------------------------------

@@ -237,6 +237,42 @@ def test_backoff_parks_until_eligible():
     assert bad.attempts == 2
 
 
+def test_reclaim_park_or_finish_decisions_and_exactly_once():
+    """``Router.reclaim`` is the ONE shared park-or-finish gate for
+    requests knocked off a replica (wedge eviction and live-tick
+    failures both route through it): cancelled/expired → parked for the
+    terminal sweep, budget remaining → parked with backoff, budget
+    spent → exactly one terminal error."""
+    from pipe_tpu.serve.queue import Request
+
+    router, t = make_fleet(1, retry_budget=2, backoff_base_s=1.0,
+                           backoff_max_s=8.0)
+    now = 5.0
+    cancelled = Request(id=101, prompt=[1], max_new_tokens=4,
+                        cancelled=True, attempts=1)
+    expired = Request(id=102, prompt=[1], max_new_tokens=4,
+                      deadline=4.0, attempts=1)
+    retryable = Request(id=103, prompt=[1], max_new_tokens=4, attempts=1)
+    spent = Request(id=104, prompt=[1], max_new_tokens=4, attempts=2,
+                    submitted_at=1.0)
+
+    finished = router.reclaim([cancelled, expired, retryable, spent], now)
+
+    # only the spent request is terminal, and it is already ledgered
+    assert [r.request_id for r in finished] == [104]
+    assert finished[0].status == "error"
+    assert finished[0].finish_reason == "retries_exhausted"
+    assert router.response(104) is finished[0]
+    # cancelled/expired park at `now` (no backoff credit); the
+    # retryable one parks at now + base * 2^(attempts-1)
+    parked = {req.id: at for at, req in router._parked}
+    assert parked == {101: now, 102: now, 103: now + 1.0}
+    # re-reclaiming the spent request would double-deliver: the ledger
+    # refuses loudly instead of silently overwriting
+    with pytest.raises(RuntimeError, match="exactly-once"):
+        router.reclaim([spent], now)
+
+
 # ---------------------------------------------------------------------------
 # satellites: cancellation after failover, all-SUSPECT backpressure
 

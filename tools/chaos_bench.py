@@ -18,13 +18,23 @@ record whether it **recovered** and how many steps the fault cost
   (faulted output != clean output), and (b) a retry without the fault
   must reproduce the clean output bitwise — the transient-loss recovery
   story. ``steps_to_recover`` = 1 retried execution.
+* **Persistent transport** (``persistent_hop_drop``) — the hop drops
+  EVERY micro-batch from the fault on; the
+  :class:`~pipe_tpu.resilience.HopHealth` streak counter must cross its
+  ``dead_after`` threshold (the signal the elastic rung consumes) and a
+  rerun without the fault must be bitwise clean.
+* **Stage loss** (``kill_stage``) — a pipeline stage dies mid-run; the
+  elastic rung (``resilience.elastic``) must detect it from the
+  gradient heartbeat, re-plan over the survivors, restore from the
+  buddy ring, and finish with finite params.
+  ``steps_to_recover`` = steps lost to the rewind (detected - snapshot).
 * **Serve faults** (``stall_tick`` / ``queue_flood`` /
   ``backend_raise``) — the engine must keep serving: stalls are counted
   by the watchdog, floods cannot starve real (higher-priority) traffic,
   and a raising backend errors only the request it hit.
 
 Usage:
-  python tools/chaos_bench.py                 # full run -> CHAOS_r09.json
+  python tools/chaos_bench.py                 # full run -> CHAOS_r11.json
   python tools/chaos_bench.py --quick         # subset, one JSON line
 Progress goes to stderr; the last stdout line is always the summary
 object, so ``bench.py`` embeds the --quick summary.
@@ -139,6 +149,87 @@ def data_trial():
                 "steps_completed": int(info["steps"])}
     finally:
         set_registry(reg)
+
+
+def kill_stage_trial():
+    """kill_stage: stage 1 of 3 dies mid-run. The elastic rung must
+    localize it from the gradient heartbeat, re-plan to 2 stages,
+    restore from the buddy ring, and finish every step with finite
+    params (the full bitwise pin lives in tools/elastic_bench.py)."""
+    reg = set_registry(MetricsRegistry())
+    try:
+        from pipe_tpu.resilience import ElasticConfig
+        from pipe_tpu.resilience.elastic import train_elastic
+        # 6 layers: divisible by 3 (healthy) and 2 (degraded)
+        ecfg = LMConfig(vocab=67, d_model=16, nhead=2, d_ff=32,
+                        n_layers=6, seq_len=32, dropout=0.0)
+        tc = TrainerConfig(batch_size=8, bptt=16, chunks=2, n_stages=3,
+                           schedule="gpipe", checkpoint="never", lr=0.01,
+                           resilience=_resilience(),
+                           elastic=ElasticConfig(snapshot_every=2,
+                                                 dead_after=2))
+        plan = ChaosPlan([Fault("kill_stage", step=4, stage=1)])
+        tr = Trainer(ecfg, tc, devices=jax.devices()[:3], chaos=plan)
+        t0 = time.perf_counter()
+        tr2, state, info = train_elastic(tr, _source(), max_steps=STEPS,
+                                         log_fn=log)
+        rec = info["recoveries"][0] if info["recoveries"] else {}
+        finite = _finite(state)
+        recovered = (info["replans"] == 1 and tr2.cfg.n_stages == 2
+                     and rec.get("stage") == 1 and finite)
+        return {"recovered": bool(recovered),
+                "steps_to_recover": int(rec.get("lost_steps", -1)),
+                "killed_stage": rec.get("stage"),
+                "detected_step": rec.get("detected_step"),
+                "snapshot_step": rec.get("snapshot_step"),
+                "stages_after": int(tr2.cfg.n_stages),
+                "params_finite": bool(finite),
+                "wall_s": round(time.perf_counter() - t0, 2)}
+    finally:
+        set_registry(reg)
+
+
+def persistent_hop_trial():
+    """persistent_hop_drop: the stage-0 hop drops EVERY micro-batch
+    from the fault on. The HopHealth streak must cross ``dead_after``
+    (the detection signal, where a transient drop's streak resets) and
+    a rerun without the fault must be bitwise clean."""
+    from pipe_tpu.core import microbatch as mb
+    from pipe_tpu.parallel import emulator
+    from pipe_tpu.resilience import HopHealth
+
+    def stage(p, x, ctx):
+        return jnp.tanh(x @ p)
+
+    key = jax.random.key(7)
+    params = [jax.random.normal(jax.random.fold_in(key, s), (8, 8))
+              for s in range(2)]
+    stages = [stage, stage]
+    xs = [mb.Batch(jax.random.normal(jax.random.fold_in(key, 10 + i),
+                                     (4, 8)), atomic=True)
+          for i in range(3)]
+
+    def run(chaos, hh=None):
+        out = emulator.run(stages, params, list(xs), chaos=chaos,
+                           hop_health=hh)
+        return [np.asarray(b.values[0]) for b in out]
+
+    clean = run(None)
+    plan = ChaosPlan([Fault("persistent_hop_drop", step=0, stage=0)])
+    hh = HopHealth(dead_after=2)
+    faulted = run(plan, hh)
+    all_dropped = all(not np.array_equal(a, b)
+                      for a, b in zip(faulted, clean))
+    streak = hh.streak(0)
+    dead = hh.dead_hops
+    retry = run(None)
+    restored = all(np.array_equal(a, b) for a, b in zip(retry, clean))
+    return {"recovered": bool(dead == [0] and streak >= 2
+                              and all_dropped and restored),
+            "steps_to_recover": 1, "hop_streak": int(streak),
+            "dead_hops": list(dead),
+            "every_microbatch_dropped": bool(all_dropped),
+            "retry_bitwise_clean": bool(restored)}
 
 
 def transport_trial(kind):
@@ -282,13 +373,20 @@ def main():
         log(f"== transport fault: {kind}")
         results[kind] = transport_trial(kind)
         log(f"   {results[kind]}")
+    if not args.quick:
+        log("== transport fault: persistent_hop_drop")
+        results["persistent_hop_drop"] = persistent_hop_trial()
+        log(f"   {results['persistent_hop_drop']}")
+        log("== stage fault: kill_stage (elastic re-plan 3->2)")
+        results["kill_stage"] = kill_stage_trial()
+        log(f"   {results['kill_stage']}")
     for kind in serve_kinds:
         log(f"== serve fault: {kind}")
         results[kind] = serve_trial(kind)
         log(f"   {results[kind]}")
 
     summary = {
-        "bench": "chaos", "rev": "r09",
+        "bench": "chaos", "rev": "r11",
         "quick": bool(args.quick),
         "platform": jax.default_backend(),
         "all_recovered": all(v.get("recovered") for v in results.values()),

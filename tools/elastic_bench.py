@@ -1,0 +1,234 @@
+"""Elastic bench: prove degraded-mode training survives losing a stage.
+
+The drill (the paper's fault model, scaled to cpu8): a 4-stage GPipe
+run with buddy replication armed takes a ``kill_stage`` fault mid-run —
+stage 1 goes permanently silent. The run must
+
+1. **detect** the loss from the per-stage gradient heartbeat (the
+   killed stage's zeroed output annihilates the backward signal for
+   every stage at or upstream of the cut; the largest persistently
+   silent index localizes it) with no host sync on the healthy path;
+2. **re-plan** over the 3 survivors — re-cut the layer balance, re-emit
+   the op table for the new width and push it through the same
+   verifier + phase compiler every table must pass
+   (:func:`~pipe_tpu.core.schedule.replan_stage_loss`);
+3. **restore** stage state from the buddy ring (each stage's shard was
+   replicated one ppermute hop away on a cadence, sha256-pinned) and
+   resume mid-epoch at the snapshot step.
+
+The acceptance pin is *bitwise*: after the recovered run finishes, its
+params AND Adam moments must equal — every leaf, every byte — a
+reference that trains the unkilled 4-stage model to the snapshot step,
+restacks it over 3 stages on the host, and finishes on a born-3-stage
+trainer over the same global batches. Recovery is a re-coordinatization
+plus verified replay, not an approximation.
+
+The second pin is absence: with ``TrainerConfig.elastic=None`` the
+train step's lowered HLO is byte-identical whether or not the elastic
+machinery was ever constructed in the process.
+
+Usage:
+  python tools/elastic_bench.py                  # -> ELASTIC_r11.json
+  python tools/elastic_bench.py --quick          # one JSON line
+Progress goes to stderr; the last stdout line is always the summary
+object, so ``bench.py`` embeds the --quick summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# 4-stage drill + 3-stage recovery need virtual CPU devices before jax
+# binds a backend (same pattern as chaos_bench).
+from pipe_tpu.utils.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(8)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from pipe_tpu.data import lm_text  # noqa: E402
+from pipe_tpu.models.transformer_lm import LMConfig  # noqa: E402
+from pipe_tpu.obs.telemetry import (MetricsRegistry,  # noqa: E402
+                                    set_registry)
+from pipe_tpu.resilience import (ChaosPlan, ElasticConfig,  # noqa: E402
+                                 Fault, ResilienceConfig)
+from pipe_tpu.resilience.elastic import (restack_state,  # noqa: E402
+                                         train_elastic)
+from pipe_tpu.train.loop import Trainer, TrainerConfig  # noqa: E402
+
+# 12 layers: divisible by 4 (healthy) and 3 (degraded) — uniform stage
+# bodies on both sides of the re-plan.
+CFG = LMConfig(vocab=67, d_model=16, nhead=2, d_ff=32, n_layers=12,
+               seq_len=32, dropout=0.0)
+STEPS = 10
+KILL_STEP = 6
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _source():
+    ids = np.random.RandomState(0).randint(0, CFG.vocab, size=20000)
+    return lm_text.batchify(ids, 8)
+
+
+def _tc(n_stages, **kw):
+    rc = ResilienceConfig(warmup_steps=100, rewind_after=3,
+                          snapshot_every=3, rewind_backoff_s=0.0)
+    ec = ElasticConfig(snapshot_every=3, dead_after=2)
+    base = dict(batch_size=8, bptt=16, chunks=4, n_stages=n_stages,
+                schedule="gpipe", checkpoint="never", lr=0.01,
+                resilience=rc, elastic=ec)
+    base.update(kw)
+    return TrainerConfig(**base)
+
+
+def _leaves_equal(a_tree, b_tree):
+    al = jax.tree_util.tree_leaves(a_tree)
+    bl = jax.tree_util.tree_leaves(b_tree)
+    if len(al) != len(bl):
+        return False, len(al)
+    bad = sum(0 if np.array_equal(np.asarray(a), np.asarray(b)) else 1
+              for a, b in zip(al, bl))
+    return bad == 0, len(al)
+
+
+def drill_trial():
+    """Kill stage 1 of 4 mid-run; assert detection, re-plan, buddy
+    restore, and the bitwise pin against the from-snapshot reference."""
+    reg = set_registry(MetricsRegistry())
+    try:
+        t0 = time.perf_counter()
+        plan = ChaosPlan([Fault("kill_stage", step=KILL_STEP, stage=1)])
+        tr = Trainer(CFG, _tc(4), chaos=plan)
+        tr2, state_e, info = train_elastic(tr, _source(), max_steps=STEPS,
+                                           log_fn=log)
+        rec = info["recoveries"][0] if info["recoveries"] else {}
+        drill_snaps = tr.registry.scalars().get(
+            "resilience.elastic.snapshots", 0)
+
+        # reference: unkilled to the snapshot step, restack on the
+        # host, finish born-3-stage over the same global batch indices
+        ref4 = Trainer(CFG, _tc(4), chaos=ChaosPlan([]))
+        s4, _ = ref4.train_epoch(_source(), 0, ref4.init_state(),
+                                 max_steps=rec.get("resume_step", 6),
+                                 log_every=0, log_fn=log)
+        host = jax.tree_util.tree_map(
+            lambda a: np.asarray(a) if isinstance(a, jax.Array) else a, s4)
+        host3 = restack_state(host, 4, 3)
+        surv = np.delete(np.asarray(ref4.mesh.devices),
+                         rec.get("stage", 1), axis=0).reshape(-1).tolist()
+        ref3 = Trainer(CFG, _tc(3), devices=surv, chaos=ChaosPlan([]))
+        tpl = ref3.init_state()
+        s3 = jax.tree_util.tree_map(
+            lambda h, t: (jax.device_put(np.asarray(h), t.sharding)
+                          if isinstance(t, jax.Array) else h), host3, tpl)
+        s3f, _ = ref3.train_epoch(_source(), 0, s3, max_steps=STEPS,
+                                  log_every=0, log_fn=log,
+                                  start_step=rec.get("resume_step", 6))
+
+        params_eq, n_p = _leaves_equal(state_e.params, s3f.params)
+        opt_eq, n_o = _leaves_equal(state_e.opt_state, s3f.opt_state)
+        finite = all(bool(jnp.isfinite(l).all())
+                     for l in jax.tree_util.tree_leaves(state_e.params)
+                     if jnp.issubdtype(l.dtype, jnp.inexact))
+        recovered = (info["replans"] == 1 and tr2.cfg.n_stages == 3
+                     and rec.get("stage") == 1 and finite
+                     and params_eq and opt_eq)
+        return {
+            "recovered": bool(recovered),
+            "killed_stage": 1, "kill_step": KILL_STEP,
+            "detected_step": rec.get("detected_step"),
+            "snapshot_step": rec.get("snapshot_step"),
+            "resume_step": rec.get("resume_step"),
+            "lost_steps": rec.get("lost_steps"),
+            "stages_after": int(tr2.cfg.n_stages),
+            "buddy_snapshots": int(drill_snaps),
+            "params_bitwise_vs_reference": bool(params_eq),
+            "opt_state_bitwise_vs_reference": bool(opt_eq),
+            "param_leaves": int(n_p), "opt_leaves": int(n_o),
+            "params_finite": bool(finite),
+            "recovery_s": round(float(rec.get("recovery_s", 0.0)), 3),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+    finally:
+        set_registry(reg)
+
+
+def hlo_trial():
+    """Elastic absent => the train step lowers byte-identical before and
+    after the elastic machinery exists in the process."""
+    reg = set_registry(MetricsRegistry())
+    try:
+        small = LMConfig(vocab=67, d_model=16, nhead=2, d_ff=32,
+                         n_layers=4, seq_len=32, dropout=0.0)
+        tc = TrainerConfig(batch_size=8, bptt=16, chunks=2, n_stages=2,
+                           checkpoint="never", lr=0.01)
+        tr = Trainer(small, tc)
+        state = tr.init_state()
+        data, target = next(tr._batches(_source(), 1))
+        x, w = tr._make_x(data, target)
+        args = (state, x, w, jax.random.key(0), jnp.float32(0.01))
+        base = tr._step_fn.lower(*args).as_text()
+
+        etr = Trainer(small, _tc(2, chunks=2), chaos=ChaosPlan([]))
+        es = etr.init_state()
+        aux = (jnp.float32(0.0), jnp.int32(0), jnp.int32(0),
+               jnp.zeros((2,), jnp.int32))
+        etr._step_fn.lower(es, aux, x, w, jax.random.key(0),
+                           jnp.float32(0.01), jnp.int32(-1),
+                           jnp.float32(0.0), jnp.int32(-1)).as_text()
+        etr.elastic_store().capture(es, 0)       # the full machinery ran
+
+        again = tr._step_fn.lower(*args).as_text()
+        return {"ok": bool(again == base), "hlo_bytes": len(base)}
+    finally:
+        set_registry(reg)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="same drill, one JSON line (no artifact)")
+    ap.add_argument("--out", default=None,
+                    help="also write the summary JSON here")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    log("== elastic drill: kill stage 1/4 at step "
+        f"{KILL_STEP}, re-plan to 3, bitwise pin")
+    drill = drill_trial()
+    log(f"   {drill}")
+    log("== HLO pin: elastic absent -> byte-identical step")
+    hlo = hlo_trial()
+    log(f"   {hlo}")
+
+    summary = {
+        "bench": "elastic", "rev": "r11",
+        "quick": bool(args.quick),
+        "platform": jax.default_backend(),
+        "all_ok": bool(drill["recovered"] and hlo["ok"]),
+        "drill": drill,
+        "hlo_unchanged_without_elastic": hlo,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+        log(f"wrote {args.out}")
+    print(json.dumps(summary, indent=None if args.quick else 2))
+    return 0 if summary["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
