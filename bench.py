@@ -549,6 +549,38 @@ def main():
     except Exception as e:
         print(f"elastic probe failed: {e}", file=sys.stderr)
 
+    # Planner probe: calibrate -> search -> measure on the cpu8 probe
+    # (quick mode of tools/plan_bench.py). plan_ok asserts the chosen
+    # plan is no slower than the hand-tuned 1f1b m=8 baseline within
+    # noise, and that every emitted plan's op table re-proved itself
+    # (PLAN_r{N}.json is the full committed record).
+    plan_summary = None
+    try:
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "plan_bench.py"), "--quick"],
+            capture_output=True, text=True, timeout=900, env=env)
+        if out.returncode == 0:
+            full = json.loads(out.stdout.strip().splitlines()[-1])
+            plan_summary = {
+                "plan_ok": full["plan_ok"],
+                "all_plans_verified": full["all_plans_verified"],
+                "top": {k: full["plan"][k] for k in
+                        ("schedule", "m", "v", "split_stage")},
+                "top_rel_err": full["top_measured"][0]["rel_err"],
+                "top_vs_baseline_per_row":
+                    full["top_vs_baseline_per_row"],
+                "calibration_rel_residual":
+                    full["calibration"]["rel_residual"],
+            }
+        else:
+            print(f"plan probe rc={out.returncode}: "
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"plan probe failed: {e}", file=sys.stderr)
+
     # Chaos smoke lane: the pytest-marked elastic drill (kill stage 1/4,
     # resumed loss trajectory vs the unkilled run) as the repo's own
     # test suite runs it — the bench proves the committed test passes,
@@ -660,6 +692,7 @@ def main():
         "chaos": chaos_summary,
         "fleet": fleet_summary,
         "elastic": elastic_summary,
+        "plan": plan_summary,
         "chaos_smoke": chaos_smoke,
         "trend_vs_prior": trend_vs_prior,
         "final_loss": round(loss, 4),

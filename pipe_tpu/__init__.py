@@ -20,6 +20,7 @@ from .ops.layers import (Decoder, Dropout, Embedding, Lambda, LayerNorm,
                          Linear, Module, MultiHeadAttention,
                          PositionalEncoding, Sequential,
                          TransformerEncoderLayer)
+from .core.planner import CostProfile, Plan, auto_plan
 from .inference import GenerationConfig, Generator, PipelinedGenerator
 from .pipe import Pipe
 
@@ -34,4 +35,5 @@ __all__ = [
     "Dropout", "MultiHeadAttention", "TransformerEncoderLayer",
     "PositionalEncoding", "Decoder",
     "GenerationConfig", "Generator", "PipelinedGenerator",
+    "CostProfile", "Plan", "auto_plan",
 ]

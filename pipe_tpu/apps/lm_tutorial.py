@@ -39,6 +39,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "which diverges at full scale; try 1e-4")
     p.add_argument("--interleave", type=int, default=2,
                    help="virtual stages per device (interleaved schedule)")
+    p.add_argument("--plan", default=None,
+                   help="auto-planner front door (docs/planning.md): "
+                        "'auto' searches schedule x chunks x interleave "
+                        "under the planner's cost model and overrides "
+                        "--schedule/--chunks; a path loads a saved "
+                        "PLAN json (tools/plan_bench.py)")
     p.add_argument("--tiny", action="store_true",
                    help="tiny model config (CI / CPU-sized)")
     p.add_argument("--profile", default=None,
@@ -80,7 +86,7 @@ def main(argv=None) -> int:
             n_layers=2 * args.stages)
     cfg = TrainerConfig(chunks=args.chunks, checkpoint=args.checkpoint,
                         n_stages=args.stages, schedule=args.schedule,
-                        interleave=args.interleave)
+                        interleave=args.interleave, plan=args.plan)
     if args.tiny:
         cfg = dataclasses.replace(cfg, batch_size=8, eval_batch_size=8,
                                   bptt=model_cfg.seq_len, lr=1e-3)
@@ -94,6 +100,17 @@ def main(argv=None) -> int:
     val_data = lm_text.batchify(val_ids, cfg.eval_batch_size)
 
     trainer = Trainer(model_cfg, cfg)
+    if args.plan:
+        rc = trainer.cfg
+        line = (f"plan resolved: schedule={rc.schedule} chunks={rc.chunks} "
+                f"interleave={rc.interleave} checkpoint={rc.checkpoint}")
+        if rc.plan.profile_source != "uniform":
+            # uniform (analytic) profiles rank in abstract units — only a
+            # measured profile's prediction is honest wall time
+            line += (f" (predicted {rc.plan.predicted_step_s * 1e3:.2f} "
+                     f"ms/step, "
+                     f"{rc.plan.predicted_peak_bytes / 1e6:.1f} MB/device)")
+        print(line)
     if args.autosave:
         trainer.install_autosave(args.autosave)
     state = trainer.init_state()

@@ -19,6 +19,7 @@ reference lineage's greedy heuristic.
 
 from __future__ import annotations
 
+import statistics
 import time
 from typing import Any, List, Optional, Sequence
 
@@ -29,7 +30,8 @@ from ..ops.layers import Sequential
 from .partition import BalanceError, StageCtx
 
 __all__ = ["profile_times", "profile_sizes", "balance_by_time",
-           "balance_by_size", "balance_cost", "rebalance_stage_loss"]
+           "balance_by_size", "balance_cost", "stage_costs",
+           "rebalance_stage_loss"]
 
 
 def _layer_specs(module: Sequential, params: Sequence[Any], sample) -> List:
@@ -45,11 +47,19 @@ def _layer_specs(module: Sequential, params: Sequence[Any], sample) -> List:
 
 def profile_times(module: Sequential, params: Sequence[Any], sample,
                   *, backward: bool = True, repeat: int = 3,
+                  warmup: int = 1,
                   key: Optional[jax.Array] = None) -> List[float]:
     """Measured per-layer step time in seconds (jitted, host-synced).
 
     torchgpipe's balance_by_time analogue: each layer is jitted and timed in
     isolation on real inputs of the shapes it will see in the pipeline.
+
+    Noise robustness (the planner ranks candidate cuts on these numbers):
+    after the compile call, ``warmup`` timed runs are DISCARDED — the first
+    post-compile dispatches pay allocator warm-up and host-cache effects —
+    and the reported figure is the MEDIAN of the remaining ``repeat``
+    samples. A median tolerates one-sided outliers (GC pause, scheduler
+    preemption) that a min systematically hides and a mean absorbs.
     """
     key = key if key is not None else jax.random.key(0)
     specs = _layer_specs(module, params, sample)
@@ -72,15 +82,17 @@ def profile_times(module: Sequential, params: Sequence[Any], sample,
             fn = jax.jit(f)
             args = (p, x)
 
-        out = fn(*args)                      # compile + warm
+        out = fn(*args)                      # compile
         jax.block_until_ready(out)
-        best = float("inf")
-        for _ in range(repeat):
+        samples: List[float] = []
+        for r in range(warmup + repeat):
             t0 = time.perf_counter()
             out = fn(*args)
             jax.block_until_ready(out)
-            best = min(best, time.perf_counter() - t0)
-        times.append(best)
+            dt = time.perf_counter() - t0
+            if r >= warmup:
+                samples.append(dt)
+        times.append(statistics.median(samples))
     return times
 
 
@@ -185,10 +197,27 @@ def rebalance_stage_loss(balance: Sequence[int],
     return _bottleneck_split(costs, n - 1)
 
 
-def balance_cost(balance: Sequence[int], costs: Sequence[float]) -> float:
-    """Bottleneck (max stage) cost of a balance — lower is better."""
+def stage_costs(balance: Sequence[int], costs: Sequence[float]
+                ) -> List[float]:
+    """Per-stage cost vector of a balance: ``out[j]`` sums the layer costs
+    assigned to stage ``j``. The planner feeds this straight into the
+    heterogeneous wall model (each stage's op is priced by ITS cost, not
+    the bottleneck's); :func:`balance_cost` is its max."""
+    if sum(int(w) for w in balance) != len(costs):
+        raise BalanceError(
+            f"balance sums to {sum(int(w) for w in balance)} layers but "
+            f"costs cover {len(costs)}")
     out, off = [], 0
     for w in balance:
-        out.append(sum(costs[off:off + w]))
+        out.append(float(sum(costs[off:off + w])))
         off += w
-    return max(out)
+    return out
+
+
+def balance_cost(balance: Sequence[int], costs: Sequence[float],
+                 *, per_stage: bool = False):
+    """Bottleneck (max stage) cost of a balance — lower is better.
+    ``per_stage=True`` returns the full per-stage vector instead of the
+    scalar (equivalently :func:`stage_costs`)."""
+    vec = stage_costs(balance, costs)
+    return vec if per_stage else max(vec)

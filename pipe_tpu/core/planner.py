@@ -1,0 +1,580 @@
+"""Profile-driven auto-planner: balance, schedule, and microbatching from
+measured costs.
+
+The reference ``Pipe`` makes the user hand-pick ``balance``, ``chunks``
+and checkpointing; pipe_tpu inherited that. This module closes the loop
+with the machinery five prior PRs built:
+
+1. **Calibrate** (:func:`profile_model` / :func:`profile_from_calibration`):
+   a handful of real measured steps fold per-layer forward/backward costs
+   (``core/balance.py:profile_times`` — median-of-k, warmup-discarded) and
+   per-layer activation/parameter sizes into a :class:`CostProfile`. A
+   serialized step-time calibration (``obs/zb_model.py:calibrate``) supplies
+   the split-overhead ``sigma`` and the per-cycle machinery overhead ``o``
+   — and its fit residual: a profile built on a calibration whose relative
+   residual exceeds :data:`MAX_REL_RESIDUAL` is REFUSED with a loud
+   warning (:class:`CalibrationError`), because every ranking downstream
+   would inherit a falsified cost model.
+
+2. **Search** (:func:`search`): enumerate (stage cut points × schedule
+   family {gpipe, 1f1b, interleaved, zb-h1/h2, bring-your-own
+   ``Schedule``} × micro-batch count m × interleave v × split_stage).
+   Every candidate's op table must PROVE itself — ``verify_op_tables`` /
+   ``verify_interleaved_op_tables`` plus a ``compile_phases`` verdict —
+   before it is scored: predicted step time from the heterogeneous
+   generalization of ``obs/zb_model.py:schedule_wall``
+   (:func:`predict_wall`, per-stage cost columns instead of one scalar
+   ``f``), predicted peak memory from the executor-shared
+   ``core/memplan.py:estimate_memory`` formula, pruned against a
+   user-supplied cap.
+
+3. **Plan** (:class:`Plan`): a JSON-serializable artifact — chosen config,
+   predicted step time, predicted peak memory, ranked runners-up — that
+   ``Pipe(plan=...)`` and ``Trainer(plan="auto")`` consume directly, and
+   ``tools/plan_bench.py`` validates against measured step times
+   (``PLAN_r12.json``).
+
+Grounding: "Efficient Pipeline Planning for Expedited Distributed DNN
+Training" and "A Flexible Programmable Pipeline Parallelism Framework"
+(PAPERS.md) — profile a few calibration steps, then search the plan space
+under a cost model instead of asking the user.
+
+Determinism: the search is a pure function of the profile and its keyword
+knobs — no RNG, no clock reads — and ties break on the lexicographic
+(schedule name, m, v, split) key, so a fixed profile always yields the
+same ranked plan list (pinned in ``tests/test_planner.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import warnings
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..obs.zb_model import OpCosts, fitted_op_costs
+from .balance import _bottleneck_split, _layer_specs, stage_costs
+from .memplan import MemoryPlanInputs, estimate_memory
+from .partition import BalanceError, split_balance
+from .schedule import (BWD, FWD, WGRAD, Schedule, compile_phases,
+                       get_schedule, verify_interleaved_op_tables,
+                       verify_op_tables)
+
+__all__ = ["CostProfile", "Plan", "CalibrationError", "MAX_REL_RESIDUAL",
+           "profile_model", "profile_from_calibration", "uniform_profile",
+           "predict_wall", "search", "auto_plan"]
+
+# Refuse to rank on a calibration whose relative fit residual exceeds
+# this: a quarter of the signal unexplained means the linear cost model
+# (op counts x per-op costs + cycles x overhead) is the wrong model for
+# the machine, and ranking schedules on it would be astrology. The
+# committed cpu8 calibrations sit well below (ZB_CROSSOVER_r05: <= 0.06).
+MAX_REL_RESIDUAL = 0.25
+
+# Committed-calibration defaults for profiles built without a fresh fit
+# (ZB_CROSSOVER_r05.json, structural split): sigma <= 1.41 across widths.
+# The legacy stored-vjp split measured 1.90-2.33 (r04) — ~1.45x worse —
+# which is how split_stage=False zb candidates are priced when the
+# profile carries no legacy sigma of its own.
+DEFAULT_SIGMA = 1.41
+LEGACY_SIGMA_RATIO = 1.45
+
+
+class CalibrationError(ValueError):
+    """The cost-model calibration is not trustworthy enough to rank on."""
+
+
+# ---------------------------------------------------------------------------
+# CostProfile: what calibration measures, what the search consumes
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CostProfile:
+    """Measured per-layer costs + sizes, and the machine model constants.
+
+    ``layer_fwd_s``/``layer_bwd_s`` are seconds per layer for one
+    micro-batch of ``rows`` rows (bwd is the BACKWARD-only part; a fused
+    BWD op costs ``bwd``, a split B or W op costs ``sigma * bwd / 2``).
+    ``layer_act_bytes`` is each layer's OUTPUT activation size at ``rows``
+    rows — boundary traffic and stash slots are priced off it.
+    Costs and activation bytes scale linearly with rows-per-micro-batch
+    when the search trades m against micro-batch size.
+    """
+
+    layer_fwd_s: Tuple[float, ...]
+    layer_bwd_s: Tuple[float, ...]
+    layer_param_bytes: Tuple[int, ...]
+    layer_act_bytes: Tuple[int, ...]
+    rows: int = 1
+    sigma: float = DEFAULT_SIGMA        # split-backward overhead factor
+    sigma_fused_split: Optional[float] = None   # legacy stored-vjp sigma
+    o: float = 0.0                      # per-cycle machinery overhead, s
+    mode: str = "serialized"            # serialized (cpu8) | parallel
+    rel_residual: float = 0.0           # of the calibration behind sigma/o
+    source: str = "unspecified"
+
+    def __post_init__(self):
+        n = len(self.layer_fwd_s)
+        for f_ in ("layer_bwd_s", "layer_param_bytes", "layer_act_bytes"):
+            if len(getattr(self, f_)) != n:
+                raise ValueError(f"{f_} covers {len(getattr(self, f_))} "
+                                 f"layers, layer_fwd_s covers {n}")
+        if self.mode not in ("serialized", "parallel"):
+            raise ValueError(f"mode must be serialized|parallel, "
+                             f"got {self.mode!r}")
+        if self.rel_residual > MAX_REL_RESIDUAL:
+            warnings.warn(
+                f"REFUSING to plan on this calibration: relative fit "
+                f"residual {self.rel_residual:.3f} exceeds "
+                f"{MAX_REL_RESIDUAL} — the linear cost model does not "
+                f"describe this machine, so any schedule ranking built on "
+                f"it would be noise. Re-measure (more iters, quieter "
+                f"host), or pass analytic costs explicitly.", stacklevel=3)
+            raise CalibrationError(
+                f"calibration rel_residual {self.rel_residual:.3f} > "
+                f"{MAX_REL_RESIDUAL}")
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layer_fwd_s)
+
+    @property
+    def sigma_legacy(self) -> float:
+        return (self.sigma_fused_split if self.sigma_fused_split is not None
+                else self.sigma * LEGACY_SIGMA_RATIO)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "CostProfile":
+        d = json.loads(text)
+        for k in ("layer_fwd_s", "layer_bwd_s", "layer_param_bytes",
+                  "layer_act_bytes"):
+            d[k] = tuple(d[k])
+        return cls(**d)
+
+
+def profile_model(module, params, sample, *, repeat: int = 5,
+                  warmup: int = 1, key=None, sigma: float = DEFAULT_SIGMA,
+                  o: float = 0.0, mode: str = "serialized",
+                  rel_residual: float = 0.0) -> CostProfile:
+    """The calibration pass over a real model: run each layer for a
+    handful of real (jitted, host-synced) steps and fold the measured
+    forward/backward costs plus parameter/activation sizes into a
+    :class:`CostProfile`. ``sample`` must be ONE micro-batch of the rows
+    the pipeline will see (the search scales costs linearly in rows when
+    it trades m against micro-batch size).
+
+    ``sigma``/``o``/``rel_residual`` come from a step-time calibration
+    when one exists (:func:`obs.zb_model.calibrate` →
+    :func:`profile_from_calibration` merges them); the defaults are the
+    committed cpu8 fit.
+    """
+    import jax
+    import jax.numpy as jnp
+    from .balance import profile_times
+
+    fwd = profile_times(module, params, sample, backward=False,
+                        repeat=repeat, warmup=warmup, key=key)
+    tot = profile_times(module, params, sample, backward=True,
+                        repeat=repeat, warmup=warmup, key=key)
+    # profile_times(backward=True) measures fwd+bwd together; the
+    # backward-only part clamps at one forward below (timer noise can
+    # push tot under fwd for tiny layers; a backward cheaper than the
+    # forward it differentiates is not physical for matmul chains).
+    bwd = [max(t - f, f) for f, t in zip(fwd, tot)]
+    specs = _layer_specs(module, params, sample)
+    p_bytes, a_bytes = [], []
+    for layer, p, spec in zip(module, params, specs):
+        p_bytes.append(int(sum(
+            a.size * a.dtype.itemsize for a in jax.tree_util.tree_leaves(p)
+            if hasattr(a, "dtype"))))
+        out = layer.out_spec(p, spec)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        a_bytes.append(int(sum(
+            int(np.prod(o_.shape)) * o_.dtype.itemsize for o_ in outs)))
+    rows = int(jnp.shape(sample)[0]) if jnp.ndim(sample) else 1
+    return CostProfile(
+        layer_fwd_s=tuple(fwd), layer_bwd_s=tuple(bwd),
+        layer_param_bytes=tuple(p_bytes), layer_act_bytes=tuple(a_bytes),
+        rows=rows, sigma=sigma, o=o, mode=mode, rel_residual=rel_residual,
+        source="profile_model")
+
+
+def profile_from_calibration(calib: dict, *, n_layers: int, rows: int,
+                             layer_param_bytes: Union[int, Sequence[int]] = 0,
+                             layer_act_bytes: Union[int, Sequence[int]] = 0,
+                             width: Optional[int] = None,
+                             mode: str = "serialized") -> CostProfile:
+    """A :class:`CostProfile` from a measured step-time calibration
+    (:func:`obs.zb_model.calibrate` over real 1f1b/zb steps). The fit's
+    per-STAGE forward cost ``f`` (at ``calib['n']`` stages) spreads
+    uniformly over ``n_layers`` layers — exact for homogeneous stacks
+    (the transformer zoo), which is the only thing a step-level fit can
+    resolve anyway. Refuses (loudly) when the fit's relative residual
+    exceeds :data:`MAX_REL_RESIDUAL` — see :class:`CalibrationError`."""
+    costs: OpCosts = fitted_op_costs(calib, width)
+    rr = float(calib.get("rel_residual",
+                         max(calib["rel_residual_per_width"])))
+    stages_at_fit = int(calib["n"])
+    layer_f = costs.f * stages_at_fit / n_layers
+    if isinstance(layer_param_bytes, int):
+        layer_param_bytes = (layer_param_bytes,) * n_layers
+    if isinstance(layer_act_bytes, int):
+        layer_act_bytes = (layer_act_bytes,) * n_layers
+    return CostProfile(
+        layer_fwd_s=(layer_f,) * n_layers,
+        layer_bwd_s=(2.0 * layer_f,) * n_layers,
+        layer_param_bytes=tuple(int(b) for b in layer_param_bytes),
+        layer_act_bytes=tuple(int(b) for b in layer_act_bytes),
+        rows=rows, sigma=costs.sigma, o=max(costs.o, 0.0), mode=mode,
+        rel_residual=rr, source="zb_model.calibrate")
+
+
+def uniform_profile(n_layers: int, *, rows: int = 1, f: float = 1.0,
+                    sigma: float = DEFAULT_SIGMA, o_over_f: float = 0.1,
+                    layer_param_bytes: int = 0, layer_act_bytes: int = 0,
+                    mode: str = "parallel") -> CostProfile:
+    """Analytic fallback profile: uniform unit-cost layers, committed
+    sigma, overhead as a fraction of ``f``. This is what
+    ``Trainer(plan='auto')`` ranks on when no measured profile is given —
+    correct RELATIVE costs for homogeneous stage bodies (PipelinedLM),
+    which is all the argmin needs."""
+    return CostProfile(
+        layer_fwd_s=(f,) * n_layers, layer_bwd_s=(2.0 * f,) * n_layers,
+        layer_param_bytes=(layer_param_bytes,) * n_layers,
+        layer_act_bytes=(layer_act_bytes,) * n_layers,
+        rows=rows, sigma=sigma, o=o_over_f * f, mode=mode,
+        source="uniform")
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous wall model: per-stage cost columns under an op table
+# ---------------------------------------------------------------------------
+
+
+def predict_wall(op: np.ndarray, grp: Optional[np.ndarray],
+                 stage_fwd_s: Sequence[float],
+                 stage_bwd_s: Sequence[float], *, d: int, sigma: float,
+                 o: float, mode: str, recompute: bool = False) -> float:
+    """Predicted wall seconds of one step — the heterogeneous
+    generalization of :func:`obs.zb_model.schedule_wall`: instead of one
+    scalar ``f`` for every stage, each of the ``S = v*d`` virtual stages
+    brings its own forward/backward cost (the per-stage vectors
+    :func:`core.balance.stage_costs` produces for a candidate cut).
+    Virtual stage ``s = grp[t, p] * d + p`` prices the op at ``(t, p)``:
+
+    * ``FWD`` = ``f_s``; fused ``BWD`` = ``b_s`` (+ ``f_s`` recompute tax
+      under non-'never' checkpointing);
+    * split tables: B and W each ``sigma * b_s / 2`` — the same pricing
+      :class:`obs.zb_model.OpCosts` uses, so with uniform cost columns
+      and ``b = 2f`` this function equals ``schedule_wall`` exactly
+      (pinned in ``tests/test_planner.py``).
+    """
+    op = np.asarray(op)
+    T, cols = op.shape
+    if cols != d:
+        raise ValueError(f"op table has {cols} device columns, d={d}")
+    S = len(stage_fwd_s)
+    if len(stage_bwd_s) != S or S % d:
+        raise ValueError(f"stage cost vectors must cover v*d stages "
+                         f"(got {S} and {len(stage_bwd_s)} for d={d})")
+    grp = (np.zeros_like(op) if grp is None else np.asarray(grp))
+    s_at = grp * d + np.arange(d)[None, :]
+    f_at = np.asarray(stage_fwd_s, np.float64)[s_at]
+    b_at = np.asarray(stage_bwd_s, np.float64)[s_at]
+    split_table = bool((op == WGRAD).any())
+    ct = np.zeros(op.shape, np.float64)
+    ct[op == FWD] = f_at[op == FWD]
+    if split_table:
+        ct[op == BWD] = (sigma / 2.0) * b_at[op == BWD]
+        ct[op == WGRAD] = (sigma / 2.0) * b_at[op == WGRAD]
+    else:
+        bb = b_at + (f_at if recompute else 0.0)
+        ct[op == BWD] = bb[op == BWD]
+    if mode == "parallel":
+        return float(ct.max(axis=1).sum() + T * o)
+    if mode == "serialized":
+        return float(ct.sum() + T * o)
+    raise ValueError(f"mode must be parallel|serialized, got {mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# The Plan artifact
+# ---------------------------------------------------------------------------
+
+PLAN_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One verified, scored pipeline configuration — the planner's unit of
+    output and the front doors' unit of input (``Pipe(plan=...)``,
+    ``Trainer(plan=...)``). JSON-serializable; ``runners_up`` carries the
+    ranked alternatives' summaries so a human (or ``tools/plan_bench.py``)
+    can see what the winner beat and by how much."""
+
+    schedule: str
+    m: int
+    v: int
+    balance: Tuple[int, ...]
+    split_stage: bool
+    checkpoint: str
+    n_devices: int
+    mode: str
+    predicted_step_s: float
+    predicted_s_per_row: float
+    predicted_peak_bytes: int
+    phase_ok: bool
+    profile_source: str = "unspecified"
+    runners_up: Tuple[dict, ...] = ()
+    # Bring-your-own-schedule plans carry the live object (not JSON-round-
+    # trippable; reloading such a plan requires re-supplying the object).
+    schedule_ref: Optional[Schedule] = dataclasses.field(
+        default=None, compare=False, repr=False)
+
+    def summary(self) -> dict:
+        return {"schedule": self.schedule, "m": self.m, "v": self.v,
+                "balance": list(self.balance),
+                "split_stage": self.split_stage,
+                "checkpoint": self.checkpoint,
+                "predicted_step_s": self.predicted_step_s,
+                "predicted_s_per_row": self.predicted_s_per_row,
+                "predicted_peak_bytes": self.predicted_peak_bytes,
+                "phase_ok": self.phase_ok}
+
+    def schedule_obj(self) -> Schedule:
+        """The live Schedule this plan prescribes."""
+        if self.schedule_ref is not None:
+            return self.schedule_ref
+        if self.schedule == "interleaved-1f1b":
+            return get_schedule("interleaved-1f1b", interleave=self.v)
+        return get_schedule(self.schedule)
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d.pop("schedule_ref")
+        d["balance"] = list(self.balance)
+        d["runners_up"] = list(self.runners_up)
+        d["version"] = PLAN_VERSION
+        return json.dumps(d, indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Plan":
+        d = json.loads(text)
+        ver = d.pop("version", PLAN_VERSION)
+        if ver != PLAN_VERSION:
+            raise ValueError(f"plan version {ver} != {PLAN_VERSION}")
+        d["balance"] = tuple(d["balance"])
+        d["runners_up"] = tuple(d.get("runners_up", ()))
+        return cls(**d)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fh:
+            fh.write(self.to_json() + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Plan":
+        with open(path) as fh:
+            return cls.from_json(fh.read())
+
+
+# ---------------------------------------------------------------------------
+# The search
+# ---------------------------------------------------------------------------
+
+
+def _balance_candidates(profile: CostProfile, n_stages: int,
+                        uniform_only: bool) -> List[Tuple[int, ...]]:
+    """Candidate stage cut points for one stage count: the uniform
+    ceil-split, the bottleneck-optimal cut by measured time, and the
+    bottleneck-optimal cut by bytes (deduped, deterministic order)."""
+    L = profile.n_layers
+    if n_stages > L:
+        return []
+    out: List[Tuple[int, ...]] = []
+    if uniform_only:
+        if L % n_stages:
+            return []
+        return [tuple(split_balance(L, n_stages))]
+    for costs in (None,
+                  [f + b for f, b in zip(profile.layer_fwd_s,
+                                         profile.layer_bwd_s)],
+                  [p + a for p, a in zip(profile.layer_param_bytes,
+                                         profile.layer_act_bytes)]):
+        try:
+            cut = (tuple(split_balance(L, n_stages)) if costs is None
+                   else tuple(_bottleneck_split(costs, n_stages)))
+        except BalanceError:
+            continue
+        if sum(costs or [0]) == 0 and costs is not None:
+            continue    # size profile absent: the cut is meaningless
+        if cut not in out:
+            out.append(cut)
+    return out
+
+
+def _schedule_candidates(spec, v_options: Sequence[int]):
+    """Expand one schedule spec into (name, v, Schedule, is_custom)."""
+    if isinstance(spec, Schedule):
+        return [(spec.name, spec.v, spec, True)]
+    name = {"interleaved": "interleaved-1f1b"}.get(spec, spec)
+    if name == "interleaved-1f1b":
+        return [(name, v, get_schedule(name, interleave=v), False)
+                for v in v_options if v > 1]
+    return [(name, 1, get_schedule(name), False)]
+
+
+def _device_param_bytes(balance: Sequence[int], profile: CostProfile,
+                        d: int) -> int:
+    """Max per-device parameter bytes: virtual stage s lives on device
+    s % d (device-major interleaving)."""
+    per_stage = stage_costs(balance, profile.layer_param_bytes)
+    dev = [0.0] * d
+    for s, b in enumerate(per_stage):
+        dev[s % d] += b
+    return int(max(dev))
+
+
+def search(profile: CostProfile, *, n_devices: int,
+           m_candidates: Sequence[int],
+           batch_rows: Optional[int] = None,
+           schedules: Sequence[Union[str, Schedule]] = (
+               "gpipe", "1f1b", "interleaved-1f1b", "zb-h1", "zb-h2"),
+           interleave_candidates: Sequence[int] = (2,),
+           checkpoint: str = "never",
+           memory_cap_bytes: Optional[int] = None,
+           uniform_only: bool = False,
+           phase_gate: bool = True,
+           max_plans: int = 8) -> List[Plan]:
+    """Rank the plan space under the profile's cost model.
+
+    For each (schedule family × interleave v × m × stage cut ×
+    split_stage) candidate: emit the op table, PROVE it
+    (``verify_op_tables`` / the interleaved verifier; construction or
+    verification failure prunes silently — an invalid table is not a
+    plan), phase-compile it (``compile_phases``; with ``phase_gate`` a
+    rejected table is pruned too, so every emitted plan lowers to the
+    switch-free executor when ``d > 1``), price it
+    (:func:`predict_wall` + :func:`core.memplan.estimate_memory`), and
+    drop it if it busts ``memory_cap_bytes``.
+
+    ``batch_rows`` fixes the global batch: rows-per-micro-batch becomes
+    ``batch_rows / m`` (non-dividing m are skipped) and costs scale
+    linearly from the profile's measured rows — this is the m-vs-
+    micro-batch-size tradeoff. Without it, each m keeps the profile's
+    rows per micro-batch and ranking is per-ROW throughput either way
+    (``predicted_s_per_row``), so small-m and large-m candidates stay
+    comparable.
+
+    Returns plans best-first; ``plans[0].runners_up`` summarizes the
+    rest. Deterministic for a fixed profile (no RNG, stable tiebreak).
+    """
+    if not m_candidates:
+        raise ValueError("m_candidates must be non-empty")
+    d = int(n_devices)
+    plans: List[Plan] = []
+    for spec in schedules:
+        for name, v, sched, is_custom in _schedule_candidates(
+                spec, interleave_candidates):
+            S = v * d
+            split_opts = ([True, False] if sched.splits_backward
+                          and checkpoint == "never" else [False])
+            for m in sorted(set(int(m) for m in m_candidates)):
+                if batch_rows is not None:
+                    if batch_rows % m:
+                        continue
+                    rows_mb = batch_rows // m
+                else:
+                    rows_mb = profile.rows
+                scale = rows_mb / profile.rows
+                for balance in _balance_candidates(profile, S,
+                                                   uniform_only):
+                    try:
+                        tables = sched.op_tables(m, d if v > 1 else S)
+                    except Exception:
+                        continue        # constructor refused this (m, n)
+                    op, mbi = tables[0], tables[1]
+                    grp = tables[2] if len(tables) > 2 else None
+                    try:
+                        if v > 1:
+                            verify_interleaved_op_tables(
+                                op, mbi, grp, m, d, v)
+                        else:
+                            verify_op_tables(
+                                op, mbi, m, S,
+                                stash_slots=sched.stash_slots(m, S),
+                                wstash_slots=(
+                                    sched.wstash_slots(m, S)
+                                    if sched.splits_backward else None))
+                    except AssertionError:
+                        continue        # table failed its proof: not a plan
+                    verdict = compile_phases(op, mbi, grp, m=m, d=d, v=v)
+                    if phase_gate and d > 1 and not verdict.accepted:
+                        continue
+                    f_vec = [scale * c for c in stage_costs(
+                        balance, profile.layer_fwd_s)]
+                    b_vec = [scale * c for c in stage_costs(
+                        balance, profile.layer_bwd_s)]
+                    for split in split_opts:
+                        sigma = (profile.sigma if split
+                                 else profile.sigma_legacy)
+                        wall = predict_wall(
+                            op, grp, f_vec, b_vec, d=d, sigma=sigma,
+                            o=profile.o, mode=profile.mode,
+                            recompute=checkpoint != "never")
+                        act = int(np.ceil(scale * max(
+                            profile.layer_act_bytes, default=0)))
+                        mem = estimate_memory(
+                            MemoryPlanInputs(
+                                v=v,
+                                stash_slots=sched.stash_slots(
+                                    m, d if v > 1 else S),
+                                wstash_slots=(
+                                    sched.wstash_slots(m, S)
+                                    if sched.splits_backward else 0),
+                                checkpoint=checkpoint,
+                                split_stage=split),
+                            act_bytes=act,
+                            param_bytes=_device_param_bytes(
+                                balance, profile, d))
+                        if memory_cap_bytes is not None \
+                                and mem > memory_cap_bytes:
+                            continue
+                        plans.append(Plan(
+                            schedule=name, m=m, v=v, balance=balance,
+                            split_stage=split, checkpoint=checkpoint,
+                            n_devices=d, mode=profile.mode,
+                            predicted_step_s=wall,
+                            predicted_s_per_row=wall / (m * rows_mb),
+                            predicted_peak_bytes=mem,
+                            phase_ok=bool(verdict.accepted),
+                            profile_source=profile.source,
+                            schedule_ref=sched if is_custom else None))
+    plans.sort(key=lambda p: (p.predicted_s_per_row, p.schedule, p.m,
+                              p.v, not p.split_stage, p.balance))
+    plans = plans[:max_plans]
+    if plans:
+        tail = tuple(p.summary() for p in plans[1:])
+        plans[0] = dataclasses.replace(plans[0], runners_up=tail)
+    return plans
+
+
+def auto_plan(module, params, sample, *, n_devices: int,
+              m_candidates: Sequence[int], **search_kw) -> Plan:
+    """Calibrate → search → best plan, in one call: profile the model's
+    layers with real measured steps (:func:`profile_model`) and hand the
+    ranked winner back. Raises :class:`BalanceError`-family errors only
+    when NO candidate survives the proofs and the memory cap."""
+    profile = profile_model(module, params, sample)
+    plans = search(profile, n_devices=n_devices,
+                   m_candidates=m_candidates, **search_kw)
+    if not plans:
+        raise BalanceError(
+            "the planner found no feasible plan: every candidate failed "
+            "table verification, phase compilation, or the memory cap")
+    return plans[0]

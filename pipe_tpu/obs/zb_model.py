@@ -57,8 +57,8 @@ import numpy as np
 
 from ..core.schedule import BWD, FWD, IDLE, WGRAD, get_schedule
 
-__all__ = ["OpCosts", "schedule_wall", "calibrate", "predict", "crossover",
-           "analytic_bubbles"]
+__all__ = ["OpCosts", "schedule_wall", "calibrate", "fitted_op_costs",
+           "predict", "crossover", "analytic_bubbles"]
 
 
 def analytic_bubbles(m: int, n: int,
@@ -195,7 +195,34 @@ def calibrate(measurements: Sequence[dict], n: int) -> dict:
         "sigma": sigma,
         "o_serialized_per_width": o_w,
         "rel_residual_per_width": resids,
+        # The fitted OpCosts per width, JSON-shaped, RIGHT NEXT TO the
+        # residual that says whether to believe them: consumers ranking
+        # schedules on this fit (core/planner.py) must check
+        # `rel_residual` first — a large value falsifies the linear cost
+        # model itself, and every prediction built on it.
+        "op_costs_per_width": [
+            {"f": f, "sigma": sg, "o": o}
+            for f, sg, o in zip(f_w, sigmas, o_w)],
+        "rel_residual": (max(resids) if resids else float("nan")),
     }
+
+
+def fitted_op_costs(calib: dict, width: Optional[int] = None) -> OpCosts:
+    """The :class:`OpCosts` a :func:`calibrate` fit implies for ``width``
+    (default: the largest width with a physical ``f > 0`` — wider layers
+    dominate real models and give the least-noisy fit). Raises
+    ``ValueError`` when no width produced a physical fit."""
+    if width is not None:
+        k = calib["widths"].index(width)
+        row = calib["op_costs_per_width"][k]
+        return OpCosts(f=row["f"], sigma=row["sigma"], o=row["o"])
+    good = [k for k, f in enumerate(calib["f_per_width"]) if f > 0]
+    if not good:
+        raise ValueError(
+            "calibration produced no physical fit (every width has f <= 0 "
+            "— the linear cost model was violated, e.g. cache spill)")
+    row = calib["op_costs_per_width"][good[-1]]
+    return OpCosts(f=row["f"], sigma=row["sigma"], o=row["o"])
 
 
 def predict(m: int, n: int, costs: OpCosts, mode: str,

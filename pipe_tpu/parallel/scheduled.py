@@ -478,45 +478,35 @@ class ScheduledPipeline:
         """Static per-device buffer counts — the memory story, inspectable.
         Reflects the ACTIVE transport: under overlapped transport the slot
         counts come from the comm-shifted tables (stash windows widen by
-        the extra in-flight cycle; a small grad park appears)."""
+        the extra in-flight cycle; a small grad park appears). The
+        checkpoint-mode → slot-count arithmetic is the SHARED formula in
+        ``core/memplan.py`` — the same one the auto-planner prices
+        candidate configs with (``estimate_memory``), so the two cannot
+        drift."""
+        from ..core.memplan import MemoryPlanInputs, activation_slot_plan
         d, v = self.n_stages, self.v
         phased = self._phase_program(m)
         overlap = phased is not None or self._overlap_enabled()
+        Gg = 0
         if phased is not None:
-            (op_np, mb_np, grp_np, _, _), _, Sg, Gg, Wg_ov, _, _ = \
+            (op_np, mb_np, grp_np, _, _), _, Sg, Gg, Wg, _, _ = \
                 self._host_tables_phased(m)
-            Wg = Wg_ov if self.checkpoint == "never" else 0
         elif overlap:
-            (op_np, mb_np, grp_np, _, _), _, Sg, Gg, Wg_ov, _, _ = \
+            (op_np, mb_np, grp_np, _, _), _, Sg, Gg, Wg, _, _ = \
                 self._host_tables_overlap(m)
-            Wg = Wg_ov if self.checkpoint == "never" else 0
         else:
             Sg = self.schedule.stash_slots(m, d)
-            # The B->W cotangent park exists only under stored residuals;
-            # in recompute modes split-backward tables run the full
-            # backward at B and the W slots park nothing
-            # (see _device_program).
-            Wg = (self.schedule.wstash_slots(m, d)
-                  if self.checkpoint == "never" else 0)
-        R = {"always": 0, "except_last": v,
-             "never": v * Sg}[self.checkpoint]
-        # Policy-shaped residual slots (dynamic path): recompute
-        # micro-batches park their policy-saved subset here, one FIFO slot
-        # per (virtual stage, stash window) — same lifetime as the stash.
-        Rp = (v * Sg if self.remat_policy is not None
-              and self.checkpoint != "never" else 0)
-        plan = {"cycles": self._cycles(m), "stash_slots": v * Sg,
-                "stash_slots_per_virtual_stage": Sg, "residual_slots": R,
-                "policy_residual_slots": Rp,
-                "h_last_slots": Sg, "wstash_slots": v * Wg,
-                "taps_slots": (v * Sg if self.split_stage is not None
-                               else 0),
-                "virtual_stages_per_device": v,
+            Wg = self.schedule.wstash_slots(m, d)
+        plan = {"cycles": self._cycles(m),
+                **activation_slot_plan(MemoryPlanInputs(
+                    v=v, stash_slots=Sg, wstash_slots=Wg,
+                    checkpoint=self.checkpoint,
+                    has_remat_policy=self.remat_policy is not None,
+                    split_stage=self.split_stage is not None,
+                    overlap=overlap, grad_park_slots=Gg)),
                 "transport": ("phase-compiled" if phased is not None
                               else "overlapped" if overlap
                               else "serialized")}
-        if overlap:
-            plan["grad_park_slots"] = v * Gg
         if phased is not None:
             plan["phase_segments"] = tuple(
                 (s_.kind, s_.t0, s_.t1, s_.period)

@@ -24,6 +24,7 @@ Deliberate re-idiomizations (documented, not ported):
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -67,10 +68,46 @@ class Pipe:
                  n_stages: Optional[int] = None,
                  balance: Optional[Sequence[int]] = None,
                  schedule: str = "gpipe",
+                 plan=None,
                  deferred_batch_norm: bool = False,
                  remat_policy=None,
                  overlap_transport: Optional[bool] = None,
                  phase_compile: Optional[bool] = None):
+        # --- auto-planner front door (core/planner.py): a Plan (or a path
+        # to a saved PLAN json) fixes chunks/schedule/balance/n_stages and
+        # the checkpoint mode it was scored under — the one-liner the
+        # planner exists for. Config the plan already decides cannot also
+        # be hand-passed (conflicting sources would silently disagree).
+        if plan is not None:
+            from .core.planner import Plan
+            if isinstance(plan, str):
+                plan = Plan.load(plan)
+            if (chunks != 1 or balance is not None or n_stages is not None
+                    or schedule != "gpipe"):
+                raise ValueError(
+                    "Pipe(plan=...) already fixes chunks, schedule, "
+                    "balance and n_stages — drop the hand-passed values "
+                    "(or drop the plan)")
+            if checkpoint != "except_last" \
+                    and checkpoint != plan.checkpoint:
+                raise ValueError(
+                    f"checkpoint={checkpoint!r} conflicts with the plan's "
+                    f"{plan.checkpoint!r} (the plan was scored under its "
+                    f"own checkpoint mode)")
+            chunks = plan.m
+            checkpoint = plan.checkpoint
+            schedule = plan.schedule_obj()
+            balance = list(plan.balance)
+            n_stages = len(balance)
+            if plan.split_stage:
+                warnings.warn(
+                    "this plan prescribes the structural B/W split "
+                    "(split_stage=True); the Pipe front door's "
+                    "heterogeneous executor runs split-backward tables "
+                    "via the stored-vjp path instead — drive "
+                    "ScheduledPipeline (or Trainer) with the plan to "
+                    "engage the split", stacklevel=2)
+        self.plan = plan
         # --- fail-fast validation (reference pipe.py:324-345) ---
         if not isinstance(chunks, int) or isinstance(chunks, bool):
             raise TypeError("chunks must be an integer")

@@ -106,6 +106,78 @@ class TrainerConfig:
     # ``resilience``; None — the default — adds nothing to the program
     # (pinned in tests/test_elastic.py).
     elastic: Optional[Any] = None
+    # Auto-planner front door (core/planner.py, docs/planning.md): a
+    # planner ``Plan``, a path to a saved PLAN json, or the string
+    # "auto". Resolved in Trainer.__init__ BEFORE executor dispatch: the
+    # plan's schedule / chunks (m) / interleave / checkpoint replace the
+    # corresponding fields here. "auto" searches the Trainer-supported
+    # schedule families over an analytic uniform profile — PipelinedLM's
+    # stage bodies are homogeneous, so uniform relative costs are exact —
+    # at this config's stage count, batch size and checkpoint mode.
+    # None (default): the hand-picked fields below stand.
+    plan: Optional[Any] = None
+    # Per-device memory cap (bytes) handed to the planner's search when
+    # plan="auto"; None = uncapped.
+    plan_memory_cap: Optional[int] = None
+
+
+def _resolve_plan_config(model_cfg: LMConfig,
+                         cfg: TrainerConfig) -> TrainerConfig:
+    """Fold a planner Plan into the TrainerConfig (cfg.plan is set).
+
+    "auto" runs the search here — schedule family × m × interleave over
+    an analytic uniform profile (homogeneous PipelinedLM stage bodies),
+    serialized cost mode on CPU hosts, parallel on real accelerators —
+    restricted to the families this Trainer can execute. A Plan object or
+    saved-plan path is adopted as-is (its schedule must be one the
+    Trainer dispatches on)."""
+    from ..core.planner import Plan, search, uniform_profile
+
+    plan = cfg.plan
+    if isinstance(plan, str) and plan != "auto":
+        plan = Plan.load(plan)
+    if plan == "auto":
+        mode = ("serialized"
+                if jax.devices()[0].platform == "cpu" else "parallel")
+        # Per-layer analytic sizes: one boundary activation row is
+        # [bptt, d_model] f32; transformer-block params are the attention
+        # (4 d^2) + FFN (2 d d_ff) matmuls.
+        act = cfg.bptt * model_cfg.d_model * 4
+        p_layer = (4 * model_cfg.d_model ** 2
+                   + 2 * model_cfg.d_model * model_cfg.d_ff) * 4
+        prof = uniform_profile(
+            model_cfg.n_layers, rows=1, mode=mode,
+            layer_act_bytes=act, layer_param_bytes=p_layer)
+        m_cands = sorted({m for m in (2, 4, 8, 16, 32, cfg.chunks)
+                          if m > 0 and cfg.batch_size % m == 0})
+        plans = search(
+            prof, n_devices=cfg.n_stages, m_candidates=m_cands,
+            batch_rows=cfg.batch_size,
+            schedules=("gpipe", "1f1b", "zb-h1", "interleaved-1f1b"),
+            interleave_candidates=(cfg.interleave,),
+            checkpoint=cfg.checkpoint,
+            memory_cap_bytes=cfg.plan_memory_cap,
+            uniform_only=True)
+        if not plans:
+            raise ValueError(
+                "plan='auto' found no feasible plan: every candidate "
+                "failed verification, phase compilation, or the "
+                "plan_memory_cap — raise the cap or hand-pick a config")
+        plan = plans[0]
+    widths = set(plan.balance)
+    if len(widths) > 1:
+        warnings.warn(
+            f"plan balance {list(plan.balance)} is non-uniform; the "
+            f"Trainer's PipelinedLM factors layers uniformly over "
+            f"virtual stages, so only the plan's stage COUNT is honored "
+            f"here (drive Pipe(plan=...) for heterogeneous cuts)",
+            stacklevel=3)
+    kw: Dict[str, Any] = {"plan": plan, "schedule": plan.schedule,
+                          "chunks": plan.m, "checkpoint": plan.checkpoint,
+                          "n_stages": plan.n_devices}
+    if plan.v > 1:
+        kw["interleave"] = plan.v
+    return dataclasses.replace(cfg, **kw)
 
 
 class Trainer:
@@ -115,6 +187,8 @@ class Trainer:
                  devices: Optional[List[jax.Device]] = None,
                  chaos=None):
         self.model_cfg = model_cfg
+        if cfg.plan is not None:
+            cfg = _resolve_plan_config(model_cfg, cfg)
         self.cfg = cfg
         # Fault injection (resilience.ChaosPlan): the activation hook
         # wraps the model's pre_fn ONLY when a plan is supplied, so the

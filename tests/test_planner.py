@@ -1,0 +1,297 @@
+"""Auto-planner proofs: every emitted plan verifies, the search is
+deterministic and cap-respecting, the wall model pins to the committed
+cost model, bad calibrations are refused, and ``Pipe(plan=...)``
+reproduces the hand-specified config bitwise.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.core.balance import balance_cost, profile_times, stage_costs
+from pipe_tpu.core.memplan import (MemoryPlanInputs, activation_slot_plan,
+                                   estimate_memory)
+from pipe_tpu.core.planner import (CalibrationError, CostProfile, Plan,
+                                   predict_wall, search, uniform_profile)
+from pipe_tpu.core.schedule import (InterleavedOneFOneBSchedule,
+                                    compile_phases, get_schedule,
+                                    verify_interleaved_op_tables,
+                                    verify_op_tables)
+from pipe_tpu.obs.zb_model import OpCosts, schedule_wall
+from pipe_tpu.ops.layers import Linear, Sequential
+from pipe_tpu.parallel.mesh import make_mesh
+
+WIDTH = 8
+
+ALL_SCHEDULES = ("gpipe", "1f1b", "interleaved-1f1b", "zb-h1", "zb-h2")
+
+
+def _search_8x4(**kw):
+    """The canonical search fixture: 8 uniform layers on 4 devices."""
+    profile = uniform_profile(8, rows=4, f=1.0, layer_param_bytes=1000,
+                              layer_act_bytes=500)
+    kw.setdefault("schedules", ALL_SCHEDULES)
+    return search(profile, n_devices=4, m_candidates=(2, 4, 8), **kw)
+
+
+# ---------------------------------------------------------------------------
+# the wall model pins to obs/zb_model.schedule_wall
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["gpipe", "1f1b", "zb-h1", "zb-h2"])
+@pytest.mark.parametrize("mode", ["serialized", "parallel"])
+def test_predict_wall_matches_schedule_wall(name, mode):
+    """With uniform cost columns and b = 2f, the heterogeneous wall model
+    IS schedule_wall — same table, same price."""
+    m, n = 8, 4
+    costs = OpCosts(f=0.7, sigma=1.3, o=0.05)
+    op = get_schedule(name).op_tables(m, n)[0]
+    want = schedule_wall(op, costs, mode)
+    got = predict_wall(op, None, [0.7] * n, [1.4] * n, d=n,
+                       sigma=1.3, o=0.05, mode=mode)
+    assert got == pytest.approx(want, rel=1e-12)
+
+
+def test_predict_wall_heterogeneous_bottleneck():
+    """A stage 2x the cost doubles the per-cycle max it participates in —
+    the parallel wall must strictly exceed the uniform one."""
+    op = get_schedule("1f1b").op_tables(8, 4)[0]
+    uni = predict_wall(op, None, [1.0] * 4, [2.0] * 4, d=4,
+                       sigma=1.0, o=0.0, mode="parallel")
+    het = predict_wall(op, None, [1.0, 2.0, 1.0, 1.0],
+                       [2.0, 4.0, 2.0, 2.0], d=4,
+                       sigma=1.0, o=0.0, mode="parallel")
+    assert het > uni
+
+
+# ---------------------------------------------------------------------------
+# every emitted plan carries a valid, phase-compilable table
+# ---------------------------------------------------------------------------
+
+
+def test_every_emitted_plan_verifies():
+    plans = _search_8x4()
+    assert plans, "search emitted no plans"
+    for p in plans:
+        d = p.n_devices
+        sched = (InterleavedOneFOneBSchedule(interleave=p.v) if p.v > 1
+                 else get_schedule(p.schedule))
+        tables = sched.op_tables(p.m, d if p.v > 1 else p.v * d)
+        op, mbi = tables[0], tables[1]
+        grp = tables[2] if len(tables) > 2 else None
+        if p.v > 1:
+            verify_interleaved_op_tables(op, mbi, grp, p.m, d, p.v)
+        else:
+            verify_op_tables(
+                op, mbi, p.m, d, stash_slots=sched.stash_slots(p.m, d),
+                wstash_slots=(sched.wstash_slots(p.m, d)
+                              if sched.splits_backward else None))
+        verdict = compile_phases(op, mbi, grp, m=p.m, d=d, v=p.v)
+        assert verdict.accepted, (p.schedule, p.m, p.v, verdict.reason)
+        assert p.phase_ok
+
+
+def test_search_deterministic():
+    """No RNG, no clock: a fixed profile yields the same ranked list."""
+    a = [p.summary() for p in _search_8x4()]
+    b = [p.summary() for p in _search_8x4()]
+    assert a == b
+
+
+def test_memory_cap_excludes_over_cap_candidates():
+    free = _search_8x4(max_plans=32)
+    peaks = sorted(p.predicted_peak_bytes for p in free)
+    cap = peaks[len(peaks) // 2]        # median: some in, some out
+    assert peaks[-1] > cap              # the cap actually bites
+    capped = _search_8x4(max_plans=32, memory_cap_bytes=cap)
+    assert capped
+    assert all(p.predicted_peak_bytes <= cap for p in capped)
+    over = {(p.schedule, p.m, p.v, p.split_stage) for p in free
+            if p.predicted_peak_bytes > cap}
+    kept = {(p.schedule, p.m, p.v, p.split_stage) for p in capped}
+    assert not (over & kept)
+
+
+def test_ranking_is_per_row():
+    """Per-row time, not per-step: with batch scaling alongside m, a
+    bigger m amortizes fill/drain and must not lose on raw step time."""
+    plans = _search_8x4()
+    for p in plans:
+        assert p.predicted_s_per_row == pytest.approx(
+            p.predicted_step_s / (p.m * 4))
+
+
+# ---------------------------------------------------------------------------
+# calibration refusal: residual over threshold -> loud no
+# ---------------------------------------------------------------------------
+
+
+def test_calibration_refused_over_residual_threshold():
+    with pytest.warns(UserWarning, match="REFUSING"):
+        with pytest.raises(CalibrationError):
+            CostProfile(layer_fwd_s=(1.0,) * 4, layer_bwd_s=(2.0,) * 4,
+                        layer_param_bytes=(0,) * 4,
+                        layer_act_bytes=(0,) * 4,
+                        rel_residual=0.30)
+
+
+def test_calibration_accepted_under_threshold():
+    p = CostProfile(layer_fwd_s=(1.0,) * 4, layer_bwd_s=(2.0,) * 4,
+                    layer_param_bytes=(0,) * 4, layer_act_bytes=(0,) * 4,
+                    rel_residual=0.06)
+    assert p.n_layers == 4
+
+
+# ---------------------------------------------------------------------------
+# the Plan artifact round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_plan_json_roundtrip(tmp_path):
+    top = _search_8x4()[0]
+    again = Plan.from_json(top.to_json())
+    assert again == top
+    path = tmp_path / "plan.json"
+    top.save(str(path))
+    assert Plan.load(str(path)) == top
+    d = json.loads(top.to_json())
+    assert d["version"] == 1
+    assert d["runners_up"]          # the winner records what it beat
+
+
+# ---------------------------------------------------------------------------
+# Pipe(plan=...) == the hand-specified config, bitwise
+# ---------------------------------------------------------------------------
+
+
+def test_pipe_plan_reproduces_hand_config_bitwise():
+    from pipe_tpu.pipe import Pipe
+
+    profile = uniform_profile(4, rows=4, f=1.0, layer_param_bytes=256,
+                              layer_act_bytes=32)
+    plans = search(profile, n_devices=2, m_candidates=(4,),
+                   schedules=("1f1b",))
+    top = plans[0]
+    assert (top.schedule, top.m) == ("1f1b", 4)
+
+    x = jax.random.normal(jax.random.key(1), (8, WIDTH))
+    y = jax.random.normal(jax.random.key(2), (8, WIDTH))
+
+    def loss_fn(out, tgt):
+        return jnp.mean((out - tgt) ** 2, axis=-1)
+
+    out = []
+    for kw in ({"plan": top},
+               {"chunks": top.m, "checkpoint": top.checkpoint,
+                "schedule": top.schedule_obj(),
+                "balance": list(top.balance)}):
+        seq = Sequential([Linear(WIDTH) for _ in range(4)])
+        mesh = make_mesh(2, 1, devices=jax.devices()[:2])
+        pipe = Pipe(seq, mesh=mesh, **kw)
+        packed = pipe.shard_params(pipe.init(jax.random.key(0), x))
+        out.append((packed, pipe.loss_and_grad(packed, x, targets=y,
+                                               loss_fn=loss_fn)))
+    (p_plan, (l_plan, g_plan)), (p_hand, (l_hand, g_hand)) = out
+    for a, b in zip(jax.tree_util.tree_leaves(p_plan),
+                    jax.tree_util.tree_leaves(p_hand)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(l_plan), np.asarray(l_hand))
+    for a, b in zip(jax.tree_util.tree_leaves(g_plan),
+                    jax.tree_util.tree_leaves(g_hand)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pipe_plan_conflicts_rejected():
+    from pipe_tpu.pipe import Pipe
+    top = _search_8x4()[0]
+    seq = Sequential([Linear(WIDTH) for _ in range(4)])
+    mesh = make_mesh(2, 1, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="plan"):
+        Pipe(seq, mesh=mesh, plan=top, chunks=2)
+
+
+# ---------------------------------------------------------------------------
+# shared memory arithmetic: planner and executor price the same slots
+# ---------------------------------------------------------------------------
+
+
+def test_memplan_matches_scheduled_executor():
+    from pipe_tpu.parallel.scheduled import ScheduledPipeline
+    mesh = make_mesh(2, 1, devices=jax.devices()[:2])
+    for schedule, split in (("1f1b", None), ("zb-h1", "auto")):
+        kw = {"split_stage": split} if split else {}
+        pipe = ScheduledPipeline(
+            mesh, lambda p, h, ctx: h,
+            pre_fn=lambda p, x_mb, ctx: x_mb,
+            post_fn=lambda p, h, x_mb, ctx: jnp.sum(h, -1),
+            checkpoint="never", schedule=schedule, **kw)
+        got = pipe.memory_plan(4)
+        sched = get_schedule(schedule)
+        want = activation_slot_plan(MemoryPlanInputs(
+            v=1, stash_slots=sched.stash_slots(4, 2),
+            wstash_slots=(sched.wstash_slots(4, 2)
+                          if sched.splits_backward else 0),
+            checkpoint="never", split_stage=bool(split)))
+        for k, v in want.items():
+            assert got[k] == v, (schedule, k, got[k], v)
+        # the dict the executor reports prices directly
+        assert estimate_memory(got, act_bytes=100, param_bytes=1000) > 0
+
+
+def test_estimate_memory_monotone_in_checkpoint():
+    """'never' stashes every residual; 'always' stashes none — the
+    estimate must order accordingly (what a pruning cap relies on)."""
+    bytes_for = {
+        ck: estimate_memory(
+            MemoryPlanInputs(v=1, stash_slots=4, checkpoint=ck),
+            act_bytes=100)
+        for ck in ("never", "except_last", "always")}
+    assert bytes_for["never"] > bytes_for["except_last"] \
+        > bytes_for["always"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: balance cost vector + noise-robust profiling
+# ---------------------------------------------------------------------------
+
+
+def test_balance_cost_vector_and_max():
+    costs = [1.0, 2.0, 3.0, 4.0]
+    vec = balance_cost([1, 3], costs, per_stage=True)
+    assert vec == [1.0, 9.0]
+    assert vec == stage_costs([1, 3], costs)
+    assert balance_cost([1, 3], costs) == 9.0
+
+
+def test_profile_times_median_of_k():
+    seq = Sequential([Linear(WIDTH) for _ in range(3)])
+    x = jnp.ones((4, WIDTH))
+    params = seq.init(jax.random.key(0), x)
+    times = profile_times(seq, params, x, repeat=3, warmup=1)
+    assert len(times) == 3
+    assert all(t > 0 for t in times)
+
+
+def test_trainer_plan_auto_resolves():
+    """Trainer(plan='auto'): the planner overrides schedule/chunks with a
+    feasible ranked winner, and the trainer builds + initializes."""
+    from pipe_tpu.models.transformer_lm import LMConfig
+    from pipe_tpu.train.loop import Trainer, TrainerConfig
+
+    model_cfg = dataclasses.replace(LMConfig().tiny(), n_layers=4)
+    cfg = TrainerConfig(n_stages=2, chunks=4, checkpoint="never",
+                       batch_size=8, eval_batch_size=8,
+                       bptt=model_cfg.seq_len, plan="auto")
+    trainer = Trainer(model_cfg, cfg)
+    rc = trainer.cfg
+    assert rc.plan is not None
+    assert rc.schedule == rc.plan.schedule
+    assert rc.chunks == rc.plan.m
+    assert rc.batch_size % rc.chunks == 0
+    state = trainer.init_state()
+    assert state is not None
