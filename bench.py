@@ -487,6 +487,13 @@ def main():
                   f"{out.stderr[-2000:]}", file=sys.stderr)
     except Exception as e:
         print(f"serve probe failed: {e}", file=sys.stderr)
+    if serve_summary is not None:
+        # Paged KV must not lose to the slab at equal live slots on the
+        # shared-prefix workload (its 2x-slots-same-memory win is on
+        # top of, not instead of, per-slot throughput).
+        assert serve_summary["kv_paged_vs_slab_equal_slots"] >= 1.0, (
+            "paged KV slower than slab at equal live slots: "
+            f"{serve_summary['kv_paged_vs_slab_equal_slots']}x")
 
     # Chaos probe: one injected fault per layer (train NaN, transport
     # drop, serve backend raise, data raise) through the recovery

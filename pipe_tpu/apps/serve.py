@@ -79,6 +79,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--shed-ewma", type=float, default=None,
                    help="watchdog: deadline-miss EWMA above which "
                         "lowest-priority queued requests are shed")
+    p.add_argument("--kv", choices=["slab", "paged"], default="slab",
+                   help="KV memory: per-slot monolithic slab, or the "
+                        "paged block pool (shared-prefix reuse + "
+                        "chunked prefill)")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="rows per KV block with --kv paged")
+    p.add_argument("--kv-pool-blocks", type=int, default=None,
+                   help="pool size in blocks with --kv paged "
+                        "(default: the slab's row footprint)")
     p.add_argument("--int8", action="store_true",
                    help="int8 weight-only quantized block weights")
     p.add_argument("--family", choices=["lm", "gpt2"], default="lm")
@@ -166,6 +175,9 @@ def main(argv=None) -> int:
     buckets = BucketSpec.pow2(min_len=8,
                               max_len=max(len(p) for p in prompts))
     max_len = buckets.max_len + args.max_new
+    kv_kwargs = {} if args.kv == "slab" else {
+        "kv_block_size": args.kv_block_size,
+        "kv_pool_blocks": args.kv_pool_blocks}
     if n_stages > 1:
         from ..parallel.mesh import make_mesh
         from ..parallel.spmd import stack_stage_params
@@ -174,12 +186,13 @@ def main(argv=None) -> int:
         backend = RingSlotBackend(
             make_mesh(n_stages, 1), model, stack_stage_params(sp), pre,
             post, max_len=max_len, gen=gen_cfg, buckets=buckets,
-            revolutions=args.decode_chunk)
+            revolutions=args.decode_chunk, **kv_kwargs)
     else:
         from ..serve import SingleDeviceSlotBackend
         backend = SingleDeviceSlotBackend(
             model, params, num_slots=args.slots, max_len=max_len,
-            gen=gen_cfg, buckets=buckets, decode_chunk=args.decode_chunk)
+            gen=gen_cfg, buckets=buckets, decode_chunk=args.decode_chunk,
+            **kv_kwargs)
 
     events = EventLog(args.events) if args.events else NULL_EVENT_LOG
 
@@ -200,7 +213,7 @@ def main(argv=None) -> int:
             SingleDeviceSlotBackend(
                 model, params, num_slots=args.slots, max_len=max_len,
                 gen=gen_cfg, buckets=buckets,
-                decode_chunk=args.decode_chunk)
+                decode_chunk=args.decode_chunk, **kv_kwargs)
             for _ in range(replicas - 1)]
         engines = [ServeEngine(b,
                                RequestQueue(capacity=args.queue_capacity),

@@ -55,6 +55,15 @@ class GenerationConfig:
     # stop, every sequence runs to max_new_tokens, the pre-EOS behavior.
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
+    # Serve-side KV memory knobs (ignored by the one-shot generators,
+    # which size a private cache per call). kv_block_size=None keeps the
+    # monolithic per-slot slab; a power-of-two value switches the slot
+    # backends to the paged pool (serve/kvpool.py). prefix_cache gates
+    # shared-prefix block reuse inside the pool — pure host-side
+    # allocator policy, so disabling it lowers to byte-identical device
+    # programs (the absence-is-zero-cost pin, tests/test_kvpool.py).
+    kv_block_size: Optional[int] = None
+    prefix_cache: bool = True
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -73,6 +82,13 @@ class GenerationConfig:
         if self.pad_token_id < 0:
             raise ValueError(
                 f"pad_token_id must be >= 0, got {self.pad_token_id}")
+        if self.kv_block_size is not None and (
+                self.kv_block_size < 1
+                or (self.kv_block_size & (self.kv_block_size - 1)) != 0):
+            raise ValueError(
+                f"kv_block_size must be a positive power of two (block "
+                f"indexing is a shift+mask in the decode step), got "
+                f"{self.kv_block_size}")
         if self.num_beams > 1 and self.eos_token_id is not None:
             raise ValueError(
                 "eos_token_id with beam search is not implemented — "

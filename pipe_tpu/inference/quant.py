@@ -32,7 +32,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-__all__ = ["QuantLeaf", "quantize_params", "dequant_tree"]
+__all__ = ["QuantLeaf", "quantize_params", "dequant_tree",
+           "quantize_kv_rows"]
 
 
 @jax.tree_util.register_dataclass
@@ -83,6 +84,21 @@ def quantize_params(stage_params) -> Any:
         return leaf
 
     return jax.tree_util.tree_map(one, stage_params)
+
+
+def quantize_kv_rows(rows: jax.Array):
+    """Symmetric absmax int8 over the last axis — one f32 scale per
+    ``[..., head_dim]`` vector. The KV-block analog of
+    :func:`_quantize_leaf`, used by the paged pool (``serve/kvpool.py``)
+    to quantize rows on scatter; the matching dequant happens inside the
+    gathered attention read. Per-row per-head scales keep the relative
+    error bound of the weight path; the accuracy contract is tolerance
+    (``tests/test_kvpool.py``), NOT the engine's bitwise pin."""
+    r32 = rows.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(r32), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(r32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def dequant_tree(params, dtype=jnp.bfloat16):

@@ -10,7 +10,11 @@ prompt-length shapes. Two slot backends:
 :class:`~.engine.SingleDeviceSlotBackend` (replicated weights, S
 arbitrary) and :class:`~.ring.RingSlotBackend` (stage-sharded weights —
 slots are the pipeline ring's request groups, kept continuously full
-across admissions/retirements). At fleet scale, :class:`~.router.Router`
+across admissions/retirements). Both back their KV memory with either a
+per-slot monolithic slab or a :class:`~.kvpool.KvPool` of fixed-size
+blocks (``kv_block_size=``) — paged mode adds shared-prefix reuse with
+copy-on-write and ONE chunked prefill program for every prompt length.
+At fleet scale, :class:`~.router.Router`
 shards one front queue across N engine replicas with health-gated
 failover, retry budgets, and exactly-once response delivery. See
 ``docs/serving.md`` ("Online serving" / "Fleet serving") and
@@ -19,6 +23,7 @@ failover, retry budgets, and exactly-once response delivery. See
 
 from .buckets import BucketSpec
 from .engine import EngineDraining, ServeEngine, SingleDeviceSlotBackend
+from .kvpool import Admission, KvPool, PoolExhausted, block_demand
 from .queue import QueueFull, Request, RequestQueue, Response
 from .ring import RingSlotBackend
 from .router import (DRAINING, HEALTHY, RETIRED, SUSPECT, WEDGED, Replica,
@@ -28,4 +33,5 @@ __all__ = ["BucketSpec", "ServeEngine", "SingleDeviceSlotBackend",
            "RingSlotBackend", "QueueFull", "Request", "RequestQueue",
            "Response", "EngineDraining", "Router", "RouterPolicy",
            "Replica", "HEALTHY", "SUSPECT", "WEDGED", "DRAINING",
-           "RETIRED"]
+           "RETIRED", "KvPool", "PoolExhausted", "Admission",
+           "block_demand"]

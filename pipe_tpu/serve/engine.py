@@ -54,6 +54,9 @@ from ..inference.quant import QuantLeaf, dequant_tree
 from ..obs.events import NULL_EVENT_LOG, REQUEST
 from ..obs.telemetry import get_registry
 from .buckets import BucketSpec
+from .kvpool import (KvPool, PoolExhausted, block_demand, copy_block,
+                     flat_row_index, gather_block_cache, scatter_block_rows,
+                     storage_for)
 from .queue import QueueFull, Request, RequestQueue, Response
 
 __all__ = ["SingleDeviceSlotBackend", "ServeEngine", "EngineDraining"]
@@ -91,7 +94,11 @@ class SingleDeviceSlotBackend:
     def __init__(self, model, params, *, num_slots: int, max_len: int,
                  gen: GenerationConfig = GenerationConfig(),
                  buckets: Optional[BucketSpec] = None,
-                 decode_chunk: int = 1, shape_cache_warn: int = 8):
+                 decode_chunk: int = 1, shape_cache_warn: int = 8,
+                 kv_block_size: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None,
+                 prefill_chunk: int = 16,
+                 kv_dtype: Optional[str] = None):
         if not hasattr(model, "embed_at"):
             raise TypeError(
                 f"{type(model).__name__} has no embed_at; KV-cache "
@@ -129,26 +136,81 @@ class SingleDeviceSlotBackend:
         self._pre = pre_params
         self._post = post_params
 
+        kbs = kv_block_size if kv_block_size is not None \
+            else gen.kv_block_size
+        self.paged = kbs is not None
+        self.kv_dtype = kv_dtype
         proto = model.block.attn.make_cache(1, max_len, dtype=cd)
-        self._caches = jax.tree_util.tree_map(
-            lambda a: jnp.zeros(
-                (self._n_layers, num_slots) + a.shape[1:], a.dtype),
-            proto)
+        if self.paged:
+            # paged KV: a block pool + per-slot tables replace the slab.
+            # Default pool = the slab's row budget (same memory, ~2x the
+            # servable live slots on mixed-length traffic) + block 0.
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+            self.prefill_chunk = prefill_chunk
+            mb = -(-max_len // kbs)
+            nb = kv_pool_blocks if kv_pool_blocks is not None \
+                else num_slots * mb + 1
+            self.pool = KvPool(
+                num_blocks=nb, block_size=kbs, num_slots=num_slots,
+                max_len=max_len, prefix_cache=gen.prefix_cache,
+                gather_slack_rows=prefill_chunk)
+            self._pool_kv = storage_for(
+                proto, self._n_layers, nb, kbs, kv_dtype=kv_dtype)
+            self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(2,))
+            self._sample_jit = jax.jit(self._sample_fn)
+            self._fork_jit = jax.jit(self._fork_fn, donate_argnums=(0,))
+            self._decode_jit = jax.jit(self._decode_paged_fn,
+                                       donate_argnums=(3, 8))
+            # per-slot gathered views carried across decode chunks —
+            # valid until a prefill moves a table (_views_dirty), when
+            # the decode program re-gathers from the (always-current)
+            # pool. Compute dtype even for int8 pools: the view is the
+            # dequantized working set.
+            R = self.pool.max_blocks * kbs
+            self._views = {
+                name: jnp.zeros(
+                    (self._n_layers, num_slots, R) + proto[name].shape[2:],
+                    cd)
+                for name in ("k", "v")}
+            self._views_dirty = True
+        else:
+            if kv_dtype is not None:
+                raise ValueError(
+                    "kv_dtype needs the paged pool (set kv_block_size); "
+                    "the slab path stores KV in the compute dtype")
+            self.pool = None
+            self._caches = jax.tree_util.tree_map(
+                lambda a: jnp.zeros(
+                    (self._n_layers, num_slots) + a.shape[1:], a.dtype),
+                proto)
+            self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(3,))
         self._tok = jnp.zeros((num_slots,), jnp.int32)
         self._pos = jnp.zeros((num_slots,), jnp.int32)
         kd0 = jax.random.key_data(jax.random.key(0))
         self._key_data = jnp.broadcast_to(kd0, (num_slots,) + kd0.shape)
 
         self._prefill_programs = {}
-        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(3,))
 
     # -- validation --------------------------------------------------------
 
     def validate(self, prompt_len: int, max_new_tokens: int) -> None:
         """Admission-control shape checks — reject at submit, not at
-        prefill, so a bad request never costs a slot."""
+        prefill, so a bad request never costs a slot. Paged mode adds
+        the can-it-EVER-fit check: demand beyond the whole pool is
+        unservable, not merely parked."""
         bucket = (self.buckets.bucket_for(prompt_len)
-                  if self.buckets is not None else prompt_len)
+                  if self.buckets is not None and not self.paged
+                  else prompt_len)
+        if self.paged and self.pool.demand_for(
+                prompt_len, max_new_tokens) > self.pool.allocatable:
+            raise ValueError(
+                f"request needs "
+                f"{self.pool.demand_for(prompt_len, max_new_tokens)} KV "
+                f"blocks but the whole pool holds "
+                f"{self.pool.allocatable}; raise kv_pool_blocks or "
+                f"shorten the request")
         if prompt_len + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt_len {prompt_len} + max_new_tokens "
@@ -258,13 +320,176 @@ class SingleDeviceSlotBackend:
         caches, tok, pos, key_data = carry[:4]
         return caches, tok, pos, key_data, jnp.moveaxis(toks, 0, 1)
 
+    # -- paged device programs ---------------------------------------------
+
+    def _chunk_fn(self, block_stack, pre, pool_kv, table_row, tokens,
+                  t0, true_len):
+        """THE prefill program: one fixed-shape ``[1, C]`` chunk at a
+        traced offset, looped on the host until the prompt is covered —
+        ANY prompt length, one compile (the per-bucket programs the slab
+        path keys on prompt shape are gone). Each layer attends against
+        the slot's gathered block view (earlier chunks' rows included)
+        and scatters its C new rows back through the table; pad
+        positions past ``true_len`` land in the slot's own future decode
+        blocks or the sacrificial block, both rewritten/ignored before
+        any unmasked read. Returns ``h`` at ``true_len - 1`` clamped
+        into this chunk — the host keeps the last chunk's."""
+        m = self.model
+        cd = m.cfg.compute_dtype
+        get_registry().counter("serve.engine.prefill_chunk_traces").inc()
+        bs = self.pool.block_size
+        C = tokens.shape[1]
+        h = m.embed_at(pre, tokens, t0)                  # [1, C, d]
+        positions = t0 + jnp.arange(C, dtype=jnp.int32)
+        ridx = flat_row_index(table_row, positions, bs)
+
+        def layer(h, inp):
+            bp, pool_l = inp
+            cache = gather_block_cache(pool_l, table_row, block_size=bs,
+                                       compute_dtype=cd)
+            h, c2 = m.block.decode(dequant_tree(bp, cd), h, cache, t0)
+            rows = {name: jax.lax.dynamic_slice(
+                        c2[name], (0, t0) + (0,) * (c2[name].ndim - 2),
+                        (1, C) + c2[name].shape[2:])[0]
+                    for name in ("k", "v")}
+            return h, scatter_block_rows(pool_l, ridx, rows)
+
+        h, pool_kv = jax.lax.scan(layer, h, (block_stack, pool_kv))
+        idx = jnp.clip(true_len - 1 - t0, 0, C - 1)
+        h_last = jax.lax.dynamic_slice(h, (0, idx, 0), (1, 1, h.shape[-1]))
+        return pool_kv, h_last
+
+    def _sample_fn(self, post, h_last, key):
+        """First-token epilogue: the exact batch-1 Generator key chain
+        (split then sample) the slab prefill runs in-program — kept as
+        its own fixed-shape program so the chunk loop stays
+        length-agnostic."""
+        key, sub = jax.random.split(key)
+        tok0 = sample_logits(
+            head_logits(self.model, post, h_last)[:, 0, :], sub,
+            self.gen)[0]
+        return tok0, key
+
+    def _fork_fn(self, pool_kv, src, dst):
+        """Copy-on-write block copy (src/dst traced — one program for
+        every fork)."""
+        get_registry().counter("serve.kv.fork_traces").inc()
+        return copy_block(pool_kv, src, dst, block_axis=1)
+
+    def _decode_paged_fn(self, block_stack, pre, post, pool_kv, tables,
+                         tok, pos, key_data, views, regather):
+        """The paged decode step: each slot's block view — its first
+        ``max_blocks`` table entries, covering every row it can read or
+        write (``rows_needed <= max_len``), exactly the slab's attention
+        footprint — is gathered ONLY when ``regather`` says a prefill
+        moved a table since the last chunk; otherwise the views carried
+        from the previous chunk are the same rows bitwise, because the
+        end-of-chunk scatter keeps the pool current every tick. The
+        chunk then runs ``decode_chunk`` slab-style steps against the
+        view (bitwise-identical attention math, in-chunk rows read back
+        from the view exactly as the slab reads its own updates), and
+        the S*C new rows scatter back once through the FULL-width
+        tables, whose sacrificial clamp routes overshoot/dead-slot
+        writes into block 0 — a dead slot can never corrupt a
+        reallocated block. Traced once; the same counter as the slab
+        path pins zero steady-state recompiles."""
+        m, gen = self.model, self.gen
+        cd = m.cfg.compute_dtype
+        get_registry().counter("serve.engine.decode_traces").inc()
+        eos = gen.eos_token_id
+        bs = self.pool.block_size
+        C = self.decode_chunk
+        S = tok.shape[0]
+        pos0 = pos
+
+        def embed_one(t, p):
+            return m.embed_at(pre, t[None, None], p)[0]    # [1, d]
+
+        view_t = tables[:, :self.pool.max_blocks + 1]
+
+        def gather_layer(pool_l):
+            out = jax.vmap(lambda tr: gather_block_cache(
+                pool_l, tr, block_size=bs, compute_dtype=cd))(view_t)
+            return {name: a[:, 0] for name, a in out.items()}  # [S, R, .]
+
+        views = jax.lax.cond(
+            regather, lambda v: jax.vmap(gather_layer)(pool_kv),
+            lambda v: v, views)                        # [L, S, R, ...]
+
+        def step(carry, _):
+            if eos is None:
+                views, tok, pos, key_data = carry
+            else:
+                views, tok, pos, key_data, done = carry
+            h = jax.vmap(embed_one)(tok, pos)              # [S, 1, d]
+
+            def layer(h, inp):
+                bp, view_l = inp
+                bpd = dequant_tree(bp, cd)
+
+                def one(hh, cache_l, pp):
+                    cache = {name: cache_l[name][None]
+                             for name in ("k", "v")}
+                    out, c2 = m.block.decode(bpd, hh[None], cache, pp)
+                    return out[0], {name: c2[name][0]
+                                    for name in ("k", "v")}
+
+                h, view_l = jax.vmap(one)(h, view_l, pos)
+                return h, view_l
+
+            h, views = jax.lax.scan(layer, h, (block_stack, views))
+            logits = head_logits(m, post, h)[:, 0, :]      # [S, V]
+            keys = jax.random.wrap_key_data(key_data)
+            ks = jax.vmap(jax.random.split)(keys)          # [S, 2] keys
+            key_data = jax.random.key_data(ks[:, 0])
+            nxt = jax.vmap(
+                lambda lg, k: sample_logits(lg[None], k, gen)[0])(
+                    logits, ks[:, 1])
+            if eos is None:
+                return (views, nxt, pos + 1, key_data), nxt
+            nxt = jnp.where(done, jnp.int32(gen.pad_token_id), nxt)
+            done = done | (nxt == jnp.int32(eos))
+            return (views, nxt, pos + 1, key_data, done), nxt
+
+        init = (views, tok, pos, key_data)
+        if eos is not None:
+            init = init + (tok == jnp.int32(eos),)
+        carry, toks = jax.lax.scan(step, init, None, length=C)
+        views, tok, pos, key_data = carry[:4]
+
+        # rows written this chunk, back through the full-width tables
+        ridx = jax.vmap(lambda tr, p0: flat_row_index(
+            tr, p0 + jnp.arange(C, dtype=jnp.int32), bs))(tables, pos0)
+
+        def scat_layer(_, inp):
+            pool_l, view_l = inp
+            rows = {name: jax.vmap(
+                lambda v, p0: jax.lax.dynamic_slice(
+                    v, (p0,) + (0,) * (v.ndim - 1),
+                    (C,) + v.shape[1:]))(view_l[name], pos0).reshape(
+                        (S * C,) + view_l[name].shape[2:])
+                for name in ("k", "v")}
+            return 0, scatter_block_rows(pool_l, ridx.reshape(-1), rows)
+
+        _, pool_kv = jax.lax.scan(scat_layer, 0, (pool_kv, views))
+        return pool_kv, tok, pos, key_data, views, jnp.moveaxis(toks, 0, 1)
+
     # -- backend API -------------------------------------------------------
 
-    def prefill(self, slot: int, prompt: Sequence[int], seed: int) -> int:
+    def prefill(self, slot: int, prompt: Sequence[int], seed: int,
+                max_new_tokens: Optional[int] = None) -> int:
         """Fill slot ``slot``'s cache rows from ``prompt`` and return the
         first sampled token. Blocking — the returned int IS the TTFT
-        moment. One program per prompt-length bucket."""
+        moment. Slab mode: one program per prompt-length bucket. Paged
+        mode: ONE chunked program regardless of length;
+        ``max_new_tokens`` sizes the block reservation (defaults to the
+        engine cap — full-demand reservation means no mid-decode OOM)."""
         reg = get_registry()
+        if self.paged:
+            return self._prefill_paged(
+                slot, prompt, seed,
+                max_new_tokens if max_new_tokens is not None
+                else self.gen.max_new_tokens)
         if self.buckets is not None:
             padded, p = self.buckets.pad(prompt, self.gen.pad_token_id)
         else:
@@ -301,23 +526,92 @@ class SingleDeviceSlotBackend:
             jax.random.key_data(key))
         return tok0
 
+    def _prefill_paged(self, slot: int, prompt: Sequence[int], seed: int,
+                       max_new_tokens: int) -> int:
+        """Admit into the pool (reserving full demand), run the COW
+        forks, stream the prompt's recompute tail through the one chunk
+        program, sample the first token with the Generator key chain. A
+        failure mid-stream releases the reservation and unpublishes any
+        half-written cache entries."""
+        plen = len(prompt)
+        adm = self.pool.admit(slot, prompt, max_new_tokens,
+                              chunk=self.prefill_chunk)
+        try:
+            for src, dst in adm.cow_forks:
+                self._pool_kv = self._fork_jit(
+                    self._pool_kv, jnp.int32(src), jnp.int32(dst))
+            trow = jnp.asarray(adm.table)
+            C = self.prefill_chunk
+            pad = self.gen.pad_token_id
+            t = adm.resume_from
+            h_last = None
+            while t < plen:
+                toks = list(prompt[t:t + C])
+                toks += [pad] * (C - len(toks))
+                arr = jnp.asarray(toks, jnp.int32)[None, :]
+                self._pool_kv, h_last = self._chunk_jit(
+                    self._block_stack, self._pre, self._pool_kv, trow,
+                    arr, jnp.int32(t), jnp.int32(plen))
+                t += C
+            tok0, key = self._sample_jit(
+                self._post, h_last, jax.random.key(seed))
+        except Exception:
+            self.pool.release(slot, failed=True)
+            raise
+        tok0 = int(tok0)
+        self._tok = self._tok.at[slot].set(tok0)
+        self._pos = self._pos.at[slot].set(plen)
+        self._key_data = self._key_data.at[slot].set(
+            jax.random.key_data(key))
+        self._views_dirty = True       # this slot's table moved
+        return tok0
+
     def decode(self, live: np.ndarray):
         """One decode chunk for all slots. Returns ``(tokens [S, K],
         valid [S, K])`` — dead slots compute garbage (their rows are
-        rewritten at the next prefill); ``valid`` masks them out."""
-        caches, tok, pos, kd, toks = self._decode_jit(
-            self._block_stack, self._pre, self._post, self._caches,
-            self._tok, self._pos, self._key_data)
-        self._caches = caches
+        rewritten at the next prefill — or, paged, land in the
+        sacrificial block); ``valid`` masks them out."""
+        if self.paged:
+            pool_kv, tok, pos, kd, views, toks = self._decode_jit(
+                self._block_stack, self._pre, self._post, self._pool_kv,
+                jnp.asarray(self.pool.table), self._tok, self._pos,
+                self._key_data, self._views,
+                jnp.asarray(self._views_dirty))
+            self._pool_kv = pool_kv
+            self._views = views
+            self._views_dirty = False
+        else:
+            caches, tok, pos, kd, toks = self._decode_jit(
+                self._block_stack, self._pre, self._post, self._caches,
+                self._tok, self._pos, self._key_data)
+            self._caches = caches
         self._tok, self._pos, self._key_data = tok, pos, kd
         toks = np.asarray(toks)
         valid = np.broadcast_to(
             np.asarray(live, bool)[:, None], toks.shape)
         return toks, valid
 
+    def can_admit(self, prompt_len: int, max_new_tokens: int,
+                  prompt: Optional[Sequence[int]] = None) -> bool:
+        """Block-availability admission gate (always True for the slab —
+        its reservation is the slot itself)."""
+        if not self.paged:
+            return True
+        return self.pool.can_admit(prompt_len, max_new_tokens, prompt,
+                                   chunk=self.prefill_chunk)
+
+    def release(self, slot: int) -> None:
+        """Engine retirement hook: return the slot's blocks to the pool
+        (no-op for the slab — the next prefill rewrites the rows)."""
+        if self.paged:
+            self.pool.release(slot)
+
     def program_stats(self) -> dict:
+        if self.paged:
+            return {"prefill_programs": 1, "decode_chunk": self.decode_chunk,
+                    "kv": "paged"}
         return {"prefill_programs": len(self._prefill_programs),
-                "decode_chunk": self.decode_chunk}
+                "decode_chunk": self.decode_chunk, "kv": "slab"}
 
 
 class ServeEngine:
@@ -543,6 +837,9 @@ class ServeEngine:
         st = self._slots[slot]
         self._slots[slot] = None
         self._free.append(slot)
+        rel = getattr(self.backend, "release", None)
+        if rel is not None:
+            rel(slot)
         req = st.req
         bucket = (self.backend.buckets.bucket_for(len(req.prompt))
                   if self.backend.buckets is not None else len(req.prompt))
@@ -623,8 +920,27 @@ class ServeEngine:
 
         # 2) admissions — prefill straight into the freed slots; a
         # backend failure here is attributable to ONE request: fail it,
-        # free the slot, keep admitting
-        while self._free and self.queue.depth and not self._draining:
+        # free the slot, keep admitting. Paged backends gate on BLOCK
+        # availability too: when the pool can't cover the head request's
+        # demand, it parks at the head (FIFO order intact) until
+        # retirements free blocks — the slab masked this over-admission
+        # by reserving max_len rows for everyone up front.
+        while self._free and not self._draining:
+            nxt = self.queue.peek()
+            if nxt is None:
+                break
+            can = getattr(self.backend, "can_admit", None)
+            if can is not None and not can(
+                    len(nxt.prompt), nxt.max_new_tokens, nxt.prompt):
+                pool = getattr(self.backend, "pool", None)
+                detail = ({"blocks_free": pool.free_blocks,
+                           "blocks_evictable": pool.evictable_blocks}
+                          if pool is not None else {})
+                reg.counter("serve.kv.admission_blocked").inc()
+                self.events.event("serve", action="admission_blocked",
+                                  request=nxt.id, depth=self.queue.depth,
+                                  **detail)
+                break
             req = self.queue.pop()
             slot = self._free.pop()
             try:
@@ -633,7 +949,9 @@ class ServeEngine:
                     from ..resilience.chaos import ChaosError
                     raise ChaosError(
                         f"injected backend fault at tick {tick_idx}")
-                tok0 = self.backend.prefill(slot, req.prompt, req.seed)
+                tok0 = self.backend.prefill(
+                    slot, req.prompt, req.seed,
+                    **self._prefill_kwargs(req))
             except Exception as e:           # noqa: BLE001 — containment
                 self._free.append(slot)
                 finished.append(self._fail_queued(req, e, self.clock()))
@@ -690,6 +1008,9 @@ class ServeEngine:
         reg.gauge("serve.engine.queue_depth").set(self.queue.depth)
         reg.gauge("serve.engine.slot_occupancy").set(
             self.live_slots / self.backend.num_slots)
+        pool = getattr(self.backend, "pool", None)
+        if pool is not None:
+            pool.observe()
         dur = self.clock() - t_start
         reg.gauge("resilience.tick_sec").set(dur)
         if wd is not None and wd.record_tick(dur):
@@ -698,6 +1019,21 @@ class ServeEngine:
                               tick=tick_idx, duration_s=dur,
                               budget_s=wd.tick_budget_s)
         return finished
+
+    def _prefill_kwargs(self, req: Request) -> dict:
+        """Pass the request's token budget to backends whose prefill
+        reserves by demand (paged pools). Legacy/stub/wrapped backends
+        with a 3-arg prefill get the legacy call."""
+        import inspect
+        try:
+            params = inspect.signature(self.backend.prefill).parameters
+        except (TypeError, ValueError):
+            return {}
+        if "max_new_tokens" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()):
+            return {"max_new_tokens": req.max_new_tokens}
+        return {}
 
     def _apply_chaos(self, reg, tick_idx: int) -> None:
         """Serve-side fault injection (chaos plan only; no-op in real

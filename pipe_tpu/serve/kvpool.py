@@ -1,0 +1,485 @@
+"""Paged KV memory: fixed-size blocks + per-slot block tables.
+
+The monolithic slab backends reserve ``max_len`` cache rows per slot
+regardless of what the request actually needs, so memory — not compute —
+caps live slots (SERVE_r08's lesson). This module makes KV memory a
+first-class resource:
+
+* :class:`KvPool` — a HOST-side allocator over ``num_blocks`` physical
+  blocks of ``block_size`` rows each. Pure numpy/dict bookkeeping (free
+  list, refcounts, prefix cache, LRU), unit-testable without jax. A slot
+  reserves exactly ``ceil((prompt_len + max_new - 1) / block_size)``
+  blocks at admission — proportional to the request, not to ``max_len``.
+* **Shared-prefix cache with copy-on-write.** Full prompt blocks are
+  content-addressed by a rolling hash of the token prefix; N requests
+  sharing a system prompt pin ONE physical copy (refcounted). A write
+  into a shared block (the prefill recompute tail) forks it first: the
+  pool hands the backend ``(src, dst)`` copy pairs, the slot's table
+  points at the private copy, and the cached original is untouched.
+* **Device helpers** (:func:`storage_for`, :func:`gather_block_cache`,
+  :func:`scatter_block_rows`, :func:`flat_row_index`, :func:`copy_block`)
+  — the gather/scatter indexing the backends fuse into their compiled
+  decode/prefill-chunk programs. The layer math (``m.block.decode``)
+  runs unchanged on a gathered contiguous view, so paged decode stays
+  bitwise-equal to the slab path.
+
+The sacrificial block
+---------------------
+Physical block 0 is never allocated. Table rows are ``table_width``
+int32 entries whose unreserved tail stays 0, and the flat row index
+clamps the block index at ``table_width - 1`` — so every overshoot
+write (decode past retirement inside a chunk, prefill padding past the
+prompt, a released slot still riding the fixed-shape decode program,
+the ring's inactive-stage cycles) lands harmlessly in block 0. This is
+the slab backends' sacrificial-region trick, relocated into the
+indexing: :meth:`KvPool.release` additionally zeroes the slot's table
+row on the host, so a dead slot can NEVER corrupt a block that has been
+reallocated to someone else.
+
+int8 KV blocks compose with ``inference/quant.py``: storage carries
+int8 codes plus one f32 scale per row per head, quantized on scatter
+and dequantized inside the gather (fused into the attention read).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs.telemetry import get_registry
+
+__all__ = ["KvPool", "PoolExhausted", "Admission", "block_demand",
+           "storage_for", "gather_block_cache", "scatter_block_rows",
+           "flat_row_index", "copy_block"]
+
+SACRIFICIAL = 0
+
+
+class PoolExhausted(RuntimeError):
+    """Raised by :meth:`KvPool.admit` when the pool cannot cover a
+    request's block demand — the paged analog of
+    :class:`~.queue.QueueFull`, carrying the same style of detail so
+    admission control can park instead of thrash."""
+
+    def __init__(self, message: str, *, demand: int = 0, free: int = 0,
+                 evictable: int = 0, total: int = 0):
+        super().__init__(message)
+        self.demand = demand
+        self.free = free
+        self.evictable = evictable
+        self.total = total
+
+
+def block_demand(prompt_len: int, max_new_tokens: int,
+                 block_size: int) -> int:
+    """Blocks a request must reserve. The last sampled token's KV row is
+    never written (retirement happens first), hence ``- 1``; decode
+    overshoot past that lands in the sacrificial block."""
+    rows = prompt_len + max_new_tokens - 1
+    return -(-rows // block_size)
+
+
+@dataclasses.dataclass
+class Admission:
+    """What :meth:`KvPool.admit` hands the backend: the slot's table
+    row, where prefill may resume (``resume_from`` — everything before
+    it is covered by shared cached blocks), and the COW copies to run
+    before any chunk writes."""
+
+    slot: int
+    table: np.ndarray                    # [table_width] int32
+    resume_from: int
+    shared_len: int
+    prefix_hits: int
+    cow_forks: List[Tuple[int, int]]     # (src, dst) physical ids
+    blocks: List[int]
+    rows_needed: int
+
+
+class _Cached:
+    __slots__ = ("block", "refs")
+
+    def __init__(self, block: int):
+        self.block = block
+        self.refs = 0
+
+
+class _SlotMeta:
+    __slots__ = ("blocks", "rows_needed", "registered")
+
+    def __init__(self, blocks, rows_needed, registered):
+        self.blocks = blocks          # [(block_id, hash-or-None)]
+        self.rows_needed = rows_needed
+        self.registered = registered  # hashes first published by this slot
+
+
+class KvPool:
+    """Host-side paged-KV allocator. Single-threaded (the engine tick
+    discipline); never touches jax.
+
+    ``num_blocks`` counts physical blocks INCLUDING the sacrificial
+    block 0, so ``num_blocks - 1`` are allocatable. ``gather_slack_rows``
+    widens the table (with sacrificial entries) past ``max_len`` so a
+    fixed-shape prefill chunk starting at ``prompt_len - 1`` can always
+    slice ``chunk`` rows out of the gathered view without clamping.
+    """
+
+    def __init__(self, *, num_blocks: int, block_size: int, num_slots: int,
+                 max_len: int, prefix_cache: bool = True,
+                 gather_slack_rows: int = 0):
+        if block_size < 1 or (block_size & (block_size - 1)) != 0:
+            raise ValueError(
+                f"block_size must be a positive power of two, got "
+                f"{block_size}")
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is sacrificial), got "
+                f"{num_blocks}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.prefix_cache = prefix_cache
+        self.max_blocks = -(-max_len // block_size)
+        ext = -(-(max_len + gather_slack_rows) // block_size)
+        self.table_width = ext + 1
+        self.table = np.zeros((num_slots, self.table_width), np.int32)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._cached: Dict[str, _Cached] = {}
+        self._lru: "OrderedDict[str, int]" = OrderedDict()
+        self._slot_meta: List[Optional[_SlotMeta]] = [None] * num_slots
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def allocatable(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def evictable_blocks(self) -> int:
+        return len(self._lru)
+
+    def demand_for(self, prompt_len: int, max_new_tokens: int) -> int:
+        return block_demand(prompt_len, max_new_tokens, self.block_size)
+
+    # -- prefix hashing ----------------------------------------------------
+
+    def prefix_hashes(self, prompt: Sequence[int]) -> List[str]:
+        """Rolling content hash per FULL prompt block (the partial tail
+        block is always private, never cached)."""
+        bs = self.block_size
+        out: List[str] = []
+        h = hashlib.sha256()
+        for i in range(len(prompt) // bs):
+            h.update(np.asarray(prompt[i * bs:(i + 1) * bs],
+                                np.int64).tobytes())
+            out.append(h.hexdigest())
+        return out
+
+    def _lookup(self, hashes: List[str]) -> int:
+        hit = 0
+        while hit < len(hashes) and hashes[hit] in self._cached:
+            hit += 1
+        return hit
+
+    def cached_prefix_blocks(self, prompt: Sequence[int]) -> int:
+        """Leading full blocks of ``prompt`` already in the cache — the
+        router's warm-handoff probe."""
+        if not self.prefix_cache:
+            return 0
+        return self._lookup(self.prefix_hashes(prompt))
+
+    def invalidate(self, hashes: Sequence[str]) -> int:
+        """Drop cached entries (router KV handoff: a session remapped
+        off a sick home replica must not find a stale prefix here).
+        Ref-held blocks merely become unshareable — they free to the
+        free list when their last holder releases."""
+        n = 0
+        for h in hashes:
+            ent = self._cached.pop(h, None)
+            if ent is None:
+                continue
+            n += 1
+            if ent.refs <= 0:
+                self._lru.pop(h, None)
+                self._free.append(ent.block)
+        return n
+
+    # -- allocation --------------------------------------------------------
+
+    def _alloc(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._lru:
+            h, bid = self._lru.popitem(last=False)   # oldest first
+            del self._cached[h]
+            get_registry().counter("serve.kv.evictions").inc()
+            return bid
+        raise PoolExhausted(
+            "kv pool exhausted mid-admission (allocator bug: demand was "
+            "pre-checked)", demand=1, free=0, evictable=0,
+            total=self.allocatable)
+
+    def _plan(self, prompt_len: int, max_new_tokens: int,
+              hashes: Optional[List[str]], chunk: int):
+        """(demand, hit, reuse, t0): how many blocks, how many cache
+        hits, how many hits survive as read-only shares (vs forked), and
+        where prefill resumes. ``t0`` must still compute position
+        ``prompt_len - 1`` (the first sampled token needs ``h`` there),
+        so a fully-cached prompt resumes at the last chunk boundary and
+        forks the shared blocks its recompute tail rewrites."""
+        bs = self.block_size
+        demand = block_demand(prompt_len, max_new_tokens, bs)
+        hit = self._lookup(hashes) if hashes is not None else 0
+        shared_len = hit * bs
+        t0 = min(shared_len, ((prompt_len - 1) // chunk) * chunk)
+        reuse = min(hit, t0 // bs)
+        return demand, hit, reuse, t0
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int,
+                  prompt: Optional[Sequence[int]] = None, *,
+                  chunk: int = 1) -> bool:
+        """Admission probe: can the pool cover this request right now
+        (free + evictable, minus shared-prefix hits)? Read-only."""
+        hashes = (self.prefix_hashes(prompt)
+                  if prompt is not None and self.prefix_cache else None)
+        demand, hit, reuse, _ = self._plan(
+            prompt_len, max_new_tokens, hashes, chunk)
+        if demand > self.max_blocks:
+            return False
+        need = (hit - reuse) + (demand - hit)
+        return need <= len(self._free) + len(self._lru)
+
+    def admit(self, slot: int, prompt: Sequence[int],
+              max_new_tokens: int, *, chunk: int = 1) -> Admission:
+        """Reserve the slot's FULL block demand (no mid-decode OOM),
+        reusing cached prefix blocks read-only and forking the ones the
+        prefill recompute tail will write. Raises :class:`PoolExhausted`
+        without mutating anything when the pool can't cover it."""
+        if self._slot_meta[slot] is not None:
+            raise RuntimeError(
+                f"slot {slot} admitted twice without release (engine "
+                f"bookkeeping bug)")
+        plen = len(prompt)
+        bs = self.block_size
+        hashes = self.prefix_hashes(prompt) if self.prefix_cache else None
+        demand, hit, reuse, t0 = self._plan(
+            plen, max_new_tokens, hashes, chunk)
+        rows = plen + max_new_tokens - 1
+        need = (hit - reuse) + (demand - hit)
+        avail = len(self._free) + len(self._lru)
+        if demand > self.max_blocks or need > avail:
+            raise PoolExhausted(
+                f"request needs {need} blocks ({demand} total, "
+                f"{hit} prefix hits, {reuse} reusable) but the pool has "
+                f"{len(self._free)} free + {len(self._lru)} evictable of "
+                f"{self.allocatable}",
+                demand=need, free=len(self._free),
+                evictable=len(self._lru), total=self.allocatable)
+        reg = get_registry()
+        full = plen // bs
+        blocks: List[int] = []
+        meta_blocks: List[Tuple[int, Optional[str]]] = []
+        forks: List[Tuple[int, int]] = []
+        registered = set()
+        for i in range(reuse):                       # read-only shares
+            h = hashes[i]
+            ent = self._cached[h]
+            if ent.refs == 0:
+                self._lru.pop(h, None)
+            ent.refs += 1
+            blocks.append(ent.block)
+            meta_blocks.append((ent.block, h))
+        for i in range(reuse, hit):                  # copy-on-write forks
+            src = self._cached[hashes[i]].block
+            dst = self._alloc()
+            forks.append((src, dst))
+            blocks.append(dst)
+            meta_blocks.append((dst, None))
+        for i in range(hit, demand):                 # fresh blocks
+            bid = self._alloc()
+            h = None
+            if hashes is not None and i < full:
+                # a full prompt block this prefill writes end-to-end:
+                # publish it (the write completes before any other
+                # admission can hit the entry — single-threaded tick)
+                h = hashes[i]
+                ent = _Cached(bid)
+                ent.refs = 1
+                self._cached[h] = ent
+                registered.add(h)
+            blocks.append(bid)
+            meta_blocks.append((bid, h))
+        row = np.zeros(self.table_width, np.int32)
+        row[:demand] = blocks
+        self.table[slot, :] = row
+        self._slot_meta[slot] = _SlotMeta(meta_blocks, rows, registered)
+        if hit:
+            reg.counter("serve.kv.prefix_hits").inc(hit)
+        if hashes is not None and full > hit:
+            reg.counter("serve.kv.prefix_misses").inc(full - hit)
+        if forks:
+            reg.counter("serve.kv.cow_forks").inc(len(forks))
+        return Admission(slot=slot, table=row, resume_from=t0,
+                         shared_len=hit * bs, prefix_hits=hit,
+                         cow_forks=forks, blocks=blocks, rows_needed=rows)
+
+    def release(self, slot: int, *, failed: bool = False) -> None:
+        """Retire a slot: zero its table row (the dead slot decodes into
+        the sacrificial block from now on), free private blocks, decref
+        shared ones — refcount-0 cached blocks become LRU-evictable, not
+        free (a future prompt may hit them). ``failed=True`` (prefill
+        raised mid-write) unpublishes the hashes this admission
+        registered: their content is garbage."""
+        meta = self._slot_meta[slot]
+        self.table[slot, :] = SACRIFICIAL
+        if meta is None:
+            return
+        self._slot_meta[slot] = None
+        for bid, h in meta.blocks:
+            ent = self._cached.get(h) if h is not None else None
+            if ent is not None and ent.block == bid:
+                ent.refs -= 1
+                if ent.refs <= 0:
+                    if failed and h in meta.registered:
+                        del self._cached[h]
+                        self._free.append(bid)
+                    else:
+                        self._lru[h] = bid
+                        self._lru.move_to_end(h)
+            else:
+                self._free.append(bid)
+
+    # -- metrics -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        total = self.allocatable
+        live = [m for m in self._slot_meta if m is not None]
+        reserved = sum(len(m.blocks) for m in live)
+        needed = sum(m.rows_needed for m in live)
+        in_use = total - len(self._free) - len(self._lru)
+        return {
+            "blocks_total": total,
+            "blocks_free": len(self._free),
+            "blocks_evictable": len(self._lru),
+            "blocks_in_use": in_use,
+            "occupancy": in_use / total if total else 0.0,
+            # internal fragmentation: reserved rows the live requests can
+            # never write (tail of each slot's last block)
+            "fragmentation": (1.0 - needed / (reserved * self.block_size)
+                              if reserved else 0.0),
+            "cached_blocks": len(self._cached),
+            "shared_blocks": sum(
+                1 for e in self._cached.values() if e.refs > 1),
+        }
+
+    def observe(self) -> None:
+        reg = get_registry()
+        for k, v in self.stats().items():
+            reg.gauge(f"serve.kv.{k}").set(float(v))
+
+
+# -- device-side indexing (compiled into the backends' programs) -----------
+
+def storage_for(proto, n_layers: int, num_blocks: int, block_size: int, *,
+                kv_dtype: Optional[str] = None):
+    """Pool device arrays ``[n_layers, num_blocks, block_size, ...]``
+    from one layer's attention-cache prototype (``make_cache(1, L)``).
+    ``kv_dtype="int8"`` stores int8 codes + one f32 scale per row per
+    head (``inference/quant.py`` discipline, applied to KV rows)."""
+    if not (isinstance(proto, dict) and set(proto) == {"k", "v"}):
+        raise TypeError(
+            "paged KV needs a {'k','v'} attention cache prototype, got "
+            f"{type(proto).__name__} with "
+            f"{sorted(proto) if isinstance(proto, dict) else '?'}")
+    out = {}
+    for name, a in proto.items():
+        shape = (n_layers, num_blocks, block_size) + tuple(a.shape[2:])
+        if kv_dtype is None:
+            out[name] = jnp.zeros(shape, a.dtype)
+        elif kv_dtype == "int8":
+            out[name] = jnp.zeros(shape, jnp.int8)
+            out[name + "_scale"] = jnp.zeros(shape[:-1] + (1,), jnp.float32)
+        else:
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+    return out
+
+
+def flat_row_index(table_row, positions, block_size: int):
+    """Physical flat row index for each position: block-table gather
+    with the block index CLAMPED to the trailing sacrificial entry, so
+    any position past the reserved region maps into block 0."""
+    mb = table_row.shape[-1] - 1
+    bi = jnp.minimum(positions // block_size, mb)
+    return jnp.take(table_row, bi) * block_size + positions % block_size
+
+
+def gather_block_cache(pool_layer, table_row, *, block_size: int,
+                       compute_dtype):
+    """One slot's rows as a contiguous ``{'k','v'} [1, R, ...]`` view
+    (R = ``(table_width - 1) * block_size``). The layer's ``decode``
+    runs on this view unchanged — garbage rows from sacrificial/unwritten
+    blocks sit at positions the causal mask kills exactly (``-1e30``
+    underflows to 0.0 in the softmax), the same bitwise argument the
+    slab backends already rely on. int8 pools dequantize here, fused
+    into the attention read."""
+    mb = table_row.shape[-1] - 1
+
+    def g(name):
+        rows = jnp.take(pool_layer[name], table_row[:mb], axis=0)
+        return rows.reshape((mb * block_size,) + rows.shape[2:])
+
+    if "k_scale" in pool_layer:
+        return {name: (g(name).astype(jnp.float32) *
+                       g(name + "_scale")).astype(compute_dtype)[None]
+                for name in ("k", "v")}
+    return {name: g(name)[None] for name in ("k", "v")}
+
+
+def scatter_block_rows(pool_layer, flat_idx, rows):
+    """Write new KV rows ``{'k': [M, ...], 'v': [M, ...]}`` at physical
+    flat indices ``[M]`` (duplicate sacrificial indices may collide —
+    block 0 content is never read un-masked, so any winner is fine).
+    int8 pools quantize per row per head on the way in."""
+    from ..inference.quant import quantize_kv_rows
+    out = dict(pool_layer)
+    int8 = "k_scale" in pool_layer
+
+    def flat(a):
+        return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
+
+    for name in ("k", "v"):
+        a = pool_layer[name]
+        if int8:
+            q, s = quantize_kv_rows(rows[name])
+            out[name] = flat(a).at[flat_idx].set(q).reshape(a.shape)
+            sa = pool_layer[name + "_scale"]
+            out[name + "_scale"] = flat(sa).at[flat_idx].set(s).reshape(
+                sa.shape)
+        else:
+            out[name] = flat(a).at[flat_idx].set(
+                rows[name].astype(a.dtype)).reshape(a.shape)
+    return out
+
+
+def copy_block(pool, src, dst, *, block_axis: int = 1):
+    """COW fork: copy physical block ``src`` → ``dst`` across every
+    array of the pool (all layers at once — a block is ``block_size``
+    rows of EVERY layer under one table entry)."""
+    def cp(a):
+        blk = jax.lax.dynamic_slice_in_dim(a, src, 1, axis=block_axis)
+        return jax.lax.dynamic_update_slice_in_dim(a, blk, dst,
+                                                   axis=block_axis)
+
+    return jax.tree_util.tree_map(cp, pool)

@@ -237,6 +237,20 @@ class RequestQueue:
                          if i not in drop]
         return shed
 
+    def peek(self) -> Optional[Request]:
+        """The request ``pop`` would return, without removing it — the
+        engine's block-availability admission gate looks before it
+        leaps (head-of-line parking keeps FIFO/priority order honest;
+        popping then re-queueing would rotate the request to the
+        tail)."""
+        if not self._waiting:
+            return None
+        if self.policy == "fifo":
+            return self._waiting[0]
+        best = max(range(len(self._waiting)),
+                   key=lambda i: (self._waiting[i].priority, -i))
+        return self._waiting[best]
+
     def pop(self) -> Optional[Request]:
         """Next request to admit (None when empty). Priority policy pops
         the highest ``priority``, FIFO within a priority level. Call

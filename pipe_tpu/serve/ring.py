@@ -51,6 +51,8 @@ from ..obs.telemetry import get_registry
 from ..parallel.mesh import STAGE_AXIS
 from ..utils.compat import shard_map
 from .buckets import BucketSpec
+from .kvpool import (KvPool, copy_block, flat_row_index,
+                     gather_block_cache, scatter_block_rows)
 
 __all__ = ["RingSlotBackend"]
 
@@ -67,7 +69,11 @@ class RingSlotBackend:
                  post_params, *, max_len: int,
                  gen: GenerationConfig = GenerationConfig(),
                  buckets: Optional[BucketSpec] = None,
-                 revolutions: int = 1, shape_cache_warn: int = 8):
+                 revolutions: int = 1, shape_cache_warn: int = 8,
+                 kv_block_size: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None,
+                 prefill_chunk: int = 16,
+                 kv_dtype: Optional[str] = None):
         if STAGE_AXIS not in mesh.axis_names:
             raise ValueError(f"mesh must have a {STAGE_AXIS!r} axis")
         if not hasattr(model, "embed_at"):
@@ -100,21 +106,61 @@ class RingSlotBackend:
         n = self.n
         cd = model.cfg.compute_dtype
         nh, hd = model.block.attn.nhead, model.block.attn.head_dim
-        # sacrificial region: big enough to absorb a q=max_bucket prefill
-        # write from an inactive stage AND any post-retirement decode
-        # overshoot within a tick
-        max_bucket = buckets.max_len if buckets is not None else max_len
-        self._cache_len = max_len + max_bucket
-        self._sac = max_len
-
         stage_sh = NamedSharding(mesh, P(STAGE_AXIS))
-        self._caches = {
-            "k": jax.device_put(jnp.zeros(
-                (n * self._lps, n, 1, self._cache_len, nh, hd), cd),
-                stage_sh),
-            "v": jax.device_put(jnp.zeros(
-                (n * self._lps, n, 1, self._cache_len, nh, hd), cd),
-                stage_sh)}
+        self._stage_sh = stage_sh
+
+        kbs = kv_block_size if kv_block_size is not None \
+            else gen.kv_block_size
+        self.paged = kbs is not None
+        if self.paged:
+            # paged KV over the ring: every stage holds the pool rows for
+            # ITS layers ([lps, num_blocks, bs, ...] per shard). The block
+            # table is layer- and stage-agnostic — one table entry
+            # addresses the same physical block id in each shard — so the
+            # host-side KvPool needs no ring awareness at all.
+            if kv_dtype is not None:
+                raise NotImplementedError(
+                    "int8 KV blocks are single-device only for now; the "
+                    "ring pool stores the compute dtype")
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+            self.prefill_chunk = prefill_chunk
+            mb = -(-max_len // kbs)
+            nb = kv_pool_blocks if kv_pool_blocks is not None \
+                else n * mb + 1
+            self.pool = KvPool(
+                num_blocks=nb, block_size=kbs, num_slots=n,
+                max_len=max_len, prefix_cache=gen.prefix_cache,
+                gather_slack_rows=prefill_chunk)
+            self._caches = {
+                name: jax.device_put(jnp.zeros(
+                    (n * self._lps, nb, kbs, nh, hd), cd), stage_sh)
+                for name in ("k", "v")}
+            # positions >= the reserved region clamp into table entry 0 —
+            # the paged replacement for the slab's sacrificial region
+            self._sacpos = (self.pool.table_width - 1) * kbs
+            self._fork_jit = jax.jit(self._fork_fn, donate_argnums=(0,))
+        else:
+            if kv_dtype is not None:
+                raise ValueError(
+                    "kv_dtype needs the paged pool (set kv_block_size); "
+                    "the slab path stores KV in the compute dtype")
+            self.pool = None
+            # sacrificial region: big enough to absorb a q=max_bucket
+            # prefill write from an inactive stage AND any
+            # post-retirement decode overshoot within a tick
+            max_bucket = buckets.max_len if buckets is not None \
+                else max_len
+            self._cache_len = max_len + max_bucket
+            self._sac = max_len
+            self._caches = {
+                "k": jax.device_put(jnp.zeros(
+                    (n * self._lps, n, 1, self._cache_len, nh, hd), cd),
+                    stage_sh),
+                "v": jax.device_put(jnp.zeros(
+                    (n * self._lps, n, 1, self._cache_len, nh, hd), cd),
+                    stage_sh)}
         self._h = jax.device_put(
             jnp.zeros((n, 1, model.cfg.d_model), cd), stage_sh)
         self._tok_ring = jax.device_put(jnp.zeros((n,), jnp.int32),
@@ -137,7 +183,16 @@ class RingSlotBackend:
 
     def validate(self, prompt_len: int, max_new_tokens: int) -> None:
         bucket = (self.buckets.bucket_for(prompt_len)
-                  if self.buckets is not None else prompt_len)
+                  if self.buckets is not None and not self.paged
+                  else prompt_len)
+        if self.paged and self.pool.demand_for(
+                prompt_len, max_new_tokens) > self.pool.allocatable:
+            raise ValueError(
+                f"request needs "
+                f"{self.pool.demand_for(prompt_len, max_new_tokens)} KV "
+                f"blocks but the whole pool holds "
+                f"{self.pool.allocatable}; raise kv_pool_blocks or "
+                f"shorten the request")
         if prompt_len + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt_len {prompt_len} + max_new_tokens "
@@ -205,6 +260,37 @@ class RingSlotBackend:
         h, new_slab = jax.lax.scan(layer_step, h, (block_stack, slab))
         caches = jax.tree_util.tree_map(slab_write, caches, new_slab)
         return h, caches
+
+    def _run_blocks_paged(self, block_stack, h, caches, trow, pos):
+        """The paged analog of :meth:`_run_blocks`: this stage's layers
+        on ``h`` against the gathered block view of the slot whose table
+        row is ``trow``. The ``q = h.shape[1]`` new rows at ``pos`` are
+        scattered back through the table; positions past the reserved
+        region (inactive stages, dead groups) clamp into the sacrificial
+        block. The layer decode itself is unchanged — the slab/paged
+        bitwise-parity argument from ``serve/kvpool.py`` applies per
+        stage."""
+        m = self.model
+        cd = m.cfg.compute_dtype
+        bs = self.pool.block_size
+        q = h.shape[1]
+        ridx = flat_row_index(
+            trow, pos + jnp.arange(q, dtype=jnp.int32), bs)
+
+        def layer_step(h_c, inp):
+            bp, pool_l = inp
+            cache = gather_block_cache(pool_l, trow, block_size=bs,
+                                       compute_dtype=cd)
+            h_new, c2 = m.block.decode(dequant_tree(bp, cd), h_c, cache,
+                                       pos)
+            rows = {name: jax.lax.dynamic_slice(
+                        c2[name], (0, pos) + (0,) * (c2[name].ndim - 2),
+                        (1, q) + c2[name].shape[2:])[0]
+                    for name in ("k", "v")}
+            return h_new, scatter_block_rows(pool_l, ridx, rows)
+
+        h, new_caches = jax.lax.scan(layer_step, h, (block_stack, caches))
+        return h, new_caches
 
     # -- device programs ---------------------------------------------------
 
@@ -307,6 +393,115 @@ class RingSlotBackend:
             jnp.where(s == n - 1, emitted, 0), STAGE_AXIS)
         return caches, h_carry, tok_ring, pos_row[None], emitted
 
+    # -- paged device programs ---------------------------------------------
+
+    def _prefill_chunk_fn(self, stage_params, pre, post, caches, tokens,
+                          t0, true_len, trow, key):
+        """THE ring prefill program: one fixed-shape ``[1, C]`` chunk at
+        a traced offset, walked around the ring once (cycle ``i`` stage
+        ``i`` active, exactly :meth:`_prefill_fn`'s serial pass), looped
+        on the host until the prompt is covered — ANY prompt length, one
+        compile, where the slab path keys a program per bucket. Inactive
+        stages write their C rows at the sacrificial position; stage
+        ``n - 1`` samples the chunk's candidate first token (the host
+        keeps the last chunk's — only there does ``true_len - 1`` fall
+        inside the chunk). The in-flight decode carry is untouched."""
+        m, gen, n = self.model, self.gen, self.n
+        cd = m.cfg.compute_dtype
+        s = jax.lax.axis_index(STAGE_AXIS)
+        get_registry().counter("serve.ring.prefill_chunk_traces").inc()
+        block_stack = self._local_blocks(stage_params)
+
+        def cycle(carry, i):
+            h_carry, caches, tok0 = carry
+            active = (s == i)
+            pos_w = jnp.where(active, t0, self._sacpos)
+            h_embed = m.embed_at(pre, tokens, t0)        # [1, C, d]
+            h_in = jnp.where(s == 0, h_embed, h_carry)
+            h_out, caches = self._run_blocks_paged(
+                block_stack, h_in, caches, trow, pos_w)
+            idx = jnp.clip(true_len - 1 - t0, 0, tokens.shape[1] - 1)
+            h_last = jax.lax.dynamic_slice(
+                h_out, (0, idx, 0), (1, 1, h_out.shape[-1]))
+            logits = head_logits(m, post, h_last)[:, 0, :]
+            tok = sample_logits(logits, jax.random.fold_in(key, 0),
+                                gen)[0]
+            emit = active & (s == n - 1)
+            tok0 = jnp.where(emit, tok, tok0)
+            return (self._ring(h_out), caches, tok0), None
+
+        h0 = jnp.zeros((1, tokens.shape[1], m.cfg.d_model), cd)
+        (_, caches, tok0), _ = jax.lax.scan(
+            cycle, (h0, caches, jnp.int32(0)), jnp.arange(n))
+        tok0 = jax.lax.psum(jnp.where(s == n - 1, tok0, 0), STAGE_AXIS)
+        return caches, tok0
+
+    def _fork_fn(self, caches, src, dst):
+        """Copy-on-write block copy across every stage's layer shard
+        (src/dst traced — one program for every fork; the copy is
+        block-axis local, so it never crosses the stage sharding)."""
+        get_registry().counter("serve.kv.fork_traces").inc()
+        return copy_block(caches, src, dst, block_axis=1)
+
+    def _decode_paged_fn(self, stage_params, pre, post, caches, h_carry,
+                         tok_ring, pos_local, c0, admit, live,
+                         tok_inject, plen, key_data, tables):
+        """:meth:`_decode_fn` with the slab slice/write swapped for the
+        pool gather/scatter: stage ``s`` looks up group ``grp``'s table
+        row and runs the SAME wavefront recurrence. Invalid (stage,
+        cycle, group) work decodes at the sacrificial position, and
+        released groups additionally carry all-zero table rows — a dead
+        group can never touch a reallocated block. Traced once (the
+        counter pins it)."""
+        m, gen, n = self.model, self.gen, self.n
+        R = self.decode_chunk
+        s = jax.lax.axis_index(STAGE_AXIS)
+        get_registry().counter("serve.ring.decode_traces").inc()
+        block_stack = self._local_blocks(stage_params)
+
+        def cycle(carry, i):
+            h_carry, tok_ring, caches, pos_row, emitted = carry
+            c = c0 + i
+            grp = jnp.mod(c - s, n)
+            adm = jnp.take(admit, grp)
+            valid = (jnp.take(live, grp) != 0) & (c >= adm + s)
+            pos = jnp.take(pos_row, grp)
+            pos_use = jnp.where(valid, pos, self._sacpos)
+            inject = c == adm
+            tok_use = jnp.where(inject, jnp.take(tok_inject, grp),
+                                tok_ring[0])
+            h_embed = m.embed_at(pre, tok_use[None, None], pos_use)
+            h_in = jnp.where(s == 0, h_embed, h_carry)
+            trow = jax.lax.dynamic_index_in_dim(tables, grp, 0,
+                                                keepdims=False)
+            h_out, caches = self._run_blocks_paged(
+                block_stack, h_in, caches, trow, pos_use)
+            logits = head_logits(m, post, h_out)[:, 0, :]   # [1, V]
+            kd_g = jax.lax.dynamic_index_in_dim(key_data, grp, 0,
+                                                keepdims=False)
+            key_g = jax.random.wrap_key_data(kd_g)
+            t_gen = pos - jnp.take(plen, grp) + 1
+            tok_out = sample_logits(
+                logits, jax.random.fold_in(key_g, t_gen), gen)
+            emit = (s == n - 1) & valid
+            r = i // n
+            old = jax.lax.dynamic_slice(emitted, (grp, r), (1, 1))[0, 0]
+            emitted = jax.lax.dynamic_update_slice(
+                emitted, jnp.where(emit, tok_out[0], old)[None, None],
+                (grp, r))
+            pos_row = jax.lax.dynamic_update_slice(
+                pos_row, jnp.where(valid, pos + 1, pos)[None], (grp,))
+            return (self._ring(h_out), self._ring(tok_out), caches,
+                    pos_row, emitted), None
+
+        emitted0 = jnp.zeros((n, R), jnp.int32)
+        (h_carry, tok_ring, caches, pos_row, emitted), _ = jax.lax.scan(
+            cycle, (h_carry, tok_ring, caches, pos_local[0], emitted0),
+            jnp.arange(n * R))
+        emitted = jax.lax.psum(
+            jnp.where(s == n - 1, emitted, 0), STAGE_AXIS)
+        return caches, h_carry, tok_ring, pos_row[None], emitted
+
     # -- backend API -------------------------------------------------------
 
     def _build(self, kind, B=None):
@@ -321,6 +516,18 @@ class RingSlotBackend:
                         P(STAGE_AXIS), P(), P(), P(), P())
             out_specs = (cache_spec, P(STAGE_AXIS), P())
             fn = self._prefill_fn
+        elif kind == "chunk":
+            in_specs = (pspec, pre_spec, post_spec, cache_spec,
+                        P(), P(), P(), P(), P())
+            out_specs = (cache_spec, P())
+            fn = self._prefill_chunk_fn
+        elif kind == "decode_paged":
+            in_specs = (pspec, pre_spec, post_spec, cache_spec,
+                        P(STAGE_AXIS), P(STAGE_AXIS), P(STAGE_AXIS),
+                        P(), P(), P(), P(), P(), P(), P())
+            out_specs = (cache_spec, P(STAGE_AXIS), P(STAGE_AXIS),
+                         P(STAGE_AXIS), P())
+            fn = self._decode_paged_fn
         else:
             in_specs = (pspec, pre_spec, post_spec, cache_spec,
                         P(STAGE_AXIS), P(STAGE_AXIS), P(STAGE_AXIS),
@@ -331,8 +538,14 @@ class RingSlotBackend:
         return jax.jit(shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_vma=False))
 
-    def prefill(self, slot: int, prompt: Sequence[int], seed: int) -> int:
+    def prefill(self, slot: int, prompt: Sequence[int], seed: int,
+                max_new_tokens: Optional[int] = None) -> int:
         reg = get_registry()
+        if self.paged:
+            return self._prefill_paged(
+                slot, prompt, seed,
+                max_new_tokens if max_new_tokens is not None
+                else self.gen.max_new_tokens)
         if self.buckets is not None:
             padded, p = self.buckets.pad(prompt, self.gen.pad_token_id)
         else:
@@ -370,22 +583,74 @@ class RingSlotBackend:
             jax.random.key_data(jax.random.key(seed)))
         return tok0
 
+    def _prefill_paged(self, slot: int, prompt: Sequence[int], seed: int,
+                       max_new_tokens: int) -> int:
+        """Admit into the pool (reserving full demand), run the COW
+        forks, stream the prompt's recompute tail through the one chunk
+        program (one serial ring pass per chunk), then arm the host
+        admission tables exactly as the slab prefill does. A failure
+        mid-stream releases the reservation and unpublishes half-written
+        cache entries."""
+        plen = len(prompt)
+        adm = self.pool.admit(slot, prompt, max_new_tokens,
+                              chunk=self.prefill_chunk)
+        try:
+            for src, dst in adm.cow_forks:
+                self._caches = self._fork_jit(
+                    self._caches, jnp.int32(src), jnp.int32(dst))
+            run = self._programs.get("chunk")
+            if run is None:
+                run = self._build("chunk")
+                self._programs["chunk"] = run
+            trow = jnp.asarray(adm.table)
+            C = self.prefill_chunk
+            pad = self.gen.pad_token_id
+            key = jax.random.key(seed)
+            t = adm.resume_from
+            tok0 = 0
+            while t < plen:
+                toks = list(prompt[t:t + C])
+                toks += [pad] * (C - len(toks))
+                arr = jnp.asarray(toks, jnp.int32)[None, :]
+                self._caches, tok0 = run(
+                    self._stage_params, self._pre, self._post,
+                    self._caches, arr, jnp.int32(t), jnp.int32(plen),
+                    trow, key)
+                t += C
+            tok0 = int(tok0)
+        except Exception:
+            self.pool.release(slot, failed=True)
+            raise
+        self._admit[slot] = self._c0 + slot
+        self._tok_inject[slot] = tok0
+        self._plen[slot] = plen
+        self._key_data[slot] = np.asarray(
+            jax.random.key_data(jax.random.key(seed)))
+        pl = np.array(self._pos_local)
+        pl[:, slot] = plen
+        self._pos_local = jax.device_put(jnp.asarray(pl), self._stage_sh)
+        return tok0
+
     def decode(self, live: np.ndarray):
         """One tick = ``revolutions`` tokens per live slot. Returns
         ``(tokens [S, R], valid [S, R])``; validity accounts for
         admission wavefronts still filling the ring."""
         n, R = self.n, self.decode_chunk
         live = np.asarray(live).astype(np.int32)
-        run = self._programs.get("decode")
+        kind = "decode_paged" if self.paged else "decode"
+        run = self._programs.get(kind)
         if run is None:
-            run = self._build("decode")
-            self._programs["decode"] = run
-        caches, h, tok_ring, pos_local, emitted = run(
+            run = self._build(kind)
+            self._programs[kind] = run
+        args = (
             self._stage_params, self._pre, self._post, self._caches,
             self._h, self._tok_ring, self._pos_local,
             jnp.int32(self._c0), jnp.asarray(self._admit),
             jnp.asarray(live), jnp.asarray(self._tok_inject),
             jnp.asarray(self._plen), jnp.asarray(self._key_data))
+        if self.paged:
+            args = args + (jnp.asarray(self.pool.table),)
+        caches, h, tok_ring, pos_local, emitted = run(*args)
         self._caches, self._h = caches, h
         self._tok_ring, self._pos_local = tok_ring, pos_local
         toks = np.asarray(emitted)                       # [n, R]
@@ -402,8 +667,26 @@ class RingSlotBackend:
                 self._admit - shift, -np.int32(_REBASE)).astype(np.int32)
         return toks, valid
 
+    def can_admit(self, prompt_len: int, max_new_tokens: int,
+                  prompt: Optional[Sequence[int]] = None) -> bool:
+        """Block-availability admission gate (always True for the slab —
+        its reservation is the slot itself)."""
+        if not self.paged:
+            return True
+        return self.pool.can_admit(prompt_len, max_new_tokens, prompt,
+                                   chunk=self.prefill_chunk)
+
+    def release(self, slot: int) -> None:
+        """Engine retirement hook: return the group's blocks to the pool
+        (no-op for the slab — the next prefill rewrites the rows)."""
+        if self.paged:
+            self.pool.release(slot)
+
     def program_stats(self) -> dict:
+        if self.paged:
+            return {"prefill_programs": 1,
+                    "decode_chunk": self.decode_chunk, "kv": "paged"}
         return {"prefill_programs": sum(
                     1 for k in self._programs
                     if isinstance(k, tuple) and k[0] == "prefill"),
-                "decode_chunk": self.decode_chunk}
+                "decode_chunk": self.decode_chunk, "kv": "slab"}

@@ -66,6 +66,7 @@ tick loop; replica chaos (``wedge_replica``/``kill_replica``/
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -290,13 +291,18 @@ class Router:
                 time.sleep(f.magnitude)
             return orig_decode(live)
 
-        def chaotic_prefill(slot, prompt, seed):
+        # wraps() keeps the wrapped prefill's signature visible so the
+        # engine's demand-kwarg probe (_prefill_kwargs) sees the real
+        # backend: paged pools still get max_new_tokens, 3-arg
+        # stub/legacy backends still get the legacy call.
+        @functools.wraps(orig_prefill)
+        def chaotic_prefill(slot, prompt, seed, **kw):
             kind = _dead()
             if kind is not None:
                 raise ChaosError(
                     f"injected {kind} on replica {idx} at router tick "
                     f"{router._tick_index}")
-            return orig_prefill(slot, prompt, seed)
+            return orig_prefill(slot, prompt, seed, **kw)
 
         backend.decode = chaotic_decode
         backend.prefill = chaotic_prefill
@@ -477,14 +483,49 @@ class Router:
                         return rep
         return min(candidates, key=lambda r: (r.load, r.index))
 
+    def _kv_handoff(self, req: Request, sess: str, old_idx: int,
+                    new_rep: Replica) -> None:
+        """Session-remap KV bookkeeping (paged pools only — ``pool`` is
+        absent on slab backends and the whole hook is a no-op). The
+        prefix blocks the session populated on its old home are
+        invalidated there: the conversation's KV continues on the new
+        home, so a later remap BACK must re-prefill rather than extend a
+        stale prefix. The new home is probed for warm prefix blocks so
+        the handoff cost (cold re-prefill vs shared-prefix hit) is
+        observable per remap."""
+        reg = get_registry()
+        reg.counter("serve.fleet.kv_handoff_total").inc()
+        old_pool = getattr(
+            self.replicas[old_idx].engine.backend, "pool", None)
+        invalidated = 0
+        if old_pool is not None:
+            invalidated = old_pool.invalidate(
+                old_pool.prefix_hashes(req.prompt))
+            if invalidated:
+                reg.counter(
+                    "serve.fleet.kv_handoff_invalidated").inc(invalidated)
+        new_pool = getattr(new_rep.engine.backend, "pool", None)
+        warm = (new_pool.cached_prefix_blocks(req.prompt)
+                if new_pool is not None else 0)
+        reg.counter("serve.fleet.kv_handoff_warm" if warm
+                    else "serve.fleet.kv_handoff_cold").inc()
+        self.events.event("resilience", action="kv_handoff",
+                          request=req.id, session=sess,
+                          from_replica=old_idx, to_replica=new_rep.index,
+                          invalidated=invalidated, warm_blocks=warm)
+
     def _try_place(self, req: Request, now: float) -> bool:
         candidates = self._placeable()
         if not candidates:
             return False
         rep = self._choose(req, candidates)
+        sess = self._session_of.get(req.id)
+        if sess is not None:
+            home = self._session_map.get(sess)
+            if home is not None and home != rep.index:
+                self._kv_handoff(req, sess, home, rep)
         rep.engine.place(req)               # increments req.attempts
         self._placed_on[req.id] = rep.index
-        sess = self._session_of.get(req.id)
         if sess is not None and rep.state == HEALTHY:
             self._session_map[sess] = rep.index
         return True

@@ -57,6 +57,25 @@ MAX_NEW = 64
 # ~0.6x the fixed-batch baseline at 2x the needed rows vs ~1.3x when
 # sized to fit).
 MAX_LEN = BUCKETS.max_len + MAX_NEW
+# KV A/B workload: a long shared system prompt (112 tokens = 14 full
+# blocks at block=8) with short per-request tails — the shape
+# prefix caching exists for. Demand per request is 19 blocks but only 5
+# are private once the prefix is cached, so a pool holding the slab's
+# row budget for S slots carries 2S live requests; and the paged
+# prefill recomputes ONE 16-token chunk where the slab runs the full
+# 128-wide bucket program.
+KV_BLOCK = 8
+SHARED_LEN = 112
+AB_TAILS = (4, 8)
+AB_MAX_NEW = 32
+AB_BUCKETS = BucketSpec.of(128)
+AB_MAX_LEN = SHARED_LEN + max(AB_TAILS) + AB_MAX_NEW    # 152
+
+
+def _backend_kv_kwargs(kv, pool_blocks=None):
+    if kv == "slab":
+        return {}
+    return {"kv_block_size": KV_BLOCK, "kv_pool_blocks": pool_blocks}
 
 
 def log(msg):
@@ -94,7 +113,7 @@ def baseline_tokens_per_sec(model, params, slots, rng):
 
 
 def steady_state_tokens_per_sec(model, params, slots, chunk, rng,
-                                ticks=20):
+                                ticks=20, kv="slab"):
     """Saturated continuous batching: a deep queue keeps every slot
     full across retirements (requests finish, replacements prefill in
     the same tick). Token count from the engine's own emitted-token
@@ -103,7 +122,7 @@ def steady_state_tokens_per_sec(model, params, slots, chunk, rng,
     gen_cfg = GenerationConfig(max_new_tokens=MAX_NEW, temperature=0.0)
     backend = SingleDeviceSlotBackend(
         model, params, num_slots=slots, max_len=MAX_LEN, gen=gen_cfg,
-        buckets=BUCKETS, decode_chunk=chunk)
+        buckets=BUCKETS, decode_chunk=chunk, **_backend_kv_kwargs(kv))
     n_requests = slots * (2 + chunk * ticks // MAX_NEW)
     eng = ServeEngine(backend, RequestQueue(capacity=n_requests + slots))
     for p in make_prompts(n_requests, rng):
@@ -121,11 +140,82 @@ def steady_state_tokens_per_sec(model, params, slots, chunk, rng,
     return (counter.value - n0) / dt
 
 
+def make_shared_prefix_prompts(n, rng, shared):
+    tails = rng.choice(AB_TAILS, size=n)
+    return [shared + rng.randint(1, CFG.vocab, size=int(t)).tolist()
+            for t in tails]
+
+
+def kv_ab_steady_state(model, params, slots, chunk, seed, *, ticks=8,
+                       reps=3):
+    """Steady-state decode tokens/s on the shared-prefix workload at a
+    fixed row budget (``slots * MAX_LEN`` — the slab's footprint at S
+    slots): slab at S slots, paged at S slots, paged at 2S slots on the
+    SAME memory. The paged pool resumes prefill past the cached prefix
+    (one chunk instead of a full bucket) and reserves actual block
+    demand instead of max_len rows per slot, so the row budget that
+    gives the slab S slots carries 2S live requests. All three engines
+    are warmed through their first retirement wave, then measurement
+    windows are INTERLEAVED config-by-config with best-of-reps per
+    config — scheduler noise on this shared box is bursty over seconds,
+    so back-to-back windows of one config would eat a burst whole."""
+    from pipe_tpu.obs.telemetry import get_registry
+    reg = get_registry()
+    counter = reg.counter("serve.engine.tokens")
+    pool_blocks = slots * (-(-AB_MAX_LEN // KV_BLOCK)) + 1
+    warm = 3 + AB_MAX_NEW // chunk
+    cfgs = [("slab", "slab", slots, None),
+            ("paged_equal_slots", "paged", slots, pool_blocks),
+            ("paged_2x_slots_same_memory", "paged", 2 * slots,
+             pool_blocks)]
+    hits0 = reg.counter("serve.kv.prefix_hits").value
+    miss0 = reg.counter("serve.kv.prefix_misses").value
+    engines = {}
+    for name, kv, s, pb in cfgs:
+        rng = np.random.RandomState(seed)
+        gen_cfg = GenerationConfig(max_new_tokens=AB_MAX_NEW,
+                                   temperature=0.0)
+        backend = SingleDeviceSlotBackend(
+            model, params, num_slots=s, max_len=AB_MAX_LEN, gen=gen_cfg,
+            buckets=AB_BUCKETS, decode_chunk=chunk,
+            **_backend_kv_kwargs(kv, pb))
+        n_req = s * (3 + chunk * (reps * ticks + warm) // AB_MAX_NEW)
+        eng = ServeEngine(backend, RequestQueue(capacity=n_req + s))
+        shared = rng.randint(1, CFG.vocab, size=SHARED_LEN).tolist()
+        for p in make_shared_prefix_prompts(n_req, rng, shared):
+            eng.submit(p)
+        for _ in range(warm):
+            eng.tick()
+        assert eng.live_slots == s, (name, eng.live_slots, s)
+        engines[name] = (eng, s)
+    best = {name: 0.0 for name, *_ in cfgs}
+    for _ in range(reps):
+        for name, kv, s, pb in cfgs:
+            eng, _ = engines[name]
+            n0 = counter.value
+            t0 = time.monotonic()
+            for _ in range(ticks):
+                eng.tick()
+            dt = time.monotonic() - t0
+            assert eng.live_slots == s  # the queue never ran dry
+            best[name] = max(best[name], (counter.value - n0) / dt)
+    hits = reg.counter("serve.kv.prefix_hits").value - hits0
+    miss = reg.counter("serve.kv.prefix_misses").value - miss0
+    out = {}
+    for name, kv, s, pb in cfgs:
+        out[name] = {"kv": kv, "live_slots": s,
+                     "tokens_s": round(best[name], 1)}
+        if kv == "paged":
+            out[name]["pool_blocks"] = pb
+    out["prefix_hit_rate"] = round(hits / max(hits + miss, 1), 4)
+    return out, pool_blocks
+
+
 def drive_poisson(eng, prompts, arrivals, *, max_new, deadline_s):
     """Feed the engine a precomputed arrival schedule against the wall
     clock; tick until drained. Returns (responses, elapsed, rejected)."""
     t0 = time.monotonic()
-    i, rejected, finished = 0, 0, []
+    i, rejected, finished, peak_live = 0, 0, [], 0
     while i < len(arrivals) or not eng.idle:
         now = time.monotonic() - t0
         while i < len(arrivals) and arrivals[i] <= now:
@@ -139,27 +229,45 @@ def drive_poisson(eng, prompts, arrivals, *, max_new, deadline_s):
             time.sleep(min(arrivals[i] - now, 0.002))
             continue
         finished.extend(eng.tick())
-    return finished, time.monotonic() - t0, rejected
+        peak_live = max(peak_live, eng.live_slots)
+    return finished, time.monotonic() - t0, rejected, peak_live
 
 
 def load_run(model, params, slots, chunk, rng, *, n_requests, rate,
-             max_new, deadline_s, capacity):
+             max_new, deadline_s, capacity, kv="slab", pool_blocks=None,
+             prompts=None, max_len=MAX_LEN, buckets=BUCKETS):
     gen_cfg = GenerationConfig(max_new_tokens=max_new, temperature=0.0)
     backend = SingleDeviceSlotBackend(
-        model, params, num_slots=slots, max_len=MAX_LEN, gen=gen_cfg,
-        buckets=BUCKETS, decode_chunk=chunk)
+        model, params, num_slots=slots, max_len=max_len, gen=gen_cfg,
+        buckets=buckets, decode_chunk=chunk,
+        **_backend_kv_kwargs(kv, pool_blocks))
     eng = ServeEngine(backend, RequestQueue(capacity=capacity))
     # warm every program before the clock matters
     for p in ([1] * 20, [1] * 40):
         eng.submit(p, max_new_tokens=1)
     eng.run_until_idle()
 
-    prompts = make_prompts(n_requests, rng)
+    if prompts is None:
+        prompts = make_prompts(n_requests, rng)
     arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
-    finished, elapsed, rejected = drive_poisson(
+    from pipe_tpu.obs.telemetry import get_registry
+    reg = get_registry()
+    hits0 = reg.counter("serve.kv.prefix_hits").value
+    miss0 = reg.counter("serve.kv.prefix_misses").value
+    blocked0 = reg.counter("serve.kv.admission_blocked").value
+    finished, elapsed, rejected, peak_live = drive_poisson(
         eng, prompts, arrivals, max_new=max_new, deadline_s=deadline_s)
     ok = [r for r in finished if r.status == "ok"]
     ttfts = sorted(r.ttft for r in ok)
+    kv_stats = {}
+    if kv == "paged":
+        hits = reg.counter("serve.kv.prefix_hits").value - hits0
+        miss = reg.counter("serve.kv.prefix_misses").value - miss0
+        kv_stats = {
+            "prefix_hit_rate": round(hits / max(hits + miss, 1), 4),
+            "admission_blocked":
+                reg.counter("serve.kv.admission_blocked").value - blocked0,
+        }
     return {
         "requests": n_requests,
         "offered_rate_req_s": round(rate, 3),
@@ -172,6 +280,8 @@ def load_run(model, params, slots, chunk, rng, *, n_requests, rate,
             sum(len(r.tokens) for r in ok) / elapsed, 1),
         "ttft_p50_s": round(percentile_exact(ttfts, 0.50), 4),
         "ttft_p99_s": round(percentile_exact(ttfts, 0.99), 4),
+        "peak_live_slots": peak_live,
+        **kv_stats,
     }
 
 
@@ -182,6 +292,9 @@ def main():
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--chunk", type=int, default=8,
                     help="decode_chunk: tokens per host round-trip")
+    ap.add_argument("--kv", choices=("slab", "paged"), default="slab",
+                    help="KV memory for the steady-state/latency "
+                         "sections (the kv A/B section always runs both)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -194,12 +307,49 @@ def main():
     base_tps = baseline_tokens_per_sec(model, params, slots, rng)
     log(f"  {base_tps:.1f} tokens/s at batch={slots}")
 
-    log("steady state: engine with every slot full...")
+    log(f"steady state: engine with every slot full (kv={args.kv})...")
     ticks = 8 if args.quick else 24
     serve_tps = steady_state_tokens_per_sec(model, params, slots, chunk,
-                                            rng, ticks=ticks)
+                                            rng, ticks=ticks, kv=args.kv)
     ratio = serve_tps / base_tps
     log(f"  {serve_tps:.1f} tokens/s ({ratio:.3f}x fixed-batch)")
+
+    # KV A/B on the shared-prefix workload at a FIXED row budget
+    # (slots * AB_MAX_LEN rows == the slab's footprint at S slots): slab
+    # at S slots, paged at S slots (the parity bar: paged must not lose
+    # at equal concurrency), paged at 2S slots on the SAME memory — the
+    # headline the pool buys. 2S only fits because the prefix blocks are
+    # shared: 8 live requests need 14 shared + 8x5 private = 54 blocks
+    # of the 76 allocatable, where private slabs would need 152.
+    log("kv A/B: shared-prefix workload, slab vs paged...")
+    ab, pool_blocks = kv_ab_steady_state(
+        model, params, slots, chunk, args.seed + 2,
+        ticks=8 if args.quick else 12, reps=3 if args.quick else 5)
+    kv_slab = ab["slab"]
+    kv_paged = ab["paged_equal_slots"]
+    kv_paged_2x = ab["paged_2x_slots_same_memory"]
+    kv_ab = {
+        "workload": {"shared_prefix": SHARED_LEN,
+                     "tails": list(AB_TAILS),
+                     "max_new_tokens": AB_MAX_NEW,
+                     "max_len": AB_MAX_LEN},
+        "kv_memory_rows": slots * AB_MAX_LEN,
+        "slab": kv_slab,
+        "paged_equal_slots": kv_paged,
+        "paged_2x_slots_same_memory": kv_paged_2x,
+        "prefix_hit_rate": ab["prefix_hit_rate"],
+        "paged_vs_slab_equal_slots": round(
+            kv_paged["tokens_s"] / kv_slab["tokens_s"], 4),
+        "paged_2x_vs_slab": round(
+            kv_paged_2x["tokens_s"] / kv_slab["tokens_s"], 4),
+        "live_slot_gain_same_memory": round(
+            kv_paged_2x["live_slots"] / kv_slab["live_slots"], 2),
+    }
+    log(f"  slab {kv_slab['tokens_s']:.1f} tok/s @ {slots} slots; paged "
+        f"{kv_paged['tokens_s']:.1f} tok/s @ {slots} slots "
+        f"({kv_ab['paged_vs_slab_equal_slots']:.3f}x); paged "
+        f"{kv_paged_2x['tokens_s']:.1f} tok/s @ {2 * slots} slots on the "
+        f"same memory (hit rate {ab['prefix_hit_rate']:.3f})")
 
     # capacity in requests/s at the bench's request size
     max_new = MAX_NEW
@@ -210,7 +360,7 @@ def main():
     moderate = load_run(model, params, slots, chunk, rng,
                         n_requests=n, rate=0.7 * cap_req_s,
                         max_new=max_new, deadline_s=30.0,
-                        capacity=4 * slots)
+                        capacity=4 * slots, kv=args.kv)
 
     summary = {
         "bench": "serve_bench",
@@ -218,11 +368,13 @@ def main():
         "device_kind": jax.devices()[0].device_kind,
         "slots": slots,
         "decode_chunk": chunk,
+        "kv": args.kv,
         "buckets": list(BUCKETS.lengths),
         "max_new_tokens": max_new,
         "baseline_fixed_batch_tokens_s": round(base_tps, 1),
         "steady_state_tokens_s": round(serve_tps, 1),
         "serve_vs_fixed_batch": round(ratio, 4),
+        "kv_ab": kv_ab,
         "poisson_0p7": moderate,
     }
     if args.quick:
@@ -232,6 +384,11 @@ def main():
             "ttft_p50_s": moderate["ttft_p50_s"],
             "ttft_p99_s": moderate["ttft_p99_s"],
             "goodput_tokens_s": moderate["goodput_tokens_s"],
+            "kv_paged_vs_slab_equal_slots":
+                kv_ab["paged_vs_slab_equal_slots"],
+            "kv_paged_2x_vs_slab": kv_ab["paged_2x_vs_slab"],
+            "kv_live_slot_gain": kv_ab["live_slot_gain_same_memory"],
+            "kv_prefix_hit_rate": kv_ab["prefix_hit_rate"],
         }))
         return
 
@@ -264,6 +421,48 @@ def main():
         "goodput_ratio_on_vs_off": round(
             on["goodput_tokens_s"] / max(off["goodput_tokens_s"], 1e-9),
             3),
+    }
+
+    # Shared-prefix Poisson A/B: identical prompts and arrival schedule
+    # (common 112-token system prompt, Poisson arrivals at 0.55x the
+    # paged-2S engine's measured steady-state capacity) against slab-S
+    # and paged-2S engines on the SAME KV row budget. The admission gain
+    # is structural and shows up directly: the paged run carries up to
+    # 2S concurrent requests (peak_live_slots) on memory that caps the
+    # slab at S, with every admission past the first a prefix-cache hit
+    # and zero pool-admission blocks — at goodput parity. (On this
+    # host-bound micro-model the extra concurrency buys headroom, not
+    # extra tokens/s; the steady-state A/B above prices the throughput.)
+    log("kv poisson: shared-prefix load, slab S vs paged 2S...")
+    sh_rng = np.random.RandomState(args.seed + 3)
+    shared = sh_rng.randint(1, CFG.vocab, size=SHARED_LEN).tolist()
+    n_kv = 96
+    kv_prompts = make_shared_prefix_prompts(n_kv, sh_rng, shared)
+    kv_rate = 0.55 * kv_paged_2x["tokens_s"] / AB_MAX_NEW
+    kv_slab_load = load_run(model, params, slots, chunk,
+                            np.random.RandomState(args.seed + 3),
+                            n_requests=n_kv, rate=kv_rate,
+                            max_new=AB_MAX_NEW, deadline_s=30.0,
+                            capacity=12 * slots, prompts=kv_prompts,
+                            max_len=AB_MAX_LEN, buckets=AB_BUCKETS)
+    kv_paged_load = load_run(model, params, 2 * slots, chunk,
+                             np.random.RandomState(args.seed + 3),
+                             n_requests=n_kv, rate=kv_rate,
+                             max_new=AB_MAX_NEW, deadline_s=30.0,
+                             capacity=12 * slots, kv="paged",
+                             pool_blocks=pool_blocks, prompts=kv_prompts,
+                             max_len=AB_MAX_LEN, buckets=AB_BUCKETS)
+    summary["kv_poisson_shared_prefix"] = {
+        "offered_rate_req_s": round(kv_rate, 3),
+        "kv_memory_rows": slots * AB_MAX_LEN,
+        "slab": kv_slab_load,
+        "paged_2x_slots_same_memory": kv_paged_load,
+        "goodput_ratio_paged_vs_slab": round(
+            kv_paged_load["goodput_tokens_s"]
+            / max(kv_slab_load["goodput_tokens_s"], 1e-9), 3),
+        "live_slot_gain_same_memory": round(
+            kv_paged_load["peak_live_slots"]
+            / max(kv_slab_load["peak_live_slots"], 1), 2),
     }
     print(json.dumps(summary, indent=2))
 
