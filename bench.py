@@ -494,6 +494,12 @@ def main():
         assert serve_summary["kv_paged_vs_slab_equal_slots"] >= 1.0, (
             "paged KV slower than slab at equal live slots: "
             f"{serve_summary['kv_paged_vs_slab_equal_slots']}x")
+        # The resident while_loop exists to remove per-chunk host
+        # round-trips; it must not LOSE tokens/s at equal live slots.
+        assert serve_summary["resident_vs_nonresident_tokens_s"] >= 1.0, (
+            "resident serve loop slower than single-chunk ticks at "
+            "equal live slots: "
+            f"{serve_summary['resident_vs_nonresident_tokens_s']}x")
 
     # Chaos probe: one injected fault per layer (train NaN, transport
     # drop, serve backend raise, data raise) through the recovery

@@ -23,6 +23,7 @@ Usage:
         [--prompts-file F] [--slots S] [--stages N] [--replicas N]
         [--eos ID] [--queue-capacity C] [--policy fifo|priority]
         [--timeout-s T] [--decode-chunk K] [--events F.jsonl] [--tiny]
+        [--resident auto|on|off] [--resident-chunks R] [--spec-tokens K]
         [--cpu N]
 """
 
@@ -71,6 +72,19 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--decode-chunk", type=int, default=4,
                    help="decode steps per host tick (ring: ring "
                         "revolutions per tick)")
+    p.add_argument("--resident", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="fused steady-state device loop: run up to "
+                        "--resident-chunks decode chunks per launch "
+                        "with on-device done-masking and early exit "
+                        "(auto: on for accelerators, off on cpu)")
+    p.add_argument("--resident-chunks", type=int, default=8,
+                   help="max decode chunks per resident launch (ring: "
+                        "revolutions)")
+    p.add_argument("--spec-tokens", type=int, default=None,
+                   help="speculative decode: K-token draft/verify per "
+                        "resident round (needs --resident on/auto-on; "
+                        "single-device backend only)")
     p.add_argument("--events", default=None,
                    help="write the request-span EventLog here (.jsonl)")
     p.add_argument("--tick-budget-s", type=float, default=None,
@@ -174,10 +188,18 @@ def main(argv=None) -> int:
     from ..serve import BucketSpec, QueueFull, RequestQueue, ServeEngine
     buckets = BucketSpec.pow2(min_len=8,
                               max_len=max(len(p) for p in prompts))
-    max_len = buckets.max_len + args.max_new
+    # spec lane: K-1 rows of verify-write slack on top of the request cap
+    max_len = buckets.max_len + args.max_new + (
+        args.spec_tokens - 1 if args.spec_tokens else 0)
     kv_kwargs = {} if args.kv == "slab" else {
         "kv_block_size": args.kv_block_size,
         "kv_pool_blocks": args.kv_pool_blocks}
+    resident = {"auto": "auto", "on": True, "off": False}[args.resident]
+    if args.spec_tokens is not None and n_stages > 1:
+        print("--spec-tokens requires --stages 1 (the ring's sampled "
+              "key chain is not the Generator chain the speculative "
+              "lane replays)", file=sys.stderr)
+        return 2
     if n_stages > 1:
         from ..parallel.mesh import make_mesh
         from ..parallel.spmd import stack_stage_params
@@ -186,13 +208,15 @@ def main(argv=None) -> int:
         backend = RingSlotBackend(
             make_mesh(n_stages, 1), model, stack_stage_params(sp), pre,
             post, max_len=max_len, gen=gen_cfg, buckets=buckets,
-            revolutions=args.decode_chunk, **kv_kwargs)
+            revolutions=args.decode_chunk, resident=resident,
+            resident_revolutions=args.resident_chunks, **kv_kwargs)
     else:
         from ..serve import SingleDeviceSlotBackend
         backend = SingleDeviceSlotBackend(
             model, params, num_slots=args.slots, max_len=max_len,
             gen=gen_cfg, buckets=buckets, decode_chunk=args.decode_chunk,
-            **kv_kwargs)
+            resident=resident, resident_chunks=args.resident_chunks,
+            spec_tokens=args.spec_tokens, **kv_kwargs)
 
     events = EventLog(args.events) if args.events else NULL_EVENT_LOG
 
@@ -213,7 +237,9 @@ def main(argv=None) -> int:
             SingleDeviceSlotBackend(
                 model, params, num_slots=args.slots, max_len=max_len,
                 gen=gen_cfg, buckets=buckets,
-                decode_chunk=args.decode_chunk, **kv_kwargs)
+                decode_chunk=args.decode_chunk, resident=resident,
+                resident_chunks=args.resident_chunks,
+                spec_tokens=args.spec_tokens, **kv_kwargs)
             for _ in range(replicas - 1)]
         engines = [ServeEngine(b,
                                RequestQueue(capacity=args.queue_capacity),
@@ -282,6 +308,7 @@ def main(argv=None) -> int:
                 "latency_s": round(r.latency, 4)}), flush=True)
     elapsed = time.monotonic() - t0
 
+    from ..obs.telemetry import host_overhead_per_token
     snap = {k: v for k, v in get_registry().scalars().items()
             if k.startswith(("serve.", "resilience."))}
     summary = {
@@ -290,6 +317,9 @@ def main(argv=None) -> int:
         "finished": done, "rejected": rejected,
         "drained": eng.draining,
         "elapsed_s": round(elapsed, 3),
+        "resident": bool(getattr(backend, "resident", False)),
+        "host_overhead_per_token_us": round(
+            1e6 * host_overhead_per_token(), 2),
         "buckets": list(buckets.lengths), "metrics": snap}
     if replicas > 1:
         summary["fleet"] = {

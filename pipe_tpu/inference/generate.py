@@ -64,6 +64,15 @@ class GenerationConfig:
     # programs (the absence-is-zero-cost pin, tests/test_kvpool.py).
     kv_block_size: Optional[int] = None
     prefix_cache: bool = True
+    # Serve-side speculative decode lane (resident loop only): propose
+    # spec_tokens - 1 draft tokens per round from an n-gram match over
+    # the slot's own emitted history and verify the whole proposal in
+    # ONE fixed-shape width-K pass — accepted tokens are bitwise the
+    # sequential chain's (teacher-forced verify + the same split-sample
+    # key walk), rejected tails cost nothing (their KV rows sit past the
+    # slot position and are overwritten before any unmasked read). None
+    # disables the lane; the one-shot generators ignore it.
+    spec_tokens: Optional[int] = None
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -94,6 +103,14 @@ class GenerationConfig:
                 "eos_token_id with beam search is not implemented — "
                 "EOS-aware beam pruning needs per-hypothesis length "
                 "normalization; use num_beams=1 for early stopping")
+        if self.spec_tokens is not None and self.spec_tokens < 2:
+            raise ValueError(
+                f"spec_tokens must be >= 2 (one draft token plus its "
+                f"correction), got {self.spec_tokens}")
+        if self.spec_tokens is not None and self.num_beams > 1:
+            raise ValueError(
+                "spec_tokens is a slot-decode lane; beam search has no "
+                "speculative form (num_beams must be 1)")
 
 
 def check_positions(model, prompt_len: int, max_new_tokens: int) -> None:

@@ -35,7 +35,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "EwmaTimer", "Histogram", "MetricsRegistry",
     "StepReport", "get_registry", "set_registry", "null_registry",
-    "labelled", "percentile_exact",
+    "labelled", "percentile_exact", "host_overhead_per_token",
     "train_flops_per_token", "peak_flops_per_chip", "device_memory_peaks",
 ]
 
@@ -308,6 +308,25 @@ def percentile_exact(values, q: float) -> float:
         return 0.0
     rank = min(len(vals), max(1, math.ceil(q * len(vals))))
     return float(vals[rank - 1])
+
+
+def host_overhead_per_token(registry: Optional[MetricsRegistry] = None
+                            ) -> float:
+    """Cumulative host-side serve overhead per emitted token, in seconds.
+
+    ``ServeEngine.tick`` accumulates every second of a tick NOT spent
+    inside the backend decode launch into the
+    ``serve.engine.host_sec`` timer (reap + admission checks + token
+    readout + gauge upkeep), and counts emitted tokens in
+    ``serve.engine.tokens``; their ratio is the number the resident
+    serve loop exists to shrink — the per-token tax the host charges no
+    matter how fast the device program is. ``SERVE_r14.json`` records
+    the before/after; 0.0 until the engine has served anything."""
+    reg = registry if registry is not None else get_registry()
+    toks = reg.counter("serve.engine.tokens").value
+    if not toks:
+        return 0.0
+    return reg.timer("serve.engine.host_sec").total / toks
 
 
 # --------------------------------------------------------------------------

@@ -52,7 +52,7 @@ from ..inference.generate import (GenerationConfig, head_logits,
                                   sample_logits)
 from ..inference.quant import QuantLeaf, dequant_tree
 from ..obs.events import NULL_EVENT_LOG, REQUEST
-from ..obs.telemetry import get_registry
+from ..obs.telemetry import get_registry, host_overhead_per_token
 from .buckets import BucketSpec
 from .kvpool import (KvPool, PoolExhausted, block_demand, copy_block,
                      flat_row_index, gather_block_cache, scatter_block_rows,
@@ -98,7 +98,9 @@ class SingleDeviceSlotBackend:
                  kv_block_size: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None,
                  prefill_chunk: int = 16,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 resident="auto", resident_chunks: int = 8,
+                 spec_tokens: Optional[int] = None):
         if not hasattr(model, "embed_at"):
             raise TypeError(
                 f"{type(model).__name__} has no embed_at; KV-cache "
@@ -121,6 +123,36 @@ class SingleDeviceSlotBackend:
         self.buckets = buckets
         self.decode_chunk = decode_chunk
         self.shape_cache_warn = shape_cache_warn
+        # resident tri-state: the fused multi-chunk loop pays off where
+        # launch/sync overhead does (accelerators); "auto" keeps the cpu
+        # default on the byte-for-byte single-chunk path.
+        if resident not in ("auto", True, False):
+            raise ValueError(
+                f"resident must be 'auto', True or False, got {resident!r}")
+        if resident == "auto":
+            resident = jax.devices()[0].platform != "cpu"
+        self.resident = bool(resident)
+        if resident_chunks < 1:
+            raise ValueError(
+                f"resident_chunks must be >= 1, got {resident_chunks}")
+        self.resident_chunks = resident_chunks
+        spec = spec_tokens if spec_tokens is not None else gen.spec_tokens
+        if spec is not None and spec < 2:
+            raise ValueError(
+                f"spec_tokens must be >= 2, got {spec}")
+        if spec is not None and not self.resident:
+            raise ValueError(
+                "spec_tokens needs the resident loop (the draft/verify "
+                "round IS the resident chunk body); pass resident=True")
+        self.spec_tokens = spec
+        # tokens per resident iteration: the readout stride of the token
+        # buffer the resident program returns
+        self.decode_width = spec if spec is not None else decode_chunk
+        # spec verify writes K rows per round starting at most at
+        # pos = plen + max_new - 2; headroom keeps the K-row
+        # dynamic_update_slice inside the slab/view so its start is
+        # never clamped (a clamped start misaligns EVERY row written)
+        self._spec_overshoot = (spec - 1) if spec is not None else 0
 
         stage_params, pre_params, post_params = params
         cd = model.cfg.compute_dtype
@@ -191,6 +223,36 @@ class SingleDeviceSlotBackend:
         kd0 = jax.random.key_data(jax.random.key(0))
         self._key_data = jnp.broadcast_to(kd0, (num_slots,) + kd0.shape)
 
+        if self.resident:
+            if self.paged:
+                # the regather flag lives ON DEVICE in resident mode —
+                # prefill arms it (the one host decision, counted), the
+                # resident program consumes and clears it in its carry
+                self._regather = jnp.asarray(True)
+                if self.spec_tokens is None:
+                    self._resident_jit = jax.jit(
+                        self._resident_paged_fn, donate_argnums=(3, 8))
+                else:
+                    self._resident_jit = jax.jit(
+                        self._resident_spec_paged_fn,
+                        donate_argnums=(3, 8, 10))
+            else:
+                if self.spec_tokens is None:
+                    self._resident_jit = jax.jit(
+                        self._resident_fn, donate_argnums=(3,))
+                else:
+                    self._resident_jit = jax.jit(
+                        self._resident_spec_fn, donate_argnums=(3, 7))
+            if self.spec_tokens is not None:
+                # device-side token history, the n-gram draft source:
+                # hist[s, p] = the token EMBEDDED at position p of slot
+                # s (prompt rows written at prefill, accepted tokens at
+                # their positions in-program). spec_tokens rows of slack
+                # absorb the masked write past the last position.
+                self._hist = jnp.full(
+                    (num_slots, max_len + self.spec_tokens),
+                    gen.pad_token_id, jnp.int32)
+
         self._prefill_programs = {}
 
     # -- validation --------------------------------------------------------
@@ -211,11 +273,14 @@ class SingleDeviceSlotBackend:
                 f"blocks but the whole pool holds "
                 f"{self.pool.allocatable}; raise kv_pool_blocks or "
                 f"shorten the request")
-        if prompt_len + max_new_tokens > self.max_len:
+        if prompt_len + max_new_tokens + self._spec_overshoot > self.max_len:
+            extra = (f" + speculative headroom {self._spec_overshoot}"
+                     if self._spec_overshoot else "")
             raise ValueError(
                 f"prompt_len {prompt_len} + max_new_tokens "
-                f"{max_new_tokens} exceeds the slot cache ({self.max_len} "
-                f"rows); raise max_len or shorten the request")
+                f"{max_new_tokens}{extra} exceeds the slot cache "
+                f"({self.max_len} rows); raise max_len or shorten the "
+                f"request")
         if max_new_tokens > self.gen.max_new_tokens:
             raise ValueError(
                 f"max_new_tokens {max_new_tokens} exceeds the engine cap "
@@ -474,6 +539,405 @@ class SingleDeviceSlotBackend:
         _, pool_kv = jax.lax.scan(scat_layer, 0, (pool_kv, views))
         return pool_kv, tok, pos, key_data, views, jnp.moveaxis(toks, 0, 1)
 
+    # -- resident device programs ------------------------------------------
+    #
+    # The resident loop is a `lax.while_loop` over the SAME per-chunk
+    # math as the single-chunk programs above (the step bodies are
+    # duplicated, not refactored, so the non-resident paths stay
+    # byte-for-byte untouched). The carry adds three things the host
+    # used to own: a per-slot `done` mask (eos/length), a per-slot
+    # token `budget` (remaining max_new_tokens), and — paged — the
+    # `regather` flag, consumed and cleared on device. The loop exits
+    # early when any LIVE slot goes done (a slot freed: host admission
+    # can change the slot set) or after `r_max` chunks (the deadline
+    # horizon). One host sync per launch: the chunk count `k`, which
+    # sizes the token readout. Per-step token/key/pos evolution is
+    # bitwise the single-chunk chain; tokens past a slot's eos/budget
+    # are pad and the host's readout break reaches them never.
+
+    def _resident_step(self, block_stack, pre, post, carry, paged):
+        """One decode step shared by the two non-spec resident bodies:
+        the exact `_decode_fn`/`_decode_paged_fn` step with the done
+        mask extended by the token budget."""
+        m, gen = self.model, self.gen
+        cd = m.cfg.compute_dtype
+        eos = gen.eos_token_id
+        caches, tok, pos, key_data, done, budget = carry
+
+        def embed_one(t, p):
+            return m.embed_at(pre, t[None, None], p)[0]
+
+        h = jax.vmap(embed_one)(tok, pos)                  # [S, 1, d]
+
+        def layer(h, inp):
+            bp, cache = inp
+            bpd = dequant_tree(bp, cd)
+
+            if paged:
+                def one(hh, cache_l, pp):
+                    cache = {name: cache_l[name][None]
+                             for name in ("k", "v")}
+                    out, c2 = m.block.decode(bpd, hh[None], cache, pp)
+                    return out[0], {name: c2[name][0]
+                                    for name in ("k", "v")}
+            else:
+                def one(hh, cc, pp):
+                    out, cc2 = m.block.decode(
+                        bpd, hh[None],
+                        jax.tree_util.tree_map(lambda a: a[None], cc), pp)
+                    return out[0], jax.tree_util.tree_map(
+                        lambda a: a[0], cc2)
+
+            return jax.vmap(one)(h, cache, pos)
+
+        h, caches = jax.lax.scan(layer, h, (block_stack, caches))
+        logits = head_logits(m, post, h)[:, 0, :]          # [S, V]
+        keys = jax.random.wrap_key_data(key_data)
+        ks = jax.vmap(jax.random.split)(keys)              # [S, 2] keys
+        key_data = jax.random.key_data(ks[:, 0])
+        nxt = jax.vmap(
+            lambda lg, k: sample_logits(lg[None], k, gen)[0])(
+                logits, ks[:, 1])
+        nxt = jnp.where(done, jnp.int32(gen.pad_token_id), nxt)
+        budget = budget - jnp.where(done, 0, 1)
+        done = done | (budget <= 0)
+        if eos is not None:
+            done = done | (nxt == jnp.int32(eos))
+        return (caches, nxt, pos + 1, key_data, done, budget), nxt
+
+    def _resident_done0(self, tok, live, budget):
+        """Initial done mask: dead slots, spent budgets, and slots whose
+        first token already hit eos (the engine retires those before
+        decode — this covers direct backend callers)."""
+        done = ~live | (budget <= 0)
+        if self.gen.eos_token_id is not None:
+            done = done | (tok == jnp.int32(self.gen.eos_token_id))
+        return done
+
+    def _resident_fn(self, block_stack, pre, post, caches, tok, pos,
+                     key_data, live, budget, r_max):
+        """Slab resident loop: up to ``r_max`` (traced, <= the static
+        ``resident_chunks``) decode chunks back-to-back in one program.
+        Returns the token buffer ``[S, R*C]``, per-chunk valid counts
+        ``[S, R]`` and the chunk count actually run."""
+        get_registry().counter("serve.engine.resident_traces").inc()
+        C = self.decode_chunk
+        R = self.resident_chunks
+        S = tok.shape[0]
+
+        def body(state):
+            caches, tok, pos, key_data, done, budget, buf, k = state
+            carry, toks = jax.lax.scan(
+                lambda c, _: self._resident_step(
+                    block_stack, pre, post, c, False),
+                (caches, tok, pos, key_data, done, budget), None, length=C)
+            caches, tok, pos, key_data, done, budget = carry
+            buf = jax.lax.dynamic_update_slice(
+                buf, jnp.moveaxis(toks, 0, 1), (0, k * C))
+            return caches, tok, pos, key_data, done, budget, buf, k + 1
+
+        def cond(state):
+            return (state[7] < r_max) & ~jnp.any(live & state[4])
+
+        buf0 = jnp.full((S, R * C), jnp.int32(self.gen.pad_token_id),
+                        jnp.int32)
+        state = (caches, tok, pos, key_data,
+                 self._resident_done0(tok, live, budget), budget, buf0,
+                 jnp.int32(0))
+        caches, tok, pos, key_data, done, budget, buf, k = \
+            jax.lax.while_loop(cond, body, state)
+        counts = jnp.where(
+            (jnp.arange(R, dtype=jnp.int32)[None, :] < k) & live[:, None],
+            jnp.int32(C), jnp.int32(0))
+        return caches, tok, pos, key_data, buf, counts, k
+
+    def _resident_paged_fn(self, block_stack, pre, post, pool_kv, tables,
+                           tok, pos, key_data, views, regather, live,
+                           budget, r_max):
+        """Paged resident loop. The regather decision rides the carry:
+        the (traced) flag gathers fresh views once at entry iff a
+        prefill moved a table since the last launch, and the program
+        returns it CLEARED — a no-prefill tick launches with the cold
+        flag and performs zero host-driven gather decisions. The
+        2-branch cond is a role conditional (both branches produce the
+        same view shape), not a dispatch."""
+        m = self.model
+        cd = m.cfg.compute_dtype
+        get_registry().counter("serve.engine.resident_traces").inc()
+        bs = self.pool.block_size
+        C = self.decode_chunk
+        R = self.resident_chunks
+        S = tok.shape[0]
+        view_t = tables[:, :self.pool.max_blocks + 1]
+
+        def gather_layer(pool_l):
+            out = jax.vmap(lambda tr: gather_block_cache(
+                pool_l, tr, block_size=bs, compute_dtype=cd))(view_t)
+            return {name: a[:, 0] for name, a in out.items()}
+
+        views = jax.lax.cond(
+            regather, lambda v: jax.vmap(gather_layer)(pool_kv),
+            lambda v: v, views)                            # [L, S, R, ...]
+
+        def body(state):
+            pool_kv, views, tok, pos, key_data, done, budget, buf, k = state
+            pos0 = pos
+            carry, toks = jax.lax.scan(
+                lambda c, _: self._resident_step(
+                    block_stack, pre, post, c, True),
+                (views, tok, pos, key_data, done, budget), None, length=C)
+            views, tok, pos, key_data, done, budget = carry
+            ridx = jax.vmap(lambda tr, p0: flat_row_index(
+                tr, p0 + jnp.arange(C, dtype=jnp.int32), bs))(tables, pos0)
+
+            def scat_layer(_, inp):
+                pool_l, view_l = inp
+                rows = {name: jax.vmap(
+                    lambda v, p0: jax.lax.dynamic_slice(
+                        v, (p0,) + (0,) * (v.ndim - 1),
+                        (C,) + v.shape[1:]))(view_l[name], pos0).reshape(
+                            (S * C,) + view_l[name].shape[2:])
+                    for name in ("k", "v")}
+                return 0, scatter_block_rows(pool_l, ridx.reshape(-1), rows)
+
+            _, pool_kv = jax.lax.scan(scat_layer, 0, (pool_kv, views))
+            buf = jax.lax.dynamic_update_slice(
+                buf, jnp.moveaxis(toks, 0, 1), (0, k * C))
+            return (pool_kv, views, tok, pos, key_data, done, budget,
+                    buf, k + 1)
+
+        def cond(state):
+            return (state[8] < r_max) & ~jnp.any(live & state[5])
+
+        buf0 = jnp.full((S, R * C), jnp.int32(self.gen.pad_token_id),
+                        jnp.int32)
+        state = (pool_kv, views, tok, pos, key_data,
+                 self._resident_done0(tok, live, budget), budget, buf0,
+                 jnp.int32(0))
+        pool_kv, views, tok, pos, key_data, done, budget, buf, k = \
+            jax.lax.while_loop(cond, body, state)
+        counts = jnp.where(
+            (jnp.arange(R, dtype=jnp.int32)[None, :] < k) & live[:, None],
+            jnp.int32(C), jnp.int32(0))
+        return (pool_kv, tok, pos, key_data, views,
+                jnp.zeros((), jnp.bool_), buf, counts, k)
+
+    # -- speculative resident programs -------------------------------------
+    #
+    # One resident iteration becomes a draft/verify ROUND: propose
+    # K-1 tokens by prompt-lookup (the most recent earlier occurrence
+    # of the current token in the slot's device-side history buffer),
+    # verify [tok, drafts] teacher-forced in ONE fixed-shape q=K decode
+    # at the slot's offset (the chunked-prefill mechanism, whose
+    # width-invariance the prefill parity pins already establish), and
+    # accept the leading prefix that matches plus the one correction
+    # token. Rollback is free: rejected rows sit at positions >= the
+    # advanced pos, causally masked, and the next round's q=K write
+    # covers them before any unmasked read. The per-slot key chain
+    # consumes exactly n_emit splits, so accepted tokens are bitwise
+    # the sequential Generator chain.
+
+    def _spec_round(self, block_stack, pre, post, carry, paged):
+        """One draft/verify round (shared by the slab/paged spec
+        bodies). Carry: (caches-or-views, tok, pos, key_data, hist,
+        done, budget); returns the updated carry plus the round's
+        ``[S, K]`` token row and ``[S]`` accepted counts."""
+        m, gen = self.model, self.gen
+        cd = m.cfg.compute_dtype
+        eos = gen.eos_token_id
+        K = self.spec_tokens
+        caches, tok, pos, key_data, hist, done, budget = carry
+        H = hist.shape[1]
+        idx = jnp.arange(H, dtype=jnp.int32)
+        ar = jnp.arange(K, dtype=jnp.int32)
+
+        # 1) draft: tokens after the latest earlier occurrence of tok
+        def draft_one(hrow, t, p):
+            mask = (hrow == t) & (idx < p)
+            j = jnp.max(jnp.where(mask, idx, jnp.int32(-1)))
+            start = jnp.maximum(j + 1, 0)
+            return jax.lax.dynamic_slice(hrow, (start,), (K - 1,))
+
+        drafts = jax.vmap(draft_one)(hist, tok, pos)       # [S, K-1]
+        x = jnp.concatenate([tok[:, None], drafts], axis=1)  # [S, K]
+
+        # 2) verify: one q=K teacher-forced decode at offset pos
+        h = jax.vmap(
+            lambda xs, p: m.embed_at(pre, xs[None], p)[0])(x, pos)
+
+        def layer(h, inp):
+            bp, cache = inp
+            bpd = dequant_tree(bp, cd)
+
+            if paged:
+                def one(hh, cache_l, pp):
+                    cache = {name: cache_l[name][None]
+                             for name in ("k", "v")}
+                    out, c2 = m.block.decode(bpd, hh[None], cache, pp)
+                    return out[0], {name: c2[name][0]
+                                    for name in ("k", "v")}
+            else:
+                def one(hh, cc, pp):
+                    out, cc2 = m.block.decode(
+                        bpd, hh[None],
+                        jax.tree_util.tree_map(lambda a: a[None], cc), pp)
+                    return out[0], jax.tree_util.tree_map(
+                        lambda a: a[0], cc2)
+
+            return jax.vmap(one)(h, cache, pos)
+
+        h, caches = jax.lax.scan(layer, h, (block_stack, caches))
+        logits = head_logits(m, post, h)                   # [S, K, V]
+
+        # 3) the sequential key chain, unrolled K deep: carries[i] is
+        # the slot key AFTER i+1 splits, subs[i] the i-th sample key
+        def chain(kd0):
+            def sp(c, _):
+                k2, sub = jax.random.split(jax.random.wrap_key_data(c))
+                c2 = jax.random.key_data(k2)
+                return c2, (c2, jax.random.key_data(sub))
+            _, (carries, subs) = jax.lax.scan(sp, kd0, None, length=K)
+            return carries, subs
+
+        carries, subs = jax.vmap(chain)(key_data)
+        t = jax.vmap(jax.vmap(
+            lambda lg, sd: sample_logits(
+                lg[None], jax.random.wrap_key_data(sd), gen)[0]))(
+                    logits, subs)                          # [S, K]
+
+        # 4) accept the leading matched prefix + 1 correction token
+        match = (drafts == t[:, :K - 1])
+        lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        n_emit = jnp.int32(1) + jnp.sum(lead, axis=1)
+        n_emit = jnp.where(done, jnp.int32(0), n_emit)
+        emit_mask = ar[None, :] < n_emit[:, None]
+        toks_out = jnp.where(emit_mask, t,
+                             jnp.int32(gen.pad_token_id))
+
+        # 5) advance — done slots frozen (pos/key/hist/budget untouched)
+        last = jnp.take_along_axis(
+            t, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+        tok = jnp.where(done, tok, last)
+
+        def hupd(hrow, p, trow, n):
+            cur = jax.lax.dynamic_slice(hrow, (p + 1,), (K,))
+            upd = jnp.where(ar < n, trow, cur)
+            return jax.lax.dynamic_update_slice(hrow, upd, (p + 1,))
+
+        hist = jax.vmap(hupd)(hist, pos, t, n_emit)
+        sel = jnp.concatenate([key_data[:, None], carries], axis=1)
+        key_data = jax.vmap(lambda s, n: s[n])(sel, n_emit)
+        pos = pos + n_emit
+        budget = budget - n_emit
+        done = done | (budget <= 0)
+        if eos is not None:
+            done = done | jnp.any(
+                (t == jnp.int32(eos)) & emit_mask, axis=1)
+        return (caches, tok, pos, key_data, hist, done, budget,
+                toks_out, n_emit)
+
+    def _resident_spec_fn(self, block_stack, pre, post, caches, tok,
+                          pos, key_data, hist, live, budget, r_max):
+        """Slab resident loop with the speculative lane: each iteration
+        is one draft/verify round emitting 1..K tokens per live slot."""
+        get_registry().counter("serve.engine.resident_traces").inc()
+        K = self.spec_tokens
+        R = self.resident_chunks
+        S = tok.shape[0]
+
+        def body(state):
+            caches, tok, pos, key_data, hist, done, budget, \
+                buf, nacc, k = state
+            (caches, tok, pos, key_data, hist, done, budget, toks,
+             n_emit) = self._spec_round(
+                block_stack, pre, post,
+                (caches, tok, pos, key_data, hist, done, budget), False)
+            buf = jax.lax.dynamic_update_slice(buf, toks, (0, k * K))
+            nacc = jax.lax.dynamic_update_slice(
+                nacc, n_emit[:, None], (0, k))
+            return (caches, tok, pos, key_data, hist, done, budget,
+                    buf, nacc, k + 1)
+
+        def cond(state):
+            return (state[9] < r_max) & ~jnp.any(live & state[5])
+
+        buf0 = jnp.full((S, R * K), jnp.int32(self.gen.pad_token_id),
+                        jnp.int32)
+        nacc0 = jnp.zeros((S, R), jnp.int32)
+        state = (caches, tok, pos, key_data, hist,
+                 self._resident_done0(tok, live, budget), budget,
+                 buf0, nacc0, jnp.int32(0))
+        caches, tok, pos, key_data, hist, done, budget, buf, nacc, k = \
+            jax.lax.while_loop(cond, body, state)
+        return caches, tok, pos, key_data, hist, buf, nacc, k
+
+    def _resident_spec_paged_fn(self, block_stack, pre, post, pool_kv,
+                                tables, tok, pos, key_data, views,
+                                regather, hist, live, budget, r_max):
+        """Paged resident loop with the speculative lane: the verify
+        runs against the carried views, each round's K rows scatter
+        back through the full-width tables (rejected/dead rows route
+        to the sacrificial block exactly like dead-slot decode)."""
+        m = self.model
+        cd = m.cfg.compute_dtype
+        get_registry().counter("serve.engine.resident_traces").inc()
+        bs = self.pool.block_size
+        K = self.spec_tokens
+        R = self.resident_chunks
+        S = tok.shape[0]
+        view_t = tables[:, :self.pool.max_blocks + 1]
+
+        def gather_layer(pool_l):
+            out = jax.vmap(lambda tr: gather_block_cache(
+                pool_l, tr, block_size=bs, compute_dtype=cd))(view_t)
+            return {name: a[:, 0] for name, a in out.items()}
+
+        views = jax.lax.cond(
+            regather, lambda v: jax.vmap(gather_layer)(pool_kv),
+            lambda v: v, views)
+
+        def body(state):
+            pool_kv, views, tok, pos, key_data, hist, done, budget, \
+                buf, nacc, k = state
+            pos0 = pos
+            (views, tok, pos, key_data, hist, done, budget, toks,
+             n_emit) = self._spec_round(
+                block_stack, pre, post,
+                (views, tok, pos, key_data, hist, done, budget), True)
+            ridx = jax.vmap(lambda tr, p0: flat_row_index(
+                tr, p0 + jnp.arange(K, dtype=jnp.int32), bs))(tables, pos0)
+
+            def scat_layer(_, inp):
+                pool_l, view_l = inp
+                rows = {name: jax.vmap(
+                    lambda v, p0: jax.lax.dynamic_slice(
+                        v, (p0,) + (0,) * (v.ndim - 1),
+                        (K,) + v.shape[1:]))(view_l[name], pos0).reshape(
+                            (S * K,) + view_l[name].shape[2:])
+                    for name in ("k", "v")}
+                return 0, scatter_block_rows(pool_l, ridx.reshape(-1), rows)
+
+            _, pool_kv = jax.lax.scan(scat_layer, 0, (pool_kv, views))
+            buf = jax.lax.dynamic_update_slice(buf, toks, (0, k * K))
+            nacc = jax.lax.dynamic_update_slice(
+                nacc, n_emit[:, None], (0, k))
+            return (pool_kv, views, tok, pos, key_data, hist, done,
+                    budget, buf, nacc, k + 1)
+
+        def cond(state):
+            return (state[10] < r_max) & ~jnp.any(live & state[6])
+
+        buf0 = jnp.full((S, R * K), jnp.int32(self.gen.pad_token_id),
+                        jnp.int32)
+        nacc0 = jnp.zeros((S, R), jnp.int32)
+        state = (pool_kv, views, tok, pos, key_data, hist,
+                 self._resident_done0(tok, live, budget), budget,
+                 buf0, nacc0, jnp.int32(0))
+        (pool_kv, views, tok, pos, key_data, hist, done, budget, buf,
+         nacc, k) = jax.lax.while_loop(cond, body, state)
+        return (pool_kv, tok, pos, key_data, views,
+                jnp.zeros((), jnp.bool_), hist, buf, nacc, k)
+
     # -- backend API -------------------------------------------------------
 
     def prefill(self, slot: int, prompt: Sequence[int], seed: int,
@@ -524,7 +988,21 @@ class SingleDeviceSlotBackend:
         self._pos = self._pos.at[slot].set(p)
         self._key_data = self._key_data.at[slot].set(
             jax.random.key_data(key))
+        self._hist_write(slot, prompt, tok0)
         return tok0
+
+    def _hist_write(self, slot: int, prompt: Sequence[int],
+                    tok0: int) -> None:
+        """Seed the speculative draft history for a freshly prefilled
+        slot: hist[s, p] = the token embedded at position p (prompt
+        rows + the first sampled token); pad beyond."""
+        if self.spec_tokens is None:
+            return
+        row = np.full((self._hist.shape[1],), self.gen.pad_token_id,
+                      np.int32)
+        row[:len(prompt)] = np.asarray(list(prompt), np.int32)
+        row[len(prompt)] = tok0
+        self._hist = self._hist.at[slot].set(jnp.asarray(row))
 
     def _prefill_paged(self, slot: int, prompt: Sequence[int], seed: int,
                        max_new_tokens: int) -> int:
@@ -564,14 +1042,36 @@ class SingleDeviceSlotBackend:
         self._key_data = self._key_data.at[slot].set(
             jax.random.key_data(key))
         self._views_dirty = True       # this slot's table moved
+        if self.resident:
+            # arm the device-side regather flag — the ONE host gather
+            # decision per admission (counted here; steady-state
+            # resident ticks make zero)
+            self._regather = jnp.asarray(True)
+            get_registry().counter(
+                "serve.kv.regather_host_decisions").inc()
+        self._hist_write(slot, prompt, tok0)
         return tok0
 
-    def decode(self, live: np.ndarray):
+    def decode(self, live: np.ndarray,
+               budgets: Optional[np.ndarray] = None,
+               r_max: Optional[int] = None):
         """One decode chunk for all slots. Returns ``(tokens [S, K],
         valid [S, K])`` — dead slots compute garbage (their rows are
         rewritten at the next prefill — or, paged, land in the
-        sacrificial block); ``valid`` masks them out."""
+        sacrificial block); ``valid`` masks them out.
+
+        With ``budgets`` (per-slot remaining max_new_tokens) on a
+        resident backend, the call runs the RESIDENT loop instead: up
+        to ``r_max`` chunks (default ``resident_chunks``) in one
+        device program, returning ``[S, k*width]`` tokens with the
+        per-chunk validity the device's done-masking produced. Without
+        ``budgets`` the single-chunk path runs even when
+        ``resident=True`` — that is the parity reference."""
+        if self.resident and budgets is not None:
+            return self._decode_resident(live, budgets, r_max)
         if self.paged:
+            get_registry().counter(
+                "serve.kv.regather_host_decisions").inc()
             pool_kv, tok, pos, kd, views, toks = self._decode_jit(
                 self._block_stack, self._pre, self._post, self._pool_kv,
                 jnp.asarray(self.pool.table), self._tok, self._pos,
@@ -580,6 +1080,8 @@ class SingleDeviceSlotBackend:
             self._pool_kv = pool_kv
             self._views = views
             self._views_dirty = False
+            if self.resident:
+                self._regather = jnp.asarray(False)  # views now current
         else:
             caches, tok, pos, kd, toks = self._decode_jit(
                 self._block_stack, self._pre, self._post, self._caches,
@@ -589,6 +1091,68 @@ class SingleDeviceSlotBackend:
         toks = np.asarray(toks)
         valid = np.broadcast_to(
             np.asarray(live, bool)[:, None], toks.shape)
+        return toks, valid
+
+    def _decode_resident(self, live: np.ndarray, budgets: np.ndarray,
+                         r_max: Optional[int]):
+        """One resident launch: up to ``r_max`` chunks/rounds on
+        device, ONE host sync (the chunk count) to size the readout."""
+        reg = get_registry()
+        R = self.resident_chunks
+        rm = R if r_max is None else max(1, min(int(r_max), R))
+        live_d = jnp.asarray(np.asarray(live, bool))
+        budget = jnp.asarray(np.asarray(budgets, np.int32))
+        if self.paged:
+            tables = jnp.asarray(self.pool.table)
+            if self.spec_tokens is not None:
+                (pool_kv, tok, pos, kd, views, regather, hist, buf,
+                 counts, k) = self._resident_jit(
+                    self._block_stack, self._pre, self._post,
+                    self._pool_kv, tables, self._tok, self._pos,
+                    self._key_data, self._views, self._regather,
+                    self._hist, live_d, budget, jnp.int32(rm))
+                self._hist = hist
+            else:
+                (pool_kv, tok, pos, kd, views, regather, buf, counts,
+                 k) = self._resident_jit(
+                    self._block_stack, self._pre, self._post,
+                    self._pool_kv, tables, self._tok, self._pos,
+                    self._key_data, self._views, self._regather,
+                    live_d, budget, jnp.int32(rm))
+            self._pool_kv = pool_kv
+            self._views = views
+            self._views_dirty = False
+            self._regather = regather          # cleared, never synced
+        else:
+            if self.spec_tokens is not None:
+                caches, tok, pos, kd, hist, buf, counts, k = \
+                    self._resident_jit(
+                        self._block_stack, self._pre, self._post,
+                        self._caches, self._tok, self._pos,
+                        self._key_data, self._hist, live_d, budget,
+                        jnp.int32(rm))
+                self._hist = hist
+            else:
+                caches, tok, pos, kd, buf, counts, k = \
+                    self._resident_jit(
+                        self._block_stack, self._pre, self._post,
+                        self._caches, self._tok, self._pos,
+                        self._key_data, live_d, budget, jnp.int32(rm))
+            self._caches = caches
+        self._tok, self._pos, self._key_data = tok, pos, kd
+        k = int(k)                             # THE host sync
+        if k < rm:
+            reg.counter("serve.engine.device_exits").inc()
+        W = self.decode_width
+        toks = np.asarray(buf)[:, :k * W]
+        counts = np.asarray(counts)[:, :k]
+        valid = (np.arange(W)[None, None, :]
+                 < counts[:, :, None]).reshape(self.num_slots, k * W)
+        if self.spec_tokens is not None:
+            lc = counts[np.asarray(live, bool)]
+            reg.counter("serve.engine.spec_rounds").inc(
+                int((lc > 0).sum()))
+            reg.counter("serve.engine.spec_emitted").inc(int(lc.sum()))
         return toks, valid
 
     def can_admit(self, prompt_len: int, max_new_tokens: int,
@@ -664,6 +1228,9 @@ class ServeEngine:
         self._decode_errors = 0
         self._miss_ewma = 0.0
         self._draining = False
+        # observed per-chunk decode latency (EWMA) — sizes the resident
+        # deadline horizon in chunks; None until the first decode
+        self._chunk_ewma: Optional[float] = None
 
     # -- front door --------------------------------------------------------
 
@@ -925,6 +1492,7 @@ class ServeEngine:
         # demand, it parks at the head (FIFO order intact) until
         # retirements free blocks — the slab masked this over-admission
         # by reserving max_len rows for everyone up front.
+        device_sec = 0.0                    # prefill + decode launches
         while self._free and not self._draining:
             nxt = self.queue.peek()
             if nxt is None:
@@ -943,6 +1511,7 @@ class ServeEngine:
                 break
             req = self.queue.pop()
             slot = self._free.pop()
+            t_pre = self.clock()
             try:
                 if self.chaos is not None and self.chaos.serve_fault(
                         "backend_raise", tick_idx) is not None:
@@ -956,6 +1525,7 @@ class ServeEngine:
                 self._free.append(slot)
                 finished.append(self._fail_queued(req, e, self.clock()))
                 continue
+            device_sec += self.clock() - t_pre
             t_first = self.clock()
             st = _Slot(req, tok0, ttft=t_first - req.submitted_at,
                        admitted_tick=tick_idx)
@@ -972,15 +1542,35 @@ class ServeEngine:
         # with slot state intact, and only a run of consecutive failures
         # retires the live set.
         live = np.array([s is not None for s in self._slots])
+        decode_sec = 0.0
         if live.any():
             t0 = self.clock()
             try:
-                toks, valid = self.backend.decode(live)
+                reg.counter("serve.engine.host_syncs").inc()
+                if getattr(self.backend, "resident", False):
+                    budgets = np.array(
+                        [0 if s is None else
+                         max(s.req.max_new_tokens - len(s.tokens), 0)
+                         for s in self._slots], np.int32)
+                    toks, valid = self.backend.decode(
+                        live, budgets=budgets,
+                        r_max=self._resident_horizon(now))
+                else:
+                    toks, valid = self.backend.decode(live)
             except Exception as e:           # noqa: BLE001 — containment
                 self._on_decode_error(reg, e, tick_idx, finished)
             else:
                 self._decode_errors = 0
                 t1 = self.clock()
+                decode_sec = t1 - t0
+                device_sec += decode_sec
+                width = getattr(
+                    self.backend, "decode_width",
+                    getattr(self.backend, "decode_chunk", 1))
+                chunks = max(1, toks.shape[1] // max(1, width))
+                per = decode_sec / chunks
+                self._chunk_ewma = per if self._chunk_ewma is None \
+                    else 0.8 * self._chunk_ewma + 0.2 * per
                 emitted = 0
                 for slot in range(self.backend.num_slots):
                     st = self._slots[slot]
@@ -1012,6 +1602,13 @@ class ServeEngine:
         if pool is not None:
             pool.observe()
         dur = self.clock() - t_start
+        # everything in the tick that was NOT a device launch (prefill
+        # or decode) is host overhead the resident loop amortizes away;
+        # the cumulative ratio is the SERVE_r14 before/after headline
+        reg.timer("serve.engine.host_sec").observe(
+            max(dur - device_sec, 0.0))
+        reg.gauge("serve.engine.host_overhead_per_token").set(
+            host_overhead_per_token(reg))
         reg.gauge("resilience.tick_sec").set(dur)
         if wd is not None and wd.record_tick(dur):
             reg.counter("resilience.watchdog_slow_ticks").inc()
@@ -1019,6 +1616,26 @@ class ServeEngine:
                               tick=tick_idx, duration_s=dur,
                               budget_s=wd.tick_budget_s)
         return finished
+
+    def _resident_horizon(self, now: float) -> int:
+        """How many chunks the device may run before host attention
+        could matter: the soonest deadline — live slots or queued
+        requests — divided by the observed per-chunk latency, clamped
+        to [1, resident_chunks]. No deadlines in sight: the full
+        resident depth (slot-free early exit still fires on device)."""
+        R = getattr(self.backend, "resident_chunks", 1)
+        dls = [s.req.deadline for s in self._slots
+               if s is not None and s.req.deadline is not None]
+        qd = self.queue.earliest_deadline()
+        if qd is not None:
+            dls.append(qd)
+        if not dls:
+            return R
+        ew = self._chunk_ewma
+        left = min(dls) - now
+        if ew is None or ew <= 0.0 or left <= 0.0:
+            return 1
+        return int(max(1, min(R, left / ew)))
 
     def _prefill_kwargs(self, req: Request) -> dict:
         """Pass the request's token budget to backends whose prefill

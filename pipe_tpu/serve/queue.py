@@ -215,6 +215,16 @@ class RequestQueue:
             now = self.clock()
         return now - min(r.submitted_at for r in self._waiting)
 
+    def earliest_deadline(self) -> Optional[float]:
+        """Soonest deadline among queued (uncancelled) requests, or None
+        when nothing queued carries one. The resident serve loop clamps
+        its on-device horizon to this: the device may run chunks
+        back-to-back only up to the moment host attention (a reap, an
+        admission) could actually change the slot set."""
+        dls = [r.deadline for r in self._waiting
+               if r.deadline is not None and not r.cancelled]
+        return min(dls) if dls else None
+
     def shed_lowest(self, n: int) -> List[Request]:
         """Degraded-mode load shedding: remove and return up to ``n``
         queued requests, lowest ``priority`` first (ties: youngest

@@ -73,7 +73,8 @@ class RingSlotBackend:
                  kv_block_size: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None,
                  prefill_chunk: int = 16,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 resident="auto", resident_revolutions: int = 8):
         if STAGE_AXIS not in mesh.axis_names:
             raise ValueError(f"mesh must have a {STAGE_AXIS!r} axis")
         if not hasattr(model, "embed_at"):
@@ -97,7 +98,30 @@ class RingSlotBackend:
         self.n = mesh.shape[STAGE_AXIS]
         self.num_slots = self.n
         self.decode_chunk = revolutions   # tokens per slot per tick
+        self.decode_width = 1             # resident readout stride
         self.shape_cache_warn = shape_cache_warn
+        # resident tri-state, exactly the single-device semantics:
+        # "auto" keeps the cpu default on the byte-for-byte
+        # single-launch path
+        if resident not in ("auto", True, False):
+            raise ValueError(
+                f"resident must be 'auto', True or False, got {resident!r}")
+        if resident == "auto":
+            resident = jax.devices()[0].platform != "cpu"
+        self.resident = bool(resident)
+        if resident_revolutions < 1:
+            raise ValueError(
+                f"resident_revolutions must be >= 1, got "
+                f"{resident_revolutions}")
+        self.resident_revolutions = resident_revolutions
+        # the engine's deadline horizon speaks in "resident chunks";
+        # for the ring one chunk is one revolution
+        self.resident_chunks = resident_revolutions
+        if gen.spec_tokens is not None:
+            raise NotImplementedError(
+                "speculative decode is single-device only for now: the "
+                "ring's sampled chain is fold_in(key, t), not the "
+                "Generator split chain the spec lane replays")
         self._stage_params = stage_params
         self._pre = pre_params
         self._post = post_params
@@ -502,6 +526,129 @@ class RingSlotBackend:
             jnp.where(s == n - 1, emitted, 0), STAGE_AXIS)
         return caches, h_carry, tok_ring, pos_row[None], emitted
 
+    # -- resident device program -------------------------------------------
+
+    def _resident_impl(self, paged, stage_params, pre, post, caches,
+                       h_carry, tok_ring, pos_local, c0, admit, live,
+                       tok_inject, plen, key_data, budget, r_max,
+                       tables=None):
+        """The resident ring loop: a ``lax.while_loop`` whose body is
+        ONE revolution of the exact wavefront recurrence above — the
+        body stays switch-free (masked arithmetic + ppermute/psum, the
+        ``compile_phases`` discipline; the 0-dispatch pin is
+        ``tools/hlo_audit.py --resident``). Each revolution's emissions
+        are psum'd so every stage can advance the replicated per-group
+        ``done``/``budget`` carry; ``done`` joins the validity mask, so
+        finished groups freeze (their writes route to the sacrificial
+        region) instead of overshooting. Exits early when any live
+        group goes done — a slot freed, host admission can matter — or
+        after ``r_max`` revolutions (the deadline horizon). One host
+        sync per launch: the revolution count."""
+        m, gen, n = self.model, self.gen, self.n
+        R = self.resident_revolutions
+        s = jax.lax.axis_index(STAGE_AXIS)
+        get_registry().counter("serve.ring.resident_traces").inc()
+        block_stack = self._local_blocks(stage_params)
+        eos = gen.eos_token_id
+        sac = self._sacpos if paged else self._sac
+
+        def body(state):
+            h_carry, tok_ring, caches, pos_row, emitted, done, budget, \
+                r = state
+
+            def cycle(carry, j):
+                h_carry, tok_ring, caches, pos_row, rev_tok, \
+                    rev_emit = carry
+                c = c0 + r * n + j
+                grp = jnp.mod(c - s, n)
+                adm = jnp.take(admit, grp)
+                valid = (jnp.take(live, grp) != 0) \
+                    & ~jnp.take(done, grp) & (c >= adm + s)
+                pos = jnp.take(pos_row, grp)
+                pos_use = jnp.where(valid, pos, sac)
+                inject = c == adm
+                tok_use = jnp.where(inject, jnp.take(tok_inject, grp),
+                                    tok_ring[0])
+                h_embed = m.embed_at(pre, tok_use[None, None], pos_use)
+                h_in = jnp.where(s == 0, h_embed, h_carry)
+                if paged:
+                    trow = jax.lax.dynamic_index_in_dim(
+                        tables, grp, 0, keepdims=False)
+                    h_out, caches = self._run_blocks_paged(
+                        block_stack, h_in, caches, trow, pos_use)
+                else:
+                    h_out, caches = self._run_blocks(
+                        block_stack, h_in, caches, grp, pos_use)
+                logits = head_logits(m, post, h_out)[:, 0, :]
+                kd_g = jax.lax.dynamic_index_in_dim(key_data, grp, 0,
+                                                    keepdims=False)
+                key_g = jax.random.wrap_key_data(kd_g)
+                t_gen = pos - jnp.take(plen, grp) + 1
+                tok_out = sample_logits(
+                    logits, jax.random.fold_in(key_g, t_gen), gen)
+                emit = (s == n - 1) & valid
+                old_t = jax.lax.dynamic_slice(rev_tok, (grp,), (1,))[0]
+                rev_tok = jax.lax.dynamic_update_slice(
+                    rev_tok, jnp.where(emit, tok_out[0], old_t)[None],
+                    (grp,))
+                old_e = jax.lax.dynamic_slice(rev_emit, (grp,), (1,))[0]
+                rev_emit = jax.lax.dynamic_update_slice(
+                    rev_emit, jnp.where(emit, jnp.int32(1), old_e)[None],
+                    (grp,))
+                pos_row = jax.lax.dynamic_update_slice(
+                    pos_row, jnp.where(valid, pos + 1, pos)[None], (grp,))
+                return (self._ring(h_out), self._ring(tok_out), caches,
+                        pos_row, rev_tok, rev_emit), None
+
+            z = jnp.zeros((n,), jnp.int32)
+            (h_carry, tok_ring, caches, pos_row, rev_tok, rev_emit), _ = \
+                jax.lax.scan(
+                    cycle, (h_carry, tok_ring, caches, pos_row, z, z),
+                    jnp.arange(n))
+            rev_tok = jax.lax.psum(
+                jnp.where(s == n - 1, rev_tok, 0), STAGE_AXIS)
+            rev_emit = jax.lax.psum(
+                jnp.where(s == n - 1, rev_emit, 0), STAGE_AXIS)
+            emitted = jax.lax.dynamic_update_slice(
+                emitted, rev_tok[:, None], (0, r))
+            budget = budget - rev_emit
+            done = done | (budget <= 0)
+            if eos is not None:
+                done = done | ((rev_tok == jnp.int32(eos))
+                               & (rev_emit > 0))
+            return (h_carry, tok_ring, caches, pos_row, emitted, done,
+                    budget, r + 1)
+
+        def cond(state):
+            return (state[7] < r_max) & \
+                ~jnp.any((live != 0) & state[5])
+
+        emitted0 = jnp.zeros((n, R), jnp.int32)
+        done0 = (live == 0) | (budget <= 0)
+        state = (h_carry, tok_ring, caches, pos_local[0], emitted0,
+                 done0, budget, jnp.int32(0))
+        h_carry, tok_ring, caches, pos_row, emitted, done, budget, r = \
+            jax.lax.while_loop(cond, body, state)
+        return caches, h_carry, tok_ring, pos_row[None], emitted, r
+
+    def _resident_decode_fn(self, stage_params, pre, post, caches,
+                            h_carry, tok_ring, pos_local, c0, admit,
+                            live, tok_inject, plen, key_data, budget,
+                            r_max):
+        return self._resident_impl(
+            False, stage_params, pre, post, caches, h_carry, tok_ring,
+            pos_local, c0, admit, live, tok_inject, plen, key_data,
+            budget, r_max)
+
+    def _resident_decode_paged_fn(self, stage_params, pre, post, caches,
+                                  h_carry, tok_ring, pos_local, c0,
+                                  admit, live, tok_inject, plen,
+                                  key_data, tables, budget, r_max):
+        return self._resident_impl(
+            True, stage_params, pre, post, caches, h_carry, tok_ring,
+            pos_local, c0, admit, live, tok_inject, plen, key_data,
+            budget, r_max, tables=tables)
+
     # -- backend API -------------------------------------------------------
 
     def _build(self, kind, B=None):
@@ -528,6 +675,20 @@ class RingSlotBackend:
             out_specs = (cache_spec, P(STAGE_AXIS), P(STAGE_AXIS),
                          P(STAGE_AXIS), P())
             fn = self._decode_paged_fn
+        elif kind == "resident":
+            in_specs = (pspec, pre_spec, post_spec, cache_spec,
+                        P(STAGE_AXIS), P(STAGE_AXIS), P(STAGE_AXIS),
+                        P(), P(), P(), P(), P(), P(), P(), P())
+            out_specs = (cache_spec, P(STAGE_AXIS), P(STAGE_AXIS),
+                         P(STAGE_AXIS), P(), P())
+            fn = self._resident_decode_fn
+        elif kind == "resident_paged":
+            in_specs = (pspec, pre_spec, post_spec, cache_spec,
+                        P(STAGE_AXIS), P(STAGE_AXIS), P(STAGE_AXIS),
+                        P(), P(), P(), P(), P(), P(), P(), P(), P())
+            out_specs = (cache_spec, P(STAGE_AXIS), P(STAGE_AXIS),
+                         P(STAGE_AXIS), P(), P())
+            fn = self._resident_decode_paged_fn
         else:
             in_specs = (pspec, pre_spec, post_spec, cache_spec,
                         P(STAGE_AXIS), P(STAGE_AXIS), P(STAGE_AXIS),
@@ -631,10 +792,20 @@ class RingSlotBackend:
         self._pos_local = jax.device_put(jnp.asarray(pl), self._stage_sh)
         return tok0
 
-    def decode(self, live: np.ndarray):
+    def decode(self, live: np.ndarray,
+               budgets: Optional[np.ndarray] = None,
+               r_max: Optional[int] = None):
         """One tick = ``revolutions`` tokens per live slot. Returns
         ``(tokens [S, R], valid [S, R])``; validity accounts for
-        admission wavefronts still filling the ring."""
+        admission wavefronts still filling the ring.
+
+        With ``budgets`` on a resident backend the call runs the
+        RESIDENT loop: up to ``r_max`` revolutions in one device
+        program with on-device done-masking and early exit. Without
+        ``budgets`` the single-launch path runs even when
+        ``resident=True`` — the parity reference."""
+        if self.resident and budgets is not None:
+            return self._decode_resident(live, budgets, r_max)
         n, R = self.n, self.decode_chunk
         live = np.asarray(live).astype(np.int32)
         kind = "decode_paged" if self.paged else "decode"
@@ -660,6 +831,49 @@ class RingSlotBackend:
         valid = (live[:, None] != 0) & \
             (emit_cycle >= self._admit[:, None] + n - 1)
         self._c0 += n * R
+        if self._c0 > _REBASE:
+            shift = self._c0
+            self._c0 = 0
+            self._admit = np.maximum(
+                self._admit - shift, -np.int32(_REBASE)).astype(np.int32)
+        return toks, valid
+
+    def _decode_resident(self, live: np.ndarray, budgets: np.ndarray,
+                         r_max: Optional[int]):
+        """One resident launch: up to ``r_max`` revolutions on device,
+        ONE host sync (the revolution count) to size the readout."""
+        reg = get_registry()
+        n, R = self.n, self.resident_revolutions
+        rm = R if r_max is None else max(1, min(int(r_max), R))
+        live = np.asarray(live).astype(np.int32)
+        kind = "resident_paged" if self.paged else "resident"
+        run = self._programs.get(kind)
+        if run is None:
+            run = self._build(kind)
+            self._programs[kind] = run
+        args = (
+            self._stage_params, self._pre, self._post, self._caches,
+            self._h, self._tok_ring, self._pos_local,
+            jnp.int32(self._c0), jnp.asarray(self._admit),
+            jnp.asarray(live), jnp.asarray(self._tok_inject),
+            jnp.asarray(self._plen), jnp.asarray(self._key_data))
+        if self.paged:
+            args = args + (jnp.asarray(self.pool.table),)
+        args = args + (jnp.asarray(np.asarray(budgets, np.int32)),
+                       jnp.int32(rm))
+        caches, h, tok_ring, pos_local, emitted, r_ran = run(*args)
+        self._caches, self._h = caches, h
+        self._tok_ring, self._pos_local = tok_ring, pos_local
+        r_ran = int(r_ran)                   # THE host sync
+        if r_ran < rm:
+            reg.counter("serve.engine.device_exits").inc()
+        toks = np.asarray(emitted)[:, :r_ran]
+        g = np.arange(n)[:, None]
+        r = np.arange(r_ran)[None, :]
+        emit_cycle = self._c0 + r * n + (g + n - 1) % n
+        valid = (live[:, None] != 0) & \
+            (emit_cycle >= self._admit[:, None] + n - 1)
+        self._c0 += n * r_ran
         if self._c0 > _REBASE:
             shift = self._c0
             self._c0 = 0

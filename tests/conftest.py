@@ -103,6 +103,12 @@ _SMOKE = {
     "test_phase_compile.py::test_front_door_phase_compile_plumbing",
     # schedules-as-data: a user-authored op table through the front door
     "test_custom_schedule.py::test_custom_table_through_pipe_front_door",
+    # resident serve loop: the fused-loop parity pin and the speculative
+    # lane's bitwise-acceptance pin (PR 11)
+    "test_resident.py::test_resident_matches_single_chunk_tick"
+    "[single-slab-greedy]",
+    "test_resident.py::test_speculative_decode_matches_generator"
+    "[slab-greedy]",
     # resilience: the byte-identical-opt-out pin, one recovery path per
     # layer (train skip-step, serve containment), and the verifiable save
     "test_resilience.py::test_train_step_hlo_unchanged_by_resilience",
@@ -284,6 +290,25 @@ _SLOW = {
     "test_ulysses.py::test_pp_cp_ulysses_matches_ring_model",
     # jit-sharding assertion; all generation-parity cases stay
     "test_generate.py::test_data_parallel_generation_is_a_jit_sharding",
+    # resident-loop duplicates: the kept cases (single-slab greedy +
+    # sampled, single-paged greedy, ring-slab greedy, the single trace
+    # pin, both spec greedy/sampled reps) pin every layout x backend x
+    # sampling mode at least once in tier 1; these re-run the same
+    # programs on the remaining crossings
+    "test_resident.py::test_resident_matches_single_chunk_tick"
+    "[single-paged-sampled]",
+    "test_resident.py::test_resident_matches_single_chunk_tick"
+    "[ring-slab-sampled]",
+    "test_resident.py::test_resident_matches_single_chunk_tick"
+    "[ring-paged-greedy]",
+    "test_resident.py::test_resident_matches_single_chunk_tick"
+    "[ring-paged-sampled]",
+    "test_resident.py::test_resident_traces_once_and_counts_host_syncs"
+    "[ring]",
+    "test_resident.py::test_speculative_decode_matches_generator"
+    "[slab-sampled]",
+    "test_resident.py::test_speculative_decode_matches_generator"
+    "[paged-greedy]",
     # paged-KV ring-backend duplicates: the [single] twins keep every
     # pool feature (staggered parity + one-program pin, COW prefix
     # parity, sampled parity) in tier 1; the ring backend's paged path

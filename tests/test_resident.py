@@ -1,0 +1,326 @@
+"""The resident serve loop (PR 11): fused multi-chunk decode + spec lane.
+
+Gold contract, layered on the serve suite's pins:
+
+* **Parity.** A resident backend — the `lax.while_loop` that runs up to
+  ``resident_chunks`` decode chunks back-to-back on device — emits
+  bitwise the tokens of the single-chunk tick path, on both backends,
+  slab and paged, greedy and sampled. The single-chunk path itself is
+  pinned to the one-shot ``Generator`` by tests/test_serve.py, so the
+  resident loop inherits the gold contract transitively (and we
+  re-assert it directly for greedy).
+* **Zero steady-state recompiles.** The resident program traces exactly
+  once across staggered arrivals and mixed prompt lengths
+  (``serve.engine.resident_traces`` / ``serve.ring.resident_traces``).
+* **The regather decision lives on device.** A steady-state resident
+  tick (no prefill) makes ZERO host-driven gather decisions
+  (``serve.kv.regather_host_decisions``); the non-resident paged path
+  makes one per tick.
+* **Early exit.** The device loop exits before ``r_max`` when any live
+  slot finishes (``serve.engine.device_exits``), so a freed slot waits
+  at most one chunk, not a full horizon.
+* **Speculative decode.** The n-gram draft/verify lane emits bitwise
+  the per-prompt ``Generator`` tokens (draft rejection rolls back to
+  exact greedy/sampled behaviour) while emitting MORE than one token
+  per verify round on draftable text (``serve.engine.spec_emitted`` >
+  ``serve.engine.spec_rounds``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.inference import GenerationConfig, Generator
+from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+from pipe_tpu.obs.telemetry import get_registry
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.spmd import stack_stage_params
+from pipe_tpu.serve import (BucketSpec, RingSlotBackend, ServeEngine,
+                            SingleDeviceSlotBackend)
+
+CFG = LMConfig(vocab=89, d_model=32, nhead=4, d_ff=64, n_layers=4,
+               seq_len=32, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = PipelinedLM(CFG, n_stages=2)
+    return model, model.init(jax.random.key(0))
+
+
+def _one_shot_refs(model, params, prompts, gen_cfg, seed):
+    g = Generator(model, gen_cfg)
+    return [np.asarray(g.generate(params,
+                                  jnp.asarray(p, jnp.int32)[None],
+                                  jax.random.key(seed)))[0]
+            for p in prompts]
+
+
+def _mixed_prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(1, CFG.vocab, size=n)) for n in lengths]
+
+
+def _make_backend(kind, model, params, gen_cfg, layout="slab",
+                  max_len=16, **kw):
+    """A backend with the resident knobs threaded per kind: the ring
+    speaks ``resident_revolutions``, the single device
+    ``resident_chunks``."""
+    if layout == "paged":
+        kw.setdefault("kv_block_size", 4)
+        kw.setdefault("prefill_chunk", 4)
+    else:
+        kw.setdefault("buckets", BucketSpec.of(4, 8))
+    if kind == "single":
+        kw.setdefault("num_slots", 2)
+        return SingleDeviceSlotBackend(model, params, max_len=max_len,
+                                       gen=gen_cfg, **kw)
+    if "resident_chunks" in kw:
+        kw["resident_revolutions"] = kw.pop("resident_chunks")
+    kw.pop("num_slots", None)
+    sp, pre, post = params
+    mesh = make_mesh(2, 1)
+    return RingSlotBackend(mesh, model, stack_stage_params(sp), pre, post,
+                           max_len=max_len, gen=gen_cfg, **kw)
+
+
+def _drive_staggered(backend, prompts, seed):
+    """Mid-flight arrivals: slot churn exercises relaunches with mixed
+    budgets, not one clean batch."""
+    eng = ServeEngine(backend)
+    ids = [eng.submit(prompts[0], seed=seed).id]
+    eng.tick()
+    ids += [eng.submit(p, seed=seed).id for p in prompts[1:]]
+    eng.run_until_idle()
+    return [list(eng.response(r).tokens) for r in ids]
+
+
+# ---------------------------------------------------------------------------
+# parity: resident loop vs the single-chunk tick path
+
+
+PARITY_CASES = [
+    ("single", "slab", 0.0), ("single", "slab", 0.8),
+    ("single", "paged", 0.0), ("single", "paged", 0.8),
+    ("ring", "slab", 0.0), ("ring", "slab", 0.8),
+    ("ring", "paged", 0.0), ("ring", "paged", 0.8),
+]
+PARITY_IDS = [f"{k}-{l}-{'greedy' if t == 0.0 else 'sampled'}"
+              for k, l, t in PARITY_CASES]
+
+
+@pytest.mark.parametrize("kind,layout,temp", PARITY_CASES, ids=PARITY_IDS)
+def test_resident_matches_single_chunk_tick(kind, layout, temp,
+                                            model_and_params):
+    """resident=True with a small horizon (forcing several launches)
+    emits bitwise the non-resident tick path; greedy additionally
+    re-pins the one-shot Generator directly."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=temp,
+                               top_k=12 if temp else None)
+    prompts = _mixed_prompts((3, 5, 4))
+
+    base = _make_backend(kind, model, params, gen_cfg, layout,
+                         resident=False)
+    ref = _drive_staggered(base, prompts, seed=7)
+    res = _make_backend(kind, model, params, gen_cfg, layout,
+                        resident=True, resident_chunks=3)
+    got = _drive_staggered(res, prompts, seed=7)
+    assert got == ref
+    if temp == 0.0:
+        refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=7)
+        for g, r in zip(got, refs):
+            np.testing.assert_array_equal(np.asarray(g), r)
+
+
+def test_resident_eos_retires_early(model_and_params):
+    """Device-side eos done-masking: the resident loop retires at the
+    EOS token with the same truncated output as the tick path."""
+    model, params = model_and_params
+    probe = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    prompts = _mixed_prompts((4, 6))
+    free = _one_shot_refs(model, params, prompts, probe, seed=7)
+    eos = int(free[0][2])   # a token greedy decoding actually emits
+
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=0.0,
+                               eos_token_id=eos)
+    base = _make_backend("single", model, params, gen_cfg,
+                         resident=False)
+    ref = _drive_staggered(base, prompts, seed=7)
+    res = _make_backend("single", model, params, gen_cfg,
+                        resident=True, resident_chunks=8)
+    got = _drive_staggered(res, prompts, seed=7)
+    assert got == ref
+    assert any(t and t[-1] == eos for t in got)
+
+
+# ---------------------------------------------------------------------------
+# the trace pin + host-sync accounting
+
+
+@pytest.mark.parametrize("kind", ["single", "ring"])
+def test_resident_traces_once_and_counts_host_syncs(kind,
+                                                    model_and_params):
+    """The resident whole-program traces exactly once across staggered
+    traffic and mixed prompt lengths, and every launch is one counted
+    host sync feeding the host-overhead-per-token gauge."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    prompts = _mixed_prompts((3, 5, 4, 7, 5))
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=7)
+
+    backend = _make_backend(kind, model, params, gen_cfg,
+                            resident=True, resident_chunks=4)
+    reg = get_registry()
+    counter = ("serve.engine.resident_traces" if kind == "single"
+               else "serve.ring.resident_traces")
+    traces0 = reg.counter(counter).value
+    syncs0 = reg.counter("serve.engine.host_syncs").value
+
+    got = _drive_staggered(backend, prompts, seed=7)
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(np.asarray(g), r)
+    assert reg.counter(counter).value - traces0 == 1
+    assert reg.counter("serve.engine.host_syncs").value - syncs0 >= 1
+    assert reg.gauge("serve.engine.host_overhead_per_token").value >= 0.0
+
+
+def test_regather_decision_stays_on_device(model_and_params):
+    """Paged resident: prefill arms the device regather flag (one host
+    decision per admission); steady-state resident ticks make ZERO.
+    The non-resident path decides once per tick — the host tax the
+    carry fold removes."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    reg = get_registry()
+
+    res = _make_backend("single", model, params, gen_cfg, "paged",
+                        resident=True, resident_chunks=1)
+    eng = ServeEngine(res)
+    eng.submit(_mixed_prompts((4,))[0], seed=7)
+    eng.submit(_mixed_prompts((5,))[0], seed=7)
+    eng.tick()          # prefills (arm the flag) + first launch
+    d0 = reg.counter("serve.kv.regather_host_decisions").value
+    eng.tick()
+    eng.tick()          # two steady-state ticks: no prefill
+    assert reg.counter("serve.kv.regather_host_decisions").value - d0 == 0
+    eng.run_until_idle()
+
+    base = _make_backend("single", model, params, gen_cfg, "paged",
+                         resident=False)
+    eng = ServeEngine(base)
+    eng.submit(_mixed_prompts((4,))[0], seed=7)
+    eng.submit(_mixed_prompts((5,))[0], seed=7)
+    eng.tick()
+    d0 = reg.counter("serve.kv.regather_host_decisions").value
+    eng.tick()
+    eng.tick()
+    assert reg.counter("serve.kv.regather_host_decisions").value - d0 == 2
+    eng.run_until_idle()
+
+
+def test_resident_early_exit_on_slot_free(model_and_params):
+    """Backend unit: with budgets [2, many] and an 8-chunk horizon the
+    device exits after chunk 2 (slot 0 done) — the readout is 2 chunks
+    wide and the early-exit counter ticks."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    backend = _make_backend("single", model, params, gen_cfg,
+                            resident=True, resident_chunks=8)
+    backend.prefill(0, _mixed_prompts((4,))[0], seed=7)
+    backend.prefill(1, _mixed_prompts((5,))[0], seed=7)
+    reg = get_registry()
+    exits0 = reg.counter("serve.engine.device_exits").value
+    toks, valid = backend.decode(np.array([True, True]),
+                                 budgets=np.array([2, 100], np.int32))
+    assert toks.shape == (2, 2)
+    assert valid.all()
+    assert reg.counter("serve.engine.device_exits").value - exits0 == 1
+
+
+# ---------------------------------------------------------------------------
+# speculative decode: acceptance parity + rollback
+
+
+SPEC_CASES = [("slab", 0.0), ("slab", 0.8), ("paged", 0.0),
+              ("paged", 0.8)]
+SPEC_IDS = [f"{l}-{'greedy' if t == 0.0 else 'sampled'}"
+            for l, t in SPEC_CASES]
+
+
+@pytest.mark.parametrize("layout,temp", SPEC_CASES, ids=SPEC_IDS)
+def test_speculative_decode_matches_generator(layout, temp,
+                                              model_and_params):
+    """K-token draft/verify: responses are bitwise the per-prompt
+    Generator output (rejections roll back exactly), and on draftable
+    (repetitive) text the lane emits more than one token per verify
+    round — the speedup the lane exists for."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=temp,
+                               top_k=12 if temp else None)
+    prompts = [[5, 6, 5, 6, 5, 6], [3, 3, 3, 3]]
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=11)
+
+    backend = _make_backend("single", model, params, gen_cfg, layout,
+                            max_len=24, resident=True,
+                            resident_chunks=4, spec_tokens=3)
+    reg = get_registry()
+    rounds0 = reg.counter("serve.engine.spec_rounds").value
+    emitted0 = reg.counter("serve.engine.spec_emitted").value
+
+    got = _drive_staggered(backend, prompts, seed=11)
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(np.asarray(g), r)
+    rounds = reg.counter("serve.engine.spec_rounds").value - rounds0
+    emitted = reg.counter("serve.engine.spec_emitted").value - emitted0
+    # each response's first token comes from prefill, not the spec lane
+    assert emitted >= sum(len(g) for g in got) - len(prompts)
+    assert rounds > 0 and emitted > rounds   # acceptance rate > 0
+
+
+# ---------------------------------------------------------------------------
+# knob validation: loud rejections, not silent fallbacks
+
+
+def test_resident_knob_validation(model_and_params):
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    with pytest.raises(ValueError, match="resident"):
+        _make_backend("single", model, params, gen_cfg,
+                      resident="yes")
+    with pytest.raises(ValueError, match="resident_chunks"):
+        _make_backend("single", model, params, gen_cfg,
+                      resident=True, resident_chunks=0)
+    with pytest.raises(ValueError, match="spec_tokens"):
+        _make_backend("single", model, params, gen_cfg,
+                      resident=True, spec_tokens=1)
+    with pytest.raises(ValueError, match="resident"):
+        _make_backend("single", model, params, gen_cfg,
+                      resident=False, spec_tokens=3)
+    # the ring's sampled key chain is not the Generator chain the spec
+    # lane replays — single-device only, rejected loudly
+    with pytest.raises(NotImplementedError, match="single-device"):
+        _make_backend("ring", model, params,
+                      GenerationConfig(max_new_tokens=4,
+                                       temperature=0.0, spec_tokens=3),
+                      resident=True)
+
+
+def test_spec_headroom_tightens_validate(model_and_params):
+    """spec_tokens=K writes K rows per verify round — K-1 rows of slack
+    must stay below max_len or the fixed-shape write would clamp.
+    validate() rejects at submit with the headroom named."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    backend = _make_backend("single", model, params, gen_cfg,
+                            max_len=24, resident=True, spec_tokens=3,
+                            buckets=BucketSpec.of(16))
+    eng = ServeEngine(backend)
+    with pytest.raises(ValueError, match="speculative headroom"):
+        eng.submit(list(range(1, 16)), max_new_tokens=8)
+    # the same request without the spec lane is servable
+    plain = _make_backend("single", model, params, gen_cfg,
+                          max_len=24, resident=True,
+                          buckets=BucketSpec.of(16))
+    ServeEngine(plain).submit(list(range(1, 16)), max_new_tokens=8)

@@ -371,6 +371,131 @@ def phases(n_stages: int = 4, chunks: int = 8, checkpoint: str = "never",
     return out
 
 
+def resident(num_slots: int = 2, max_len: int = 16,
+             resident_chunks: int = 4, spec_tokens: int = 3,
+             d_model: int = 32, d_ff: int = 64, n_layers: int = 4) -> dict:
+    """Census of the RESIDENT serve whole-program (PR 11 acceptance pin).
+
+    Lowers every resident decode program — single-device slab/paged,
+    each with and without the speculative lane, plus the ring's
+    slab/paged revolutions — and censuses its ``while`` bodies (the
+    steady-state loop and everything it calls) with the same
+    arity-based conditional classifier the phase audit uses.
+
+    The pin: ZERO dispatch conditionals (indexed, >=3-branch — what
+    ``lax.switch`` lowers to) anywhere in a steady-state body. The
+    paged carry's regather fold is a 2-branch ``lax.cond`` — a role
+    conditional, reported transparently; done-masking is pure masked
+    arithmetic (selects). ASSERTS the invariant and exits non-zero on
+    violation.
+    """
+    from pipe_tpu.utils.platform import force_cpu_platform
+    force_cpu_platform(8)
+
+    import jax
+    import jax.numpy as jnp
+
+    from pipe_tpu.inference import GenerationConfig
+    from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+    from pipe_tpu.parallel.mesh import make_mesh
+    from pipe_tpu.parallel.spmd import stack_stage_params
+    from pipe_tpu.serve import BucketSpec, SingleDeviceSlotBackend
+    from pipe_tpu.serve.ring import RingSlotBackend
+
+    cfg = LMConfig(vocab=128, d_model=d_model, nhead=4, d_ff=d_ff,
+                   n_layers=n_layers, seq_len=2 * max_len, dropout=0.0)
+    model = PipelinedLM(cfg, n_stages=2)
+    params = model.init(jax.random.key(0))
+    gen = GenerationConfig(max_new_tokens=max_len // 2, temperature=0.0,
+                           eos_token_id=1)
+
+    def single(layout, spec):
+        kw = dict(resident=True, resident_chunks=resident_chunks)
+        if spec:
+            kw["spec_tokens"] = spec_tokens
+        if layout == "paged":
+            kw.update(kv_block_size=4, prefill_chunk=4)
+        else:
+            kw["buckets"] = BucketSpec.of(max_len // 2)
+        b = SingleDeviceSlotBackend(model, params, num_slots=num_slots,
+                                    max_len=max_len, gen=gen, **kw)
+        live = jnp.zeros((num_slots,), bool)
+        budget = jnp.full((num_slots,), gen.max_new_tokens, jnp.int32)
+        if b.paged:
+            args = [b._block_stack, b._pre, b._post, b._pool_kv,
+                    jnp.asarray(b.pool.table), b._tok, b._pos,
+                    b._key_data, b._views, b._regather]
+        else:
+            args = [b._block_stack, b._pre, b._post, b._caches, b._tok,
+                    b._pos, b._key_data]
+        if spec:
+            args.append(b._hist)
+        args += [live, budget, jnp.int32(resident_chunks)]
+        return b._resident_jit.lower(*args).compile().as_text()
+
+    def ring(layout):
+        sp, pre, post = params
+        mesh = make_mesh(2, 1)
+        kw = dict(resident=True, resident_revolutions=resident_chunks)
+        if layout == "paged":
+            kw.update(kv_block_size=4, prefill_chunk=4)
+        else:
+            kw["buckets"] = BucketSpec.of(max_len // 2)
+        b = RingSlotBackend(mesh, model, stack_stage_params(sp), pre,
+                            post, max_len=max_len, gen=gen, **kw)
+        kind = "resident_paged" if b.paged else "resident"
+        n = b.n
+        args = [b._stage_params, b._pre, b._post, b._caches, b._h,
+                b._tok_ring, b._pos_local, jnp.int32(0),
+                jnp.asarray(b._admit), jnp.zeros((n,), jnp.int32),
+                jnp.asarray(b._tok_inject), jnp.asarray(b._plen),
+                jnp.asarray(b._key_data)]
+        if b.paged:
+            args.append(jnp.asarray(b.pool.table))
+        args += [jnp.full((n,), gen.max_new_tokens, jnp.int32),
+                 jnp.int32(resident_chunks)]
+        return b._build(kind).lower(*args).compile().as_text()
+
+    out = {"platform": "cpu8", "num_slots": num_slots,
+           "max_len": max_len, "resident_chunks": resident_chunks,
+           "spec_tokens": spec_tokens, "programs": {}}
+    violations = []
+    cases = [("single-slab", lambda: single("slab", False)),
+             ("single-paged", lambda: single("paged", False)),
+             ("single-slab-spec", lambda: single("slab", True)),
+             ("single-paged-spec", lambda: single("paged", True)),
+             ("ring-slab", lambda: ring("slab")),
+             ("ring-paged", lambda: ring("paged"))]
+    for name, build in cases:
+        hlo = build()
+        comps = _hlo_computations(hlo)
+        dispatch, role = _conditional_census(hlo)
+        bodies = {}
+        for body in comps.values():
+            for mt in re.finditer(r"body=%?([\w.\-]+)", body):
+                bodies[mt.group(1)] = None
+        per_body = {b_: _region_census(hlo, [b_]) for b_ in bodies}
+        bad = [b_ for b_, c in per_body.items()
+               if c["dispatch_conditionals"]]
+        if dispatch or bad:
+            violations.append(
+                f"{name}: dispatch conditional in resident program "
+                f"(whole={dispatch}, bodies={bad})")
+        if not bodies:
+            violations.append(
+                f"{name}: no while body found — resident loop missing?")
+        out["programs"][name] = {
+            "whole_program": {"dispatch_conditionals": dispatch,
+                              "role_conditionals": role,
+                              "whiles": len(re.findall(r" while\(",
+                                                       hlo))},
+            "steady_bodies": per_body,
+        }
+    out["violations"] = violations
+    out["ok"] = not violations
+    return out
+
+
 if __name__ == "__main__":
     kw = {}
     mode = audit
@@ -381,11 +506,14 @@ if __name__ == "__main__":
         if a == "--phases":
             mode = phases
             continue
+        if a == "--resident":
+            mode = resident
+            continue
         k, v = a.lstrip("-").split("=", 1)
         k = k.replace("-", "_")
         kw[k] = tuple(v.split(",")) if k == "schedules" else (
             v if k == "checkpoint" else int(v))
     res = mode(**kw)
     print(json.dumps(res))
-    if mode is phases and not res["ok"]:
+    if mode in (phases, resident) and not res["ok"]:
         sys.exit(1)

@@ -18,6 +18,11 @@ of record are the committed ``SERVE_r08.json``):
    (requests rot in the queue past their deadline and are reaped, or
    time out mid-decode after burning slot-steps). Goodput counts only
    tokens of requests that finished ``ok`` within their deadline.
+4. **Resident loop A/B** (``SERVE_r14.json``; ``--resident`` adds the
+   speculative section to the full run) — host-overhead-per-token and
+   tokens/s at equal live slots, single-chunk ticks vs the fused
+   ``lax.while_loop``, plus draft/verify acceptance on repetitive
+   prompts with the bitwise-Generator-parity bit reported.
 
 Usage:
   python tools/serve_bench.py            # full run, pretty JSON to stdout
@@ -211,6 +216,124 @@ def kv_ab_steady_state(model, params, slots, chunk, seed, *, ticks=8,
     return out, pool_blocks
 
 
+RES_HORIZON = 8
+
+
+def resident_steady_state(model, params, slots, seed, *, resident,
+                          rounds, reps=2):
+    """Steady-state tokens/s + host-overhead-per-token for one engine
+    at ``slots`` live slots, decode_chunk=1. ``rounds`` counts resident
+    launches; the non-resident engine runs ``rounds * RES_HORIZON``
+    single-chunk ticks so both cover the same token volume. Best of
+    ``reps`` measurement windows (tokens/s max, overhead min — both
+    reject scheduler noise in the same direction)."""
+    from pipe_tpu.obs.telemetry import get_registry
+    reg = get_registry()
+    tok_c = reg.counter("serve.engine.tokens")
+    host_t = reg.timer("serve.engine.host_sec")
+    sync_c = reg.counter("serve.engine.host_syncs")
+    gen_cfg = GenerationConfig(max_new_tokens=MAX_NEW, temperature=0.0)
+    kw = (dict(resident=True, resident_chunks=RES_HORIZON)
+          if resident else dict(resident=False))
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=slots, max_len=MAX_LEN, gen=gen_cfg,
+        buckets=BUCKETS, decode_chunk=1, **kw)
+    ticks = rounds if resident else rounds * RES_HORIZON
+    warm = 3 if resident else 3 * RES_HORIZON
+    per_slot = (warm + reps * ticks) * (RES_HORIZON if resident else 1)
+    n_req = slots * (4 + 2 * per_slot // MAX_NEW)
+    rng = np.random.RandomState(seed)
+    eng = ServeEngine(backend, RequestQueue(capacity=n_req + slots))
+    for p in make_prompts(n_req, rng):
+        eng.submit(p)
+    for _ in range(warm):
+        eng.tick()
+    assert eng.live_slots == slots
+    best_tps, best_oh, syncs_per_tok = 0.0, float("inf"), 0.0
+    for _ in range(reps):
+        n0, h0, s0 = tok_c.value, host_t.total, sync_c.value
+        t0 = time.monotonic()
+        for _ in range(ticks):
+            eng.tick()
+        dt = time.monotonic() - t0
+        assert eng.live_slots == slots      # the queue never ran dry
+        n = tok_c.value - n0
+        best_tps = max(best_tps, n / dt)
+        best_oh = min(best_oh, (host_t.total - h0) / max(n, 1))
+        syncs_per_tok = (sync_c.value - s0) / max(n, 1)
+    return {"tokens_s": round(best_tps, 1),
+            "host_overhead_per_token_us": round(best_oh * 1e6, 2),
+            "host_syncs_per_token": round(syncs_per_tok, 4),
+            "live_slots": slots}
+
+
+def resident_ab(model, params, slots, seed, *, rounds, reps=2):
+    """The PR 11 A/B: non-resident single-chunk ticks vs the resident
+    ``lax.while_loop`` at EQUAL live slots and equal token volume. The
+    resident loop's job is the host-overhead-per-token column; the
+    tokens/s column is the no-regression bar."""
+    non = resident_steady_state(model, params, slots, seed,
+                                resident=False, rounds=rounds, reps=reps)
+    res = resident_steady_state(model, params, slots, seed,
+                                resident=True, rounds=rounds, reps=reps)
+    return {
+        "horizon_chunks": RES_HORIZON,
+        "decode_chunk": 1,
+        "nonresident": non,
+        "resident": res,
+        "resident_vs_nonresident_tokens_s": round(
+            res["tokens_s"] / max(non["tokens_s"], 1e-9), 4),
+        "host_overhead_reduction": round(
+            non["host_overhead_per_token_us"]
+            / max(res["host_overhead_per_token_us"], 1e-9), 2),
+    }
+
+
+def spec_acceptance(model, params, seed, *, n_prompts=4, max_new=32,
+                    spec_tokens=3):
+    """Speculative lane on draftable (repetitive) prompts: bitwise
+    parity vs the per-prompt Generator, acceptance rate from the
+    engine's own round/emission counters."""
+    from pipe_tpu.obs.telemetry import get_registry
+    reg = get_registry()
+    rng = np.random.RandomState(seed)
+    prompts = []
+    for _ in range(n_prompts):
+        pair = rng.randint(1, CFG.vocab, size=2).tolist()
+        prompts.append(pair * 4)
+    gen_cfg = GenerationConfig(max_new_tokens=max_new, temperature=0.0)
+    g = Generator(model, gen_cfg)
+    refs = [np.asarray(g.generate(
+        params, jnp.asarray(p, jnp.int32)[None],
+        jax.random.key(seed + i)))[0] for i, p in enumerate(prompts)]
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=2, max_len=MAX_LEN, gen=gen_cfg,
+        buckets=BUCKETS, resident=True, resident_chunks=RES_HORIZON,
+        spec_tokens=spec_tokens)
+    rounds0 = reg.counter("serve.engine.spec_rounds").value
+    emitted0 = reg.counter("serve.engine.spec_emitted").value
+    eng = ServeEngine(backend)
+    resps = eng.serve(prompts,
+                      seeds=[seed + i for i in range(n_prompts)])
+    equal = all(
+        np.array_equal(np.asarray(r.tokens), ref)
+        for r, ref in zip(resps, refs))
+    rounds = reg.counter("serve.engine.spec_rounds").value - rounds0
+    emitted = reg.counter("serve.engine.spec_emitted").value - emitted0
+    return {
+        "spec_tokens": spec_tokens,
+        "prompts": n_prompts,
+        "max_new_tokens": max_new,
+        "bitwise_equal_to_generator": bool(equal),
+        "verify_rounds": int(rounds),
+        "tokens_emitted": int(emitted),
+        "tokens_per_round": round(emitted / max(rounds, 1), 3),
+        # accepted drafts per offered draft (K-1 offered per round)
+        "acceptance_rate": round(
+            (emitted - rounds) / max(rounds * (spec_tokens - 1), 1), 4),
+    }
+
+
 def drive_poisson(eng, prompts, arrivals, *, max_new, deadline_s):
     """Feed the engine a precomputed arrival schedule against the wall
     clock; tick until drained. Returns (responses, elapsed, rejected)."""
@@ -295,6 +418,10 @@ def main():
     ap.add_argument("--kv", choices=("slab", "paged"), default="slab",
                     help="KV memory for the steady-state/latency "
                          "sections (the kv A/B section always runs both)")
+    ap.add_argument("--resident", action="store_true",
+                    help="full-size resident A/B + speculative-decode "
+                         "section (quick mode always runs a small "
+                         "resident A/B for the CI embed)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -351,6 +478,22 @@ def main():
         f"{kv_paged_2x['tokens_s']:.1f} tok/s @ {2 * slots} slots on the "
         f"same memory (hit rate {ab['prefix_hit_rate']:.3f})")
 
+    # Resident loop A/B at equal live slots and equal token volume:
+    # host-overhead-per-token is the number the fused loop exists to
+    # shrink; tokens/s is the no-regression bar. Forced on explicitly —
+    # "auto" keeps cpu on the single-chunk path, so this measures the
+    # mechanism the accelerator default gets.
+    log("resident A/B: single-chunk ticks vs the fused device loop...")
+    res_ab = resident_ab(model, params, slots, args.seed + 4,
+                         rounds=4 if args.quick else 10,
+                         reps=2 if args.quick else 3)
+    log(f"  non-resident {res_ab['nonresident']['tokens_s']:.1f} tok/s @ "
+        f"{res_ab['nonresident']['host_overhead_per_token_us']:.1f} "
+        f"us/tok host; resident {res_ab['resident']['tokens_s']:.1f} "
+        f"tok/s @ {res_ab['resident']['host_overhead_per_token_us']:.1f} "
+        f"us/tok ({res_ab['host_overhead_reduction']:.1f}x less host, "
+        f"{res_ab['resident_vs_nonresident_tokens_s']:.3f}x tokens/s)")
+
     # capacity in requests/s at the bench's request size
     max_new = MAX_NEW
     cap_req_s = serve_tps / max_new
@@ -375,6 +518,7 @@ def main():
         "steady_state_tokens_s": round(serve_tps, 1),
         "serve_vs_fixed_batch": round(ratio, 4),
         "kv_ab": kv_ab,
+        "resident_ab": res_ab,
         "poisson_0p7": moderate,
     }
     if args.quick:
@@ -389,8 +533,20 @@ def main():
             "kv_paged_2x_vs_slab": kv_ab["paged_2x_vs_slab"],
             "kv_live_slot_gain": kv_ab["live_slot_gain_same_memory"],
             "kv_prefix_hit_rate": kv_ab["prefix_hit_rate"],
+            "resident_vs_nonresident_tokens_s":
+                res_ab["resident_vs_nonresident_tokens_s"],
+            "host_overhead_reduction":
+                res_ab["host_overhead_reduction"],
         }))
         return
+
+    if args.resident:
+        log("speculative decode: draft/verify on repetitive prompts...")
+        spec = spec_acceptance(model, params, args.seed + 5)
+        summary["speculative"] = spec
+        log(f"  bitwise={spec['bitwise_equal_to_generator']} "
+            f"acceptance={spec['acceptance_rate']:.3f} "
+            f"({spec['tokens_per_round']:.2f} tokens/verify-round)")
 
     # 2x overload: backpressure bounds the queue so the engine only
     # accepts what it can finish inside the deadline; without it the
