@@ -521,17 +521,22 @@ def main():
     except Exception as e:
         print(f"chaos probe failed: {e}", file=sys.stderr)
 
-    # Fleet probe: replica-count goodput scaling plus the kill-one-of-3
-    # failover proof (drop <= ~1/N, recovery, exactly-once ledger) —
-    # fleet_ok must stay true every round (quick mode of
-    # tools/fleet_bench.py; FLEET_r{N}.json is the full record).
+    # Fleet probe: replica-count goodput scaling plus the
+    # kill-one-of-3 failover proof over REAL child processes (SIGKILL
+    # a replica process mid-stream: recovery + exactly-once ledger),
+    # the async-tick straggler win, and the session-remap KV handoff
+    # TTFT — fleet_ok must stay true every round (quick mode of
+    # tools/fleet_bench.py --fleet proc; FLEET_r{N}.json is the full
+    # record).
     fleet_summary = None
     try:
         import subprocess
         env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
         out = subprocess.run(
             [sys.executable, os.path.join(here, "tools",
-                                          "fleet_bench.py"), "--quick"],
+                                          "fleet_bench.py"), "--quick",
+             "--fleet", "proc",
+             "--out", os.path.join(here, "FLEET_r15.json")],
             capture_output=True, text=True, timeout=900, env=env)
         if out.returncode == 0:
             fleet_summary = json.loads(out.stdout.strip().splitlines()[-1])
@@ -540,6 +545,14 @@ def main():
                   f"{out.stderr[-2000:]}", file=sys.stderr)
     except Exception as e:
         print(f"fleet probe failed: {e}", file=sys.stderr)
+    if fleet_summary is not None:
+        # Per-replica tick threads exist to confine a straggler's
+        # stall to its own replica; at N=3 with one straggler the
+        # async fleet must not LOSE steady-state goodput to the
+        # serial tick loop.
+        assert fleet_summary["async_beats_serial"], (
+            "async-tick fleet goodput fell below the serial tick loop "
+            f"at N=3: {fleet_summary['async_speedup']}x")
 
     # Elastic probe: kill 1 of 4 stages mid-run -> heartbeat detection,
     # re-plan to 3, buddy restore, and the bitwise pin against the

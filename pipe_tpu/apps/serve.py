@@ -14,13 +14,25 @@ rejected counters, TTFT percentiles, queue-depth/occupancy gauges).
 (docs/observability.md).
 
 ``--replicas N`` (with ``--stages 1``) serves through the fleet
-:class:`~pipe_tpu.serve.Router` instead: N engine replicas behind one
-front queue with health-gated failover; the summary gains per-replica
-lines and a fleet rollup, and SIGTERM drains the whole fleet.
+instead: N replicas behind one front queue with health-gated failover;
+the summary gains per-replica lines and a fleet rollup, and SIGTERM
+drains the whole fleet. ``--fleet`` picks the replica transport:
+
+* ``inproc`` (default) — engine replicas in this process, ticked
+  serially by the router (the PR 7 behavior, byte-for-byte);
+* ``thread`` — same engines, each under its own tick thread
+  (``Router(async_tick=True)``): a slow replica no longer stalls its
+  siblings' decode loops;
+* ``proc`` — each replica a real OS process
+  (:class:`~pipe_tpu.fleet.ProcessReplicaTransport`) with its own
+  engine/jit cache/KV pool behind a length-prefixed socket protocol;
+  needs ``--family lm`` without ``--resume``/``--spec-tokens`` (the
+  child rebuilds the model from the spec + seed).
 
 Usage:
     python -m pipe_tpu.apps.serve [--resume DIR] [--requests N --rate R]
         [--prompts-file F] [--slots S] [--stages N] [--replicas N]
+        [--fleet inproc|thread|proc]
         [--eos ID] [--queue-capacity C] [--policy fifo|priority]
         [--timeout-s T] [--decode-chunk K] [--events F.jsonl] [--tiny]
         [--resident auto|on|off] [--resident-chunks R] [--spec-tokens K]
@@ -61,6 +73,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help=">1: run N engine replicas behind the fleet "
                         "Router (health-gated failover; single-device "
                         "backend only)")
+    p.add_argument("--fleet", choices=["inproc", "thread", "proc"],
+                   default="inproc",
+                   help="replica transport with --replicas > 1: same-"
+                        "process serial ticks (inproc), same-process "
+                        "with one tick thread per replica (thread), or "
+                        "one OS process per replica (proc)")
     p.add_argument("--slots", type=int, default=4,
                    help="decode slots (single-device backend; the ring "
                         "always has one slot per stage)")
@@ -227,11 +245,43 @@ def main(argv=None) -> int:
         return TickWatchdog(tick_budget_s=args.tick_budget_s,
                             shed_ewma_threshold=args.shed_ewma)
 
-    if replicas > 1:
-        # fleet path: one front queue, N engines each with its own
+    if replicas > 1 and args.fleet == "proc":
+        # process fleet: each replica a fresh interpreter built from a
+        # plain-data spec — only the deterministic-init lm family can be
+        # reconstructed child-side.
+        if args.family != "lm" or args.resume or args.spec_tokens:
+            print("--fleet proc requires --family lm without --resume/"
+                  "--spec-tokens (children rebuild the model from the "
+                  "spec + seed)", file=sys.stderr)
+            return 2
+        from ..fleet import (FleetController, ProcessReplicaTransport,
+                             ReplicaSpec, RouterPolicy)
+        spec = ReplicaSpec(
+            lm_cfg={f: getattr(model_cfg, f)
+                    for f in ("vocab", "d_model", "nhead", "d_ff",
+                              "n_layers", "dropout", "seq_len")},
+            n_stages=1, init_seed=args.seed, num_slots=args.slots,
+            max_len=max_len, buckets=list(buckets.lengths),
+            decode_chunk=args.decode_chunk,
+            queue_capacity=args.queue_capacity,
+            gen=dict(max_new_tokens=args.max_new,
+                     temperature=args.temperature, top_k=args.top_k,
+                     eos_token_id=args.eos),
+            **({"kv_block_size": args.kv_block_size,
+                "kv_pool_blocks": args.kv_pool_blocks}
+               if args.kv == "paged" else {}))
+        transports = [ProcessReplicaTransport(spec)
+                      for _ in range(replicas)]
+        queue = RequestQueue(capacity=args.queue_capacity,
+                             policy=args.policy)
+        eng = FleetController(transports, queue, policy=RouterPolicy(),
+                              event_log=events)
+    elif replicas > 1:
+        # in-process fleet: one front queue, N engines each with its own
         # queue/watchdog, the Router in between. The single-replica path
         # below stays byte-for-byte what it was — Router absent means
-        # zero overhead.
+        # zero overhead. --fleet thread gives each replica its own tick
+        # thread; placement/health/delivery stay on the caller's thread.
         from ..serve import Router, SingleDeviceSlotBackend
         backends = [backend] + [
             SingleDeviceSlotBackend(
@@ -248,7 +298,8 @@ def main(argv=None) -> int:
                    for b in backends]
         queue = RequestQueue(capacity=args.queue_capacity,
                              policy=args.policy)
-        eng = Router(engines, queue, event_log=events)
+        eng = Router(engines, queue, event_log=events,
+                     async_tick=(args.fleet == "thread"))
     else:
         queue = RequestQueue(capacity=args.queue_capacity,
                              policy=args.policy)
@@ -312,7 +363,8 @@ def main(argv=None) -> int:
     snap = {k: v for k, v in get_registry().scalars().items()
             if k.startswith(("serve.", "resilience."))}
     summary = {
-        "backend": (f"Router({type(backend).__name__} x {replicas})"
+        "backend": (f"Fleet[{args.fleet}]({type(backend).__name__} x "
+                    f"{replicas})"
                     if replicas > 1 else type(backend).__name__),
         "finished": done, "rejected": rejected,
         "drained": eng.draining,
@@ -322,13 +374,21 @@ def main(argv=None) -> int:
             1e6 * host_overhead_per_token(), 2),
         "buckets": list(buckets.lengths), "metrics": snap}
     if replicas > 1:
+        def _rep_line(rep):
+            line = {"replica": rep.index, "state": rep.state}
+            try:
+                # transport surfaces work for in-process AND process
+                # replicas (a retired process transport may be gone)
+                line["queue_depth"] = rep.transport.queue_depth
+                line["live_slots"] = rep.transport.live_slots
+            except Exception:
+                line["queue_depth"] = line["live_slots"] = None
+            return line
         summary["fleet"] = {
+            "transport": args.fleet,
             "rollup": eng.counts(),
-            "per_replica": [
-                {"replica": rep.index, "state": rep.state,
-                 "queue_depth": rep.engine.queue.depth,
-                 "live_slots": rep.engine.live_slots}
-                for rep in eng.replicas]}
+            "per_replica": [_rep_line(rep) for rep in eng.replicas]}
+        eng.close()   # stops tick threads / shuts replica processes down
     print(json.dumps({"summary": summary}))
     events.close()
     return 0

@@ -1,0 +1,41 @@
+"""pipe_tpu.fleet — the process-separated serving fleet.
+
+The coordination plane for N serve-engine replicas, split from the
+transport that reaches them:
+
+* :mod:`.control` — the transport-agnostic control plane.
+  :class:`~.control.FleetController` owns placement, the
+  HEALTHY→SUSPECT→WEDGED→DRAINING→RETIRED health machine, retry
+  budgets and the exactly-once delivery ledger — everything
+  ``serve/router.py`` proved in-process, now speaking to replicas only
+  through the :class:`~.control.ReplicaTransport` interface.
+  :class:`~.control.InProcessTransport` preserves the PR 7 behavior
+  byte-for-byte (serial ticks) and adds an async mode (one tick thread
+  per replica, so a slow replica no longer stalls its siblings).
+* :mod:`.proc` — :class:`~.proc.ProcessReplicaTransport`: each replica
+  a real OS process owning its own engine, jit cache and KV pool,
+  speaking a length-prefixed msgpack/JSON wire protocol with
+  heartbeats that carry the health signals across the IPC boundary.
+* :mod:`.topology` — carve a dp×pp sub-mesh per replica from the
+  global device set ("model parallel between nodes is bad": a replica
+  never spans a host).
+
+``serve/router.py``'s :class:`~..serve.router.Router` is now a thin
+shim over this package — existing callers and the pinned
+``tests/test_router.py`` suite are unchanged. See ``docs/fleet.md``.
+"""
+
+from .control import (DRAINING, HEALTHY, RETIRED, SUSPECT, WEDGED,
+                      FleetController, InProcessTransport, Replica,
+                      ReplicaHealth, ReplicaTransport, RouterPolicy,
+                      TransportError)
+from .proc import (FleetSpawnError, ProcessReplicaTransport, ReplicaSpec,
+                   check_spawn_capability)
+from .topology import carve_replica_meshes, replica_device_plan
+
+__all__ = ["FleetController", "ReplicaTransport", "InProcessTransport",
+           "Replica", "ReplicaHealth", "RouterPolicy", "TransportError",
+           "ProcessReplicaTransport", "ReplicaSpec", "FleetSpawnError",
+           "check_spawn_capability", "carve_replica_meshes",
+           "replica_device_plan",
+           "HEALTHY", "SUSPECT", "WEDGED", "DRAINING", "RETIRED"]

@@ -1,0 +1,1145 @@
+"""Fleet control plane: placement, health, exactly-once — transport-split.
+
+PR 7's Router proved the fleet state machine against in-process engine
+replicas driven by one serial host loop. This module is that machine
+extracted from its transport, so the same implementation coordinates
+in-process engines (byte-for-byte the PR 7 behavior, pinned by
+``tests/test_router.py``) and real OS processes (:mod:`.proc`):
+
+* :class:`ReplicaTransport` — everything the control plane needs from a
+  replica: place/poll/evict/drain/cancel, a queue-pressure surface for
+  placement, a :class:`ReplicaHealth` snapshot (the watchdog signals,
+  shipped as heartbeat payload when an IPC boundary intervenes), and
+  the KV-handoff hooks (export/import/invalidate prefix blocks).
+* :class:`InProcessTransport` — wraps one :class:`~..serve.engine
+  .ServeEngine`. Serial mode (default): ``poll()`` *is* ``engine.tick()``
+  — the control loop drives the replica, exactly the PR 7 round-robin.
+  Async mode (``async_tick=True``): a daemon thread ticks the engine
+  continuously under a per-replica lock and ``poll()`` merely drains
+  finished responses, so one slow replica no longer stalls its siblings
+  (a process replica ticks *itself* — same contract, different
+  mechanism).
+* :class:`FleetController` — the state machine itself. Health states::
+
+      HEALTHY --(slow streak / decode error / retryable failure)--> SUSPECT
+      SUSPECT --(recover_healthy_ticks clean ticks)--> HEALTHY
+      HEALTHY|SUSPECT --(wedge thresholds / heartbeat loss)--> WEDGED
+      WEDGED --(queued work evicted, drain() issued)--> DRAINING
+      DRAINING --(transport.drained)--> RETIRED
+
+  plus the retry-parking/backoff machinery and the exactly-once
+  delivery ledger. **The ledger lives here**, never in a transport: a
+  transport may die mid-flight (socket drop, child crash) and the
+  controller reclaims the in-flight requests it placed there
+  (``_placed_on`` is the authoritative in-flight map), re-places them
+  under ``Request.attempts``, and still delivers every id exactly once
+  — a duplicate terminal response raises.
+
+KV handoff is real here, not just counters: when a session remaps off
+its home replica, the controller asks the old home's transport to
+export the session's cached shared-prefix blocks (serialized through
+the int8 path when they cross a process boundary — see
+``serve/engine.py:export_prefix_payload``) and seats them into the
+destination pool before the request is placed, so the destination
+prefill resumes from the shipped blocks instead of recomputing them.
+The warm/cold classification still probes the destination *before* the
+import — it records what the handoff cost (shipping vs. nothing), and
+keeps the ``serve.fleet.kv_handoff_*`` counter semantics of PR 10.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..obs.events import NULL_EVENT_LOG, REQUEST
+from ..obs.telemetry import get_registry, labelled
+
+if False:  # type-hint names only — the runtime imports are lazy because
+    # serve/__init__ imports router which imports THIS module: a
+    # top-level serve import here deadlocks whichever package the user
+    # imports first (fleet-first and serve-first must both work)
+    from ..serve.engine import ServeEngine  # noqa: F401
+    from ..serve.queue import Request, RequestQueue, Response  # noqa: F401
+
+__all__ = ["FleetController", "ReplicaTransport", "InProcessTransport",
+           "Replica", "ReplicaHealth", "RouterPolicy", "TransportError",
+           "HEALTHY", "SUSPECT", "WEDGED", "DRAINING", "RETIRED",
+           "RETRYABLE_REASONS"]
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+WEDGED = "wedged"
+DRAINING = "draining"
+RETIRED = "retired"
+STATES = (HEALTHY, SUSPECT, WEDGED, DRAINING, RETIRED)
+_STATE_CODE = {s: i for i, s in enumerate(STATES)}
+
+# Engine finish_reasons the controller may retry on another replica;
+# every other terminal outcome is delivered as-is.
+RETRYABLE_REASONS = ("backend_error", "stuck")
+
+
+class TransportError(RuntimeError):
+    """The transport to a replica died (socket drop, child crash,
+    heartbeat loss). Raised by transport methods; the controller
+    responds by reclaiming every request in flight on that replica and
+    retiring it — the replica itself may be perfectly healthy, but
+    unreachable is indistinguishable from dead."""
+
+
+@dataclasses.dataclass
+class ReplicaHealth:
+    """One replica's health signals, as the control plane sees them.
+    For an in-process replica these are live reads of the engine's
+    watchdog surface; for a process replica they are the most recent
+    heartbeat payload — the same fields, surviving the IPC boundary.
+    ``heartbeat_age_s`` is 0.0 in-process (every read is fresh)."""
+
+    slow_streak: int = 0
+    miss_ewma: float = 0.0
+    stuck_slots: int = 0
+    consecutive_decode_errors: int = 0
+    heartbeat_age_s: float = 0.0
+    alive: bool = True
+
+
+@dataclasses.dataclass
+class RouterPolicy:
+    """Fleet policy knobs. Defaults are deliberately conservative —
+    quick to stop placing on a sick replica (SUSPECT is cheap: work
+    just goes elsewhere), slow to wedge (WEDGED is one-way).
+
+    ``placement`` — ``least_loaded`` picks the replica with the fewest
+    queued+live requests (ties: lowest index); ``session`` pins each
+    ``session`` key to its first replica while that replica is HEALTHY
+    (KV-cache/prefix locality for multi-turn traffic) and falls back to
+    least-loaded — remapping the session — when it isn't.
+
+    ``retry_budget`` — max *placements* per request (``Request.attempts``
+    is the ledger); a retryable failure at ``attempts >= retry_budget``
+    is terminal. ``backoff_base_s``/``backoff_max_s`` shape the parked
+    delay ``min(base * 2^(attempts-1), max)``; base 0 retries on the
+    next tick (what deterministic fake-clock tests want — a parked
+    request is only eligible once the queue clock passes its delay).
+
+    SUSPECT triggers: ``suspect_slow_streak`` consecutive over-budget
+    ticks (watchdog), any decode error, any retryable failure this
+    tick, or ``suspect_miss_ewma`` (None disables the EWMA trigger).
+    ``recover_healthy_ticks`` clean ticks clear SUSPECT. WEDGE
+    triggers: ``wedge_slow_streak`` consecutive slow ticks,
+    ``wedge_decode_errors`` consecutive decode errors (keep it below
+    the engine's ``decode_error_limit``, which resets the streak), or
+    ``wedge_error_ticks`` *cumulative* ticks that produced retryable
+    failures (catches prefill-side death, where no decode streak ever
+    forms). ``heartbeat_timeout_s`` (None disables) wedges a replica
+    whose health snapshot is older than this — the IPC analog of a
+    slow streak: an unreachable replica must not hold its queue.
+
+    Lifecycle: ``spawn_depth``/``spawn_sustain_ticks``/``max_replicas``
+    gate the spawn hook; ``retire_idle_ticks``/``min_replicas`` gate
+    idle retirement (None disables).
+    """
+
+    placement: str = "least_loaded"
+    retry_budget: int = 3
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    suspect_slow_streak: int = 2
+    suspect_miss_ewma: Optional[float] = None
+    recover_healthy_ticks: int = 3
+    wedge_slow_streak: int = 6
+    wedge_decode_errors: int = 2
+    wedge_error_ticks: int = 3
+    heartbeat_timeout_s: Optional[float] = None
+    spawn_depth: Optional[int] = None
+    spawn_sustain_ticks: int = 10
+    max_replicas: int = 8
+    retire_idle_ticks: Optional[int] = None
+    min_replicas: int = 1
+
+    def __post_init__(self):
+        if self.placement not in ("least_loaded", "session"):
+            raise ValueError(
+                f"placement must be least_loaded|session, got "
+                f"{self.placement!r}")
+        if self.retry_budget < 1:
+            raise ValueError(
+                f"retry_budget must be >= 1, got {self.retry_budget}")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("backoff seconds must be >= 0")
+        if self.heartbeat_timeout_s is not None \
+                and self.heartbeat_timeout_s <= 0:
+            raise ValueError("heartbeat_timeout_s must be > 0 or None")
+        for fld in ("suspect_slow_streak", "recover_healthy_ticks",
+                    "wedge_slow_streak", "wedge_decode_errors",
+                    "wedge_error_ticks", "spawn_sustain_ticks",
+                    "max_replicas", "min_replicas"):
+            if getattr(self, fld) < 1:
+                raise ValueError(f"{fld} must be >= 1")
+
+
+# ---------------------------------------------------------------------------
+# the transport interface
+
+
+class ReplicaTransport:
+    """What the control plane needs from one replica — nothing more.
+
+    Implementations: :class:`InProcessTransport` (an engine in this
+    process), :class:`~.proc.ProcessReplicaTransport` (a real OS
+    process on the wire). Any method may raise :class:`TransportError`
+    when the replica becomes unreachable; the controller reclaims and
+    retires.
+
+    ``rpc_inflight``/``rpc_retries`` are wire-level telemetry
+    (0 in-process); they surface through the per-replica labelled
+    gauges the controller exports every tick.
+    """
+
+    rpc_inflight: int = 0
+    rpc_retries: int = 0
+
+    # -- work ------------------------------------------------------------
+    def place(self, req: Request) -> None:
+        """Admit an existing request (increments ``req.attempts``).
+        Raises like ``ServeEngine.place``: ``EngineDraining``,
+        ``ValueError``, ``QueueFull`` — or :class:`TransportError`."""
+        raise NotImplementedError
+
+    def poll(self) -> List[Response]:
+        """Advance the replica if this transport drives it (serial
+        in-process mode) and return the terminal responses that
+        finished since the last poll."""
+        raise NotImplementedError
+
+    def evict_queued(self) -> List[Union[Request, int]]:
+        """Remove and return the replica's queued (not live) requests —
+        as :class:`Request` objects when the transport holds them, or
+        as request ids the controller resolves against its ledger."""
+        raise NotImplementedError
+
+    def cancel(self, request_id: int) -> bool:
+        raise NotImplementedError
+
+    # -- lifecycle -------------------------------------------------------
+    def drain(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def drained(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def idle(self) -> bool:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release transport resources (kill threads/processes)."""
+
+    # -- placement surface ----------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def queue_capacity(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def live_slots(self) -> int:
+        raise NotImplementedError
+
+    # -- admission validation -------------------------------------------
+    def validate(self, prompt_len: int, max_new_tokens: int) -> None:
+        raise NotImplementedError
+
+    @property
+    def default_max_new_tokens(self) -> int:
+        raise NotImplementedError
+
+    # -- health ----------------------------------------------------------
+    def health(self) -> ReplicaHealth:
+        raise NotImplementedError
+
+    # -- KV handoff (paged pools only; every hook may no-op) -------------
+    def export_prefix(self, prompt: Sequence[int]) -> Optional[dict]:
+        """Serialize the cached shared-prefix blocks covering
+        ``prompt`` (None when the backend has no pool / no hits)."""
+        return None
+
+    def import_prefix(self, payload: dict) -> int:
+        """Seat an exported payload into this replica's pool; returns
+        blocks seated (0 when unsupported)."""
+        return 0
+
+    def invalidate_prefix(self, prompt: Sequence[int]) -> int:
+        """Drop this replica's cached prefix entries for ``prompt``;
+        returns entries invalidated."""
+        return 0
+
+    def cached_prefix_blocks(self, prompt: Sequence[int]) -> int:
+        """Leading full prompt blocks already cached here (the
+        warm-handoff probe)."""
+        return 0
+
+
+class InProcessTransport(ReplicaTransport):
+    """One :class:`~..serve.engine.ServeEngine` behind the transport
+    interface.
+
+    Serial mode (default) is the PR 7 contract verbatim: the controller
+    calls ``poll()`` once per fleet tick and that call runs
+    ``engine.tick()`` — single-threaded, deterministic, what the pinned
+    router tests drive with a fake clock.
+
+    ``async_tick=True`` starts a daemon thread that ticks the engine
+    whenever it has work; ``poll()`` just drains the finished-response
+    buffer. Every engine call (tick/place/evict/drain) is serialized
+    under one per-replica lock, so the engine itself stays
+    single-threaded — the thread merely moves WHOSE loop runs it. A
+    wedged or slow replica then blocks only its own thread.
+    """
+
+    def __init__(self, engine: ServeEngine, *, async_tick: bool = False,
+                 tick_interval_s: float = 0.0):
+        self.engine = engine
+        self.async_tick = bool(async_tick)
+        self._lock = threading.Lock()
+        self._buffer: "deque[Response]" = deque()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._tick_interval_s = tick_interval_s
+        if self.async_tick:
+            self._thread = threading.Thread(
+                target=self._tick_loop, name="fleet-replica-tick",
+                daemon=True)
+            self._thread.start()
+
+    # -- async tick loop -------------------------------------------------
+
+    def _tick_loop(self) -> None:
+        while not self._stop.is_set():
+            did_work = False
+            with self._lock:
+                eng = self.engine
+                if not eng.idle or (eng.draining and not eng.drained):
+                    self._buffer.extend(eng.tick())
+                    did_work = True
+            if not did_work:
+                time.sleep(0.001)
+            elif self._tick_interval_s:
+                time.sleep(self._tick_interval_s)
+
+    # -- work ------------------------------------------------------------
+
+    def place(self, req: Request) -> None:
+        with self._lock:
+            self.engine.place(req)
+
+    def poll(self) -> List[Response]:
+        if self.async_tick:
+            out = []
+            while self._buffer:
+                out.append(self._buffer.popleft())
+            return out
+        return self.engine.tick()
+
+    def evict_queued(self) -> List[Request]:
+        with self._lock:
+            return self.engine.evict_queued()
+
+    def cancel(self, request_id: int) -> bool:
+        return self.engine.cancel(request_id)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def drain(self) -> None:
+        with self._lock:
+            self.engine.drain()
+
+    @property
+    def drained(self) -> bool:
+        if self.async_tick:
+            with self._lock:
+                return self.engine.drained and not self._buffer
+        return self.engine.drained
+
+    @property
+    def idle(self) -> bool:
+        # pending async responses still count as work for the fleet —
+        # and the async read must hold the tick lock: mid-tick the
+        # engine can look idle (last slot retired) BEFORE the response
+        # reaches the buffer, and an unlocked read of that instant
+        # would let the controller conclude the fleet is done
+        if self.async_tick:
+            with self._lock:
+                return self.engine.idle and not self._buffer
+        return self.engine.idle
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
+    # -- placement surface ----------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return self.engine.queue.depth
+
+    @property
+    def queue_capacity(self) -> int:
+        return self.engine.queue.capacity
+
+    @property
+    def live_slots(self) -> int:
+        return self.engine.live_slots
+
+    # -- admission validation -------------------------------------------
+
+    def validate(self, prompt_len: int, max_new_tokens: int) -> None:
+        self.engine.backend.validate(prompt_len, max_new_tokens)
+
+    @property
+    def default_max_new_tokens(self) -> int:
+        return self.engine.backend.gen.max_new_tokens
+
+    # -- health ----------------------------------------------------------
+
+    def health(self) -> ReplicaHealth:
+        wd = self.engine.watchdog
+        return ReplicaHealth(
+            slow_streak=wd.slow_streak if wd is not None else 0,
+            miss_ewma=wd.miss_ewma if wd is not None else 0.0,
+            stuck_slots=wd.stuck_slots if wd is not None else 0,
+            consecutive_decode_errors=(
+                self.engine.consecutive_decode_errors),
+            heartbeat_age_s=0.0, alive=True)
+
+    # -- KV handoff ------------------------------------------------------
+
+    def export_prefix(self, prompt: Sequence[int]) -> Optional[dict]:
+        exp = getattr(self.engine.backend, "export_prefix_payload", None)
+        if exp is None:
+            return None
+        # in-process: exact bytes (codec="raw"), no lossy serialization
+        return exp(prompt, codec="raw")
+
+    def import_prefix(self, payload: dict) -> int:
+        imp = getattr(self.engine.backend, "import_prefix_payload", None)
+        if imp is None:
+            return 0
+        with self._lock:
+            return imp(payload)
+
+    def invalidate_prefix(self, prompt: Sequence[int]) -> int:
+        pool = getattr(self.engine.backend, "pool", None)
+        if pool is None:
+            return 0
+        with self._lock:
+            return pool.invalidate(pool.prefix_hashes(prompt))
+
+    def cached_prefix_blocks(self, prompt: Sequence[int]) -> int:
+        pool = getattr(self.engine.backend, "pool", None)
+        if pool is None:
+            return 0
+        return pool.cached_prefix_blocks(prompt)
+
+
+# ---------------------------------------------------------------------------
+# replica record
+
+
+class Replica:
+    """Controller-side record of one replica: health state plus the
+    hysteresis counters the state machine runs on. ``engine`` is the
+    in-process convenience accessor (None for a process replica)."""
+
+    __slots__ = ("index", "transport", "state", "healthy_streak",
+                 "idle_ticks", "error_ticks", "had_error_this_tick")
+
+    def __init__(self, index: int, transport: ReplicaTransport):
+        self.index = index
+        self.transport = transport
+        self.state = HEALTHY
+        self.healthy_streak = 0
+        self.idle_ticks = 0
+        self.error_ticks = 0          # cumulative ticks with retryable fails
+        self.had_error_this_tick = False
+
+    @property
+    def engine(self):
+        return getattr(self.transport, "engine", None)
+
+    @property
+    def load(self) -> int:
+        return self.transport.queue_depth + self.transport.live_slots
+
+    def __repr__(self) -> str:
+        return (f"Replica({self.index}, state={self.state}, "
+                f"load={self.load})")
+
+
+# ---------------------------------------------------------------------------
+# the controller
+
+
+class FleetController:
+    """Shard one front :class:`~..serve.queue.RequestQueue` across N
+    replica transports with health-gated failover.
+
+    The surface mirrors :class:`~..serve.engine.ServeEngine` — ``submit``
+    / ``tick`` / ``cancel`` / ``response`` / ``drain`` / ``idle`` /
+    ``run_until_idle`` — so drivers (``apps/serve.py``) swap one for
+    the other without restructuring their loop. ``spawn_fn`` (if given)
+    builds one more transport on demand for the spawn hook.
+    """
+
+    def __init__(self, transports: Sequence[ReplicaTransport],
+                 queue: Optional[RequestQueue] = None, *,
+                 policy: RouterPolicy = RouterPolicy(),
+                 spawn_fn: Optional[Callable[[], ReplicaTransport]] = None,
+                 event_log=None,
+                 clock: Optional[Callable[[], float]] = None):
+        transports = list(transports)
+        if not transports:
+            raise ValueError(
+                "the fleet needs at least one replica transport")
+        if queue is None:
+            from ..serve.queue import RequestQueue
+            queue = RequestQueue(clock=clock or time.monotonic)
+        elif clock is not None and clock is not queue.clock:
+            raise ValueError(
+                "pass the clock on the queue (the fleet adopts "
+                "queue.clock)")
+        self.queue = queue
+        self.clock = queue.clock
+        self.policy = policy
+        self.spawn_fn = spawn_fn
+        self.events = event_log if event_log is not None else NULL_EVENT_LOG
+        self.replicas: List[Replica] = []
+        for tr in transports:
+            self._add_replica(tr)
+        self._responses: Dict[int, Response] = {}
+        self._tracked: Dict[int, Request] = {}
+        self._parked: List[Tuple[float, Request]] = []
+        self._session_of: Dict[int, str] = {}
+        self._session_map: Dict[str, int] = {}
+        self._placed_on: Dict[int, int] = {}
+        self._tick_index = 0
+        self._depth_streak = 0
+        self._draining = False
+
+    # -- construction helpers ----------------------------------------------
+
+    def _add_replica(self, transport: ReplicaTransport) -> Replica:
+        rep = Replica(len(self.replicas), transport)
+        self.replicas.append(rep)
+        return rep
+
+    # -- front door --------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None, seed: int = 0,
+               priority: int = 0, timeout_s: Optional[float] = None,
+               session: Optional[str] = None) -> Request:
+        """Validate + enqueue at the fleet front door. Raises
+        ``ValueError`` on an unservable request,
+        :class:`~..serve.engine.EngineDraining` after :meth:`drain`, and
+        :class:`~..serve.queue.QueueFull` when the front queue is at
+        capacity — which is exactly what happens when every replica is
+        SUSPECT or worse: placement stops, the front fills, callers feel
+        backpressure instead of silent loss."""
+        from ..serve.engine import EngineDraining
+        from ..serve.queue import QueueFull
+        reg = get_registry()
+        if self._draining:
+            raise EngineDraining(
+                "fleet is draining: live requests are finishing and no "
+                "new work is admitted")
+        tr = self.replicas[0].transport
+        if max_new_tokens is None:
+            max_new_tokens = tr.default_max_new_tokens
+        tr.validate(len(prompt), max_new_tokens)
+        try:
+            req = self.queue.submit(prompt, max_new_tokens=max_new_tokens,
+                                    seed=seed, priority=priority,
+                                    timeout_s=timeout_s)
+        except QueueFull:
+            reg.counter("serve.fleet.rejected").inc()
+            raise
+        self._tracked[req.id] = req
+        if session is not None:
+            self._session_of[req.id] = str(session)
+        reg.counter("serve.fleet.submitted").inc()
+        reg.gauge("serve.fleet.front_depth").set(self.queue.depth)
+        return req
+
+    def cancel(self, request_id: int) -> bool:
+        """Mark a live request cancelled wherever it currently sits —
+        front queue, parked for retry, a replica's queue, or a running
+        slot. One flag flip on the shared :class:`~..serve.queue.Request`;
+        whichever sweep sees it first emits the single terminal
+        ``cancelled`` response. False for unknown/terminal ids."""
+        req = self._tracked.get(request_id)
+        if req is None:
+            return False
+        req.cancelled = True
+        # a process replica holds a COPY of the request across the wire:
+        # forward the flag so the remote sweep sees it too
+        idx = self._placed_on.get(request_id)
+        if idx is not None:
+            try:
+                self.replicas[idx].transport.cancel(request_id)
+            except TransportError:
+                pass  # drop recovery reclaims it next tick
+        return True
+
+    def response(self, request_id: int) -> Optional[Response]:
+        return self._responses.get(request_id)
+
+    # -- drain / status ----------------------------------------------------
+
+    def drain(self) -> None:
+        """Fleet-wide graceful shutdown: ``submit`` starts raising, the
+        next tick sheds front-queued and parked work
+        (``finish_reason="drain"``) and every replica drains its live
+        slots. Idempotent."""
+        if not self._draining:
+            self._draining = True
+            self.events.event("resilience", action="fleet_drain",
+                              front=self.queue.depth,
+                              parked=len(self._parked))
+            for rep in self.replicas:
+                if rep.state != RETIRED:
+                    try:
+                        rep.transport.drain()
+                    except TransportError:
+                        pass
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        return self._draining and self.idle
+
+    @property
+    def idle(self) -> bool:
+        return (self.queue.depth == 0 and not self._parked
+                and all(r.state == RETIRED or r.transport.idle
+                        for r in self.replicas))
+
+    def counts(self) -> Dict[str, int]:
+        """Replica count per health state (``{state: n}``)."""
+        out = {s: 0 for s in STATES}
+        for rep in self.replicas:
+            out[rep.state] += 1
+        return out
+
+    def close(self) -> None:
+        """Release every transport (threads, child processes)."""
+        for rep in self.replicas:
+            try:
+                rep.transport.close()
+            except Exception:
+                pass
+
+    # -- delivery (the exactly-once ledger) --------------------------------
+
+    def _deliver(self, resp: Response) -> Response:
+        if resp.request_id in self._responses:
+            raise RuntimeError(
+                f"duplicate terminal response for request "
+                f"{resp.request_id} (exactly-once delivery violated)")
+        self._responses[resp.request_id] = resp
+        req = self._tracked.pop(resp.request_id, None)
+        self._session_of.pop(resp.request_id, None)
+        self._placed_on.pop(resp.request_id, None)
+        self.queue.forget(resp.request_id)
+        reg = get_registry()
+        reg.counter("serve.fleet.delivered").inc()
+        if resp.status == "ok":
+            reg.counter("serve.fleet.ok").inc()
+        if req is not None and req.attempts > 1:
+            reg.counter("serve.fleet.failed_over").inc()
+        return resp
+
+    def _finish_unplaced(self, req: Request, status: str, reason: str,
+                         now: float) -> Response:
+        """Terminal record for a request that never (re)reached a
+        replica: front-reaped, parked-reaped, shed on fleet drain, or
+        retries exhausted."""
+        from ..serve.queue import Response
+        resp = Response(request_id=req.id, tokens=[], status=status,
+                        finish_reason=reason, prompt_len=len(req.prompt),
+                        ttft=None, latency=now - req.submitted_at)
+        self.events.event(REQUEST, request=req.id, status=status,
+                          finish_reason=reason, replica=None,
+                          attempts=req.attempts)
+        return self._deliver(resp)
+
+    # -- retry parking -----------------------------------------------------
+
+    def _as_requests(self,
+                     items: Sequence[Union[Request, int]]) -> List[Request]:
+        """Resolve a transport's evicted items — Request objects pass
+        through; bare ids (a process replica holds only copies) map to
+        the controller's tracked originals, which are authoritative for
+        deadlines and attempts. Unknown/already-terminal ids drop."""
+        from ..serve.queue import Request
+        out: List[Request] = []
+        for it in items:
+            req = it if isinstance(it, Request) else self._tracked.get(it)
+            if req is not None:
+                out.append(req)
+        return out
+
+    def reclaim(self, requests: List[Request], now: float) -> List[Response]:
+        """Re-absorb requests knocked off a replica — the ONE
+        park-or-finish decision all recovery paths share (a wedged
+        replica's evicted backlog, per-request retryable failures from
+        a live tick, and a transport drop's in-flight set), so the
+        exactly-once ledger has a single writer. Per request: cancelled
+        or past its deadline → parked for the next sweep's terminal
+        cancelled/timeout record; retry budget remaining → parked with
+        exponential backoff; else ONE terminal ``retries_exhausted``
+        error. Returns the terminal responses (already recorded in the
+        ledger); parked requests surface through later ticks."""
+        reg = get_registry()
+        finished: List[Response] = []
+        for req in requests:
+            if req.cancelled or (req.deadline is not None
+                                 and now >= req.deadline):
+                # next tick's parked sweep emits the terminal
+                # cancelled/timeout record
+                self._parked.append((now, req))
+            elif req.attempts < self.policy.retry_budget:
+                self._park(req, now)
+            else:
+                reg.counter("serve.fleet.retries_exhausted").inc()
+                finished.append(self._finish_unplaced(
+                    req, "error", "retries_exhausted", now))
+        return finished
+
+    def _park(self, req: Request, now: float) -> None:
+        p = self.policy
+        delay = min(p.backoff_base_s * (2.0 ** max(req.attempts - 1, 0)),
+                    p.backoff_max_s)
+        self._parked.append((now + delay, req))
+        get_registry().counter("serve.fleet.retried").inc()
+        self.events.event("resilience", action="retry_parked",
+                          request=req.id, attempts=req.attempts,
+                          delay_s=delay)
+
+    # -- placement ---------------------------------------------------------
+
+    def _placeable(self) -> List[Replica]:
+        return [r for r in self.replicas
+                if r.state == HEALTHY
+                and r.transport.queue_depth < r.transport.queue_capacity]
+
+    def _choose(self, req: Request, candidates: List[Replica]) -> Replica:
+        if self.policy.placement == "session":
+            sess = self._session_of.get(req.id)
+            if sess is not None:
+                home = self._session_map.get(sess)
+                for rep in candidates:
+                    if rep.index == home:
+                        return rep
+        return min(candidates, key=lambda r: (r.load, r.index))
+
+    def _kv_handoff(self, req: Request, sess: str, old_idx: int,
+                    new_rep: Replica) -> None:
+        """Session remap off its home replica: actually move the
+        session's shared-prefix KV. Order matters and each step keeps
+        the PR 10 counter semantics:
+
+        1. probe the NEW home (warm/cold classifies what this handoff
+           costs — before the import, or shipping would mask itself);
+        2. export the cached prefix blocks from the OLD home (raw bytes
+           in-process, int8-serialized across a process boundary);
+        3. seat them into the new home's pool (refcount-0 cached
+           entries: the request's admission takes the refs);
+        4. invalidate the old home (the conversation continues on the
+           new home; a later remap BACK must re-prefill, not extend a
+           stale prefix).
+
+        Transports without a paged pool no-op every step — the hook
+        then only moves counters, exactly the PR 7/10 behavior."""
+        reg = get_registry()
+        reg.counter("serve.fleet.kv_handoff_total").inc()
+        old_tr = self.replicas[old_idx].transport
+        new_tr = new_rep.transport
+        warm = new_tr.cached_prefix_blocks(req.prompt)
+        shipped = nbytes = 0
+        if not warm:
+            try:
+                payload = old_tr.export_prefix(req.prompt)
+            except TransportError:
+                payload = None    # dead home: nothing to ship
+            if payload is not None:
+                nbytes = int(payload.get("nbytes", 0))
+                try:
+                    shipped = new_tr.import_prefix(payload)
+                except TransportError:
+                    shipped = 0
+        if shipped:
+            reg.counter("serve.fleet.kv_handoff_shipped").inc(shipped)
+            reg.counter("serve.fleet.kv_handoff_bytes").inc(nbytes)
+            reg.gauge(labelled("serve.fleet.handoff_bytes",
+                               replica=new_rep.index)).set(nbytes)
+        invalidated = 0
+        try:
+            invalidated = old_tr.invalidate_prefix(req.prompt)
+        except TransportError:
+            pass
+        if invalidated:
+            reg.counter(
+                "serve.fleet.kv_handoff_invalidated").inc(invalidated)
+        reg.counter("serve.fleet.kv_handoff_warm" if warm
+                    else "serve.fleet.kv_handoff_cold").inc()
+        self.events.event("resilience", action="kv_handoff",
+                          request=req.id, session=sess,
+                          from_replica=old_idx, to_replica=new_rep.index,
+                          invalidated=invalidated, warm_blocks=warm,
+                          shipped_blocks=shipped, bytes=nbytes)
+
+    def _try_place(self, req: Request, now: float) -> bool:
+        candidates = self._placeable()
+        if not candidates:
+            return False
+        rep = self._choose(req, candidates)
+        sess = self._session_of.get(req.id)
+        if sess is not None:
+            home = self._session_map.get(sess)
+            if home is not None and home != rep.index:
+                self._kv_handoff(req, sess, home, rep)
+        try:
+            rep.transport.place(req)        # increments req.attempts
+        except TransportError:
+            self._transport_drop(rep, now)
+            return False
+        self._placed_on[req.id] = rep.index
+        if sess is not None and rep.state == HEALTHY:
+            self._session_map[sess] = rep.index
+        return True
+
+    # -- health state machine ----------------------------------------------
+
+    def _inflight_on(self, rep: Replica) -> List[Request]:
+        """Requests currently placed on this replica, per the
+        controller's own ledger — the authoritative in-flight map when
+        the transport can no longer be asked."""
+        return [self._tracked[rid]
+                for rid, idx in list(self._placed_on.items())
+                if idx == rep.index and rid in self._tracked]
+
+    def _transport_drop(self, rep: Replica, now: float) -> None:
+        """The transport died (not necessarily the replica): reclaim
+        everything in flight there from the controller ledger and
+        retire the replica — exactly-once holds because the ledger
+        lives here and the dead connection's frames are never read
+        again. One-way, like a wedge, but with no drain (nothing can be
+        asked to drain)."""
+        if rep.state == RETIRED:
+            return
+        reg = get_registry()
+        reg.counter("serve.fleet.transport_drops").inc()
+        inflight = self._inflight_on(rep)
+        for req in inflight:
+            self._placed_on.pop(req.id, None)
+        self.events.event("resilience", action="transport_drop",
+                          replica=rep.index, inflight=len(inflight))
+        rep.state = RETIRED
+        reg.counter("serve.fleet.retired").inc()
+        try:
+            rep.transport.close()
+        except Exception:
+            pass
+        self.reclaim(inflight, now)
+
+    def _wedge(self, rep: Replica, reason: str, now: float) -> None:
+        """WEDGED: reclaim the backlog intact, re-place or park it under
+        the retry budget, and start draining the live slots. One-way."""
+        rep.state = WEDGED
+        get_registry().counter("serve.fleet.wedged").inc()
+        try:
+            evicted = self._as_requests(rep.transport.evict_queued())
+        except TransportError:
+            # the transport is gone too: the drop path reclaims the
+            # whole in-flight set itself — reclaiming `evicted` here as
+            # well would park every request TWICE and break the
+            # exactly-once ledger with duplicate terminals
+            self._transport_drop(rep, now)
+            return
+        self.events.event("resilience", action="replica_wedged",
+                          replica=rep.index, reason=reason,
+                          evicted=len(evicted))
+        for req in evicted:
+            self._placed_on.pop(req.id, None)
+        # terminal responses land in the ledger; tick's delivered list
+        # picks them up via response() like any mid-health-pass finish
+        self.reclaim(evicted, now)
+        if rep.state == WEDGED:          # transport still up: drain live
+            try:
+                rep.transport.drain()
+                rep.state = DRAINING
+            except TransportError:
+                self._transport_drop(rep, now)
+
+    def _update_health(self, rep: Replica, now: float) -> None:
+        p = self.policy
+        if rep.state == RETIRED:
+            return
+        if rep.state == DRAINING:
+            try:
+                if rep.transport.drained:
+                    rep.state = RETIRED
+                    get_registry().counter("serve.fleet.retired").inc()
+                    self.events.event("resilience",
+                                      action="replica_retired",
+                                      replica=rep.index)
+            except TransportError:
+                self._transport_drop(rep, now)
+            return
+
+        try:
+            h = rep.transport.health()
+        except TransportError:
+            self._transport_drop(rep, now)
+            return
+        if not h.alive or (p.heartbeat_timeout_s is not None
+                           and h.heartbeat_age_s > p.heartbeat_timeout_s):
+            self._wedge(rep, f"heartbeat lost (age="
+                             f"{h.heartbeat_age_s:.3f}s)", now)
+            return
+        slow = h.slow_streak
+        ewma = h.miss_ewma
+        derr = h.consecutive_decode_errors
+        if rep.had_error_this_tick:
+            rep.error_ticks += 1
+
+        if (slow >= p.wedge_slow_streak or derr >= p.wedge_decode_errors
+                or rep.error_ticks >= p.wedge_error_ticks):
+            self._wedge(rep, f"slow_streak={slow} decode_errors={derr} "
+                             f"error_ticks={rep.error_ticks}", now)
+            return
+
+        bad = (slow >= p.suspect_slow_streak or derr > 0
+               or rep.had_error_this_tick
+               or (p.suspect_miss_ewma is not None
+                   and ewma > p.suspect_miss_ewma))
+        if rep.state == HEALTHY and bad:
+            rep.state = SUSPECT
+            rep.healthy_streak = 0
+            get_registry().counter("serve.fleet.suspected").inc()
+            self.events.event("resilience", action="replica_suspect",
+                              replica=rep.index, slow_streak=slow,
+                              decode_errors=derr, miss_ewma=ewma)
+        elif rep.state == SUSPECT:
+            if bad:
+                rep.healthy_streak = 0
+            else:
+                rep.healthy_streak += 1
+                if rep.healthy_streak >= p.recover_healthy_ticks:
+                    rep.state = HEALTHY
+                    rep.healthy_streak = 0
+                    get_registry().counter("serve.fleet.recovered").inc()
+                    self.events.event("resilience",
+                                      action="replica_recovered",
+                                      replica=rep.index)
+
+    def _lifecycle(self, now: float) -> None:
+        """Spawn on sustained front-queue depth; retire sustained-idle
+        replicas (never below ``min_replicas`` placeable ones)."""
+        p = self.policy
+        active = [r for r in self.replicas if r.state in (HEALTHY, SUSPECT)]
+        if p.spawn_depth is not None and self.spawn_fn is not None:
+            if self.queue.depth >= p.spawn_depth:
+                self._depth_streak += 1
+            else:
+                self._depth_streak = 0
+            if self._depth_streak >= p.spawn_sustain_ticks \
+                    and len(active) < p.max_replicas:
+                rep = self._add_replica(self.spawn_fn())
+                self._depth_streak = 0
+                get_registry().counter("serve.fleet.spawned").inc()
+                self.events.event("resilience", action="replica_spawned",
+                                  replica=rep.index,
+                                  front_depth=self.queue.depth)
+        if p.retire_idle_ticks is None:
+            return
+        for rep in self.replicas:
+            if rep.state != HEALTHY:
+                continue
+            if rep.transport.idle and self.queue.depth == 0 \
+                    and not self._parked:
+                rep.idle_ticks += 1
+            else:
+                rep.idle_ticks = 0
+            active = [r for r in self.replicas
+                      if r.state in (HEALTHY, SUSPECT)]
+            if rep.idle_ticks >= p.retire_idle_ticks \
+                    and len(active) > p.min_replicas:
+                rep.transport.drain()
+                rep.state = DRAINING
+                rep.idle_ticks = 0
+                get_registry().counter("serve.fleet.idle_retired").inc()
+                self.events.event("resilience",
+                                  action="replica_idle_retired",
+                                  replica=rep.index)
+
+    # -- the fleet tick ----------------------------------------------------
+
+    def tick(self) -> List[Response]:
+        """One fleet scheduling round: sweep the front/parked sets,
+        advance every replica's health machine, place onto HEALTHY
+        replicas, poll the replicas (serial in-process transports tick
+        here; async/process replicas tick themselves and this just
+        drains), then deliver-or-retry their terminal responses.
+        Returns the responses DELIVERED this tick (retried failures are
+        not delivered — they park)."""
+        reg = get_registry()
+        now = self.clock()
+        tick_idx = self._tick_index
+        delivered: List[Response] = []
+
+        # 0) fleet drain — push back everything not yet on a replica
+        if self._draining:
+            for req in self.queue.evict_all():
+                delivered.append(
+                    self._finish_unplaced(req, "shed", "drain", now))
+            for _, req in self._parked:
+                delivered.append(
+                    self._finish_unplaced(req, "shed", "drain", now))
+            self._parked = []
+
+        # 1) front + parked sweeps — deaths that never cost a replica
+        for req, reason in self.queue.reap(now):
+            status = "cancelled" if reason == "cancelled" else "timeout"
+            delivered.append(
+                self._finish_unplaced(req, status, reason, now))
+        still = []
+        for eligible_at, req in self._parked:
+            if req.cancelled:
+                delivered.append(
+                    self._finish_unplaced(req, "cancelled", "cancelled",
+                                          now))
+            elif req.deadline is not None and now >= req.deadline:
+                delivered.append(
+                    self._finish_unplaced(req, "timeout", "deadline", now))
+            else:
+                still.append((eligible_at, req))
+        self._parked = still
+
+        # 2) health transitions + lifecycle (uses last tick's signals)
+        for rep in self.replicas:
+            self._update_health(rep, now)
+            rep.had_error_this_tick = False
+        if not self._draining:
+            self._lifecycle(now)
+
+        # 2b) dead fleet — no replica can ever serve again (none healthy
+        # or recoverable, no spawn hook armed): fail the stranded work
+        # now instead of parking it forever
+        recoverable = any(r.state in (HEALTHY, SUSPECT)
+                          for r in self.replicas)
+        can_spawn = (self.spawn_fn is not None
+                     and self.policy.spawn_depth is not None)
+        if not recoverable and not can_spawn and not self._draining:
+            stranded = self.queue.evict_all() + [r for _, r in self._parked]
+            self._parked = []
+            for req in stranded:
+                reg.counter("serve.fleet.retries_exhausted").inc()
+                delivered.append(self._finish_unplaced(
+                    req, "error", "no_replicas", now))
+
+        # 3) placement — parked retries first (oldest work), then front
+        if not self._draining:
+            still = []
+            for eligible_at, req in self._parked:
+                if eligible_at > now or not self._try_place(req, now):
+                    still.append((eligible_at, req))
+            self._parked = still
+            while self.queue.depth and self._placeable():
+                req = self.queue.pop()
+                if not self._try_place(req, now):
+                    # the pop is not a lease on delivery: placement can
+                    # race a transport death (place RPC hits a socket
+                    # that just died → drop → False) and the request
+                    # must survive it — park for the next sweep
+                    self._parked.append((now, req))
+
+        # 4) poll the replicas, deliver-or-retry what they finish
+        for rep in self.replicas:
+            if rep.state == RETIRED:
+                continue
+            try:
+                finished = rep.transport.poll()
+            except TransportError:
+                self._transport_drop(rep, now)
+                continue
+            for resp in finished:
+                req = self._tracked.get(resp.request_id)
+                if (resp.status == "error"
+                        and resp.finish_reason in RETRYABLE_REASONS
+                        and req is not None):
+                    rep.had_error_this_tick = True
+                    self._placed_on.pop(req.id, None)
+                    delivered.extend(self.reclaim([req], now))
+                    continue
+                delivered.append(self._deliver(resp))
+
+        # 5) fleet gauges
+        counts = self.counts()
+        for state, n in counts.items():
+            reg.gauge(f"serve.fleet.replicas_{state}").set(n)
+        reg.gauge("serve.fleet.front_depth").set(self.queue.depth)
+        reg.gauge("serve.fleet.parked").set(len(self._parked))
+        for rep in self.replicas:
+            tr = rep.transport
+            reg.gauge(labelled("serve.fleet.replica.state",
+                               replica=rep.index)).set(
+                _STATE_CODE[rep.state])
+            if rep.state == RETIRED:
+                continue
+            try:
+                h = tr.health()
+                reg.gauge(labelled("serve.fleet.replica.queue_depth",
+                                   replica=rep.index)).set(tr.queue_depth)
+                reg.gauge(labelled("serve.fleet.replica.live_slots",
+                                   replica=rep.index)).set(tr.live_slots)
+                reg.gauge(labelled("serve.fleet.rpc_inflight",
+                                   replica=rep.index)).set(tr.rpc_inflight)
+                reg.gauge(labelled("serve.fleet.rpc_retries",
+                                   replica=rep.index)).set(tr.rpc_retries)
+                reg.gauge(labelled("serve.fleet.heartbeat_age_s",
+                                   replica=rep.index)).set(
+                    h.heartbeat_age_s)
+            except TransportError:
+                self._transport_drop(rep, now)
+        self._tick_index = tick_idx + 1
+        return delivered
+
+    # -- convenience loops -------------------------------------------------
+
+    def run_until_idle(self, max_ticks: int = 1_000_000) -> List[Response]:
+        """Tick until every tracked request delivered. With every
+        replica dead this still terminates: retries exhaust their
+        budgets and the dead-fleet sweep fails anything stranded."""
+        delivered: List[Response] = []
+        for _ in range(max_ticks):
+            if self.idle:
+                return delivered
+            delivered.extend(self.tick())
+        raise RuntimeError(
+            f"fleet not idle after {max_ticks} ticks (front="
+            f"{self.queue.depth}, parked={len(self._parked)}, "
+            f"replicas={self.counts()})")

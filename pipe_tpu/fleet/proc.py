@@ -1,0 +1,914 @@
+"""Process replicas: each fleet replica a real OS process on a wire.
+
+The other half of the transport split (:mod:`.control` is the
+transport-agnostic control plane): :class:`ProcessReplicaTransport`
+spawns ``python -m pipe_tpu.fleet.proc`` as a fresh interpreter that
+owns its OWN engine, jit cache and KV pool — the process boundary is
+the isolation the in-process fleet can't give (a wedged replica's GIL,
+a poisoned XLA client, a leaked device buffer die with their process).
+
+Wire protocol
+-------------
+Length-prefixed frames over one loopback TCP connection per replica
+(the child connects back to the parent's listener, so the parent never
+needs to guess a child port, and reconnect is child-initiated):
+
+* frame = 4-byte big-endian payload length + payload;
+* payload = msgpack (JSON + base64 fallback when msgpack is absent)
+  of one message dict; numpy arrays ride an explicit
+  ``{"__nd__": dtype, shape, data}`` envelope, so KV-handoff payloads
+  (int8 codes + f32 scales) cross the wire without pickling;
+* messages: parent→child **ops** (``place``/``cancel``/``evict``/
+  ``drain``/``export_prefix``/``import_prefix``/``invalidate_prefix``/
+  ``cached_prefix``/``shutdown``), each carrying an ``rpc`` id the
+  child echoes in its ``reply`` (value or ``error=[type, msg]``, so
+  ``QueueFull``/``EngineDraining``/``ValueError`` re-raise with their
+  in-process semantics); child→parent **responses** (terminal
+  :class:`~..serve.queue.Response` records, streamed as they finish)
+  and **heartbeats** (the health signals the controller's state
+  machine runs on — ``slow_streak``, ``miss_ewma``, ``stuck_slots``,
+  ``consecutive_decode_errors`` — plus depth/live/idle/drained, every
+  ``heartbeat_interval_s`` whether or not anything else moved).
+
+Clock domains: the parent and child clocks are unrelated, so deadlines
+NEVER cross the wire absolute — ``place`` ships ``remaining_s`` (time
+left) and ``age_s`` (time since submit) and the child re-anchors both
+on its own monotonic clock. Reconnect: a dropped connection is retried
+by the child against the same listener for ``reconnect_timeout_s``;
+the parent re-sends still-pending RPC frames on the new connection
+(counted in ``rpc_retries``). Past the window the transport reports
+dead and every call raises :class:`~.control.TransportError` — the
+controller then reclaims the in-flight requests from its OWN ledger
+(the authoritative map; a late response for a reclaimed id is dropped
+here, never delivered twice).
+
+The child ticks ITSELF — the async-tick contract. The controller's
+``poll()`` just drains what the reader thread buffered.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..serve.queue import Request, Response
+from .control import ReplicaHealth, ReplicaTransport, TransportError
+
+try:
+    import msgpack
+    HAVE_MSGPACK = True
+except Exception:                                 # pragma: no cover
+    msgpack = None
+    HAVE_MSGPACK = False
+
+__all__ = ["ProcessReplicaTransport", "ReplicaSpec", "FleetSpawnError",
+           "check_spawn_capability"]
+
+
+class FleetSpawnError(RuntimeError):
+    """The platform cannot launch JAX child processes — raised BEFORE
+    any replica process is attempted, with the remedy in the message."""
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """Everything a child process needs to build its replica engine —
+    plain data only (it crosses the wire as the handshake frame). The
+    child constructs ``PipelinedLM(LMConfig(**lm_cfg), n_stages)``,
+    initializes params from ``init_seed`` (replica homogeneity: every
+    replica derives the same weights from the same seed — shipping
+    params through the frame protocol is pointless when init is
+    deterministic), and wraps a
+    :class:`~..serve.engine.SingleDeviceSlotBackend` +
+    :class:`~..serve.engine.ServeEngine`."""
+
+    lm_cfg: Dict[str, Any]
+    n_stages: int = 1
+    init_seed: int = 0
+    num_slots: int = 2
+    max_len: int = 96
+    gen: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    buckets: Optional[List[int]] = None
+    decode_chunk: int = 1
+    kv_block_size: Optional[int] = None
+    kv_pool_blocks: Optional[int] = None
+    kv_dtype: Optional[str] = None
+    prefill_chunk: int = 16
+    queue_capacity: int = 256
+    watchdog: bool = True
+    heartbeat_interval_s: float = 0.1
+    jax_platform: str = "cpu"
+    local_devices: int = 1
+
+
+# ---------------------------------------------------------------------------
+# spawn capability (satellite: runtime/_multiproc_check discipline)
+
+
+def _spawn_env(repo_root: Optional[str] = None,
+               jax_platform: str = "cpu") -> Dict[str, str]:
+    """Child environment, the ``runtime/_multiproc_check`` discipline:
+    fresh interpreters must not boot an accelerator plugin meant for
+    the parent (it would hang platform selection) and must not inherit
+    a forced device count — the child picks its own platform."""
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = jax_platform
+    return env
+
+
+def check_spawn_capability(executable: Optional[str] = None, *,
+                           probe: bool = False) -> None:
+    """Refuse a process-transport fleet up front, with a clear error,
+    when this platform cannot fork/spawn JAX child processes — the
+    failure mode ``runtime/_multiproc_check`` documents (sandboxes
+    without subprocess, stripped interpreters, no loopback sockets).
+    ``probe=True`` additionally launches a trivial child interpreter
+    (slower; the transport does it implicitly anyway on first spawn).
+    Raises :class:`FleetSpawnError`; returns None when spawning looks
+    possible."""
+    exe = executable if executable is not None else sys.executable
+    remedy = ("process-transport replicas are fresh interpreters "
+              "(python -m pipe_tpu.fleet.proc); run on a platform where "
+              "subprocesses and loopback sockets are available, or use "
+              "the in-process fleet (--fleet inproc / --fleet thread)")
+    if not exe or not os.path.exists(exe):
+        raise FleetSpawnError(
+            f"cannot spawn JAX child processes: python executable "
+            f"{exe!r} does not exist — {remedy}")
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.bind(("127.0.0.1", 0))
+        finally:
+            s.close()
+    except OSError as e:
+        raise FleetSpawnError(
+            f"cannot spawn JAX child processes: loopback sockets are "
+            f"unavailable ({e}) — {remedy}")
+    if probe:
+        try:
+            r = subprocess.run([exe, "-c", "import sys; sys.exit(0)"],
+                               env=_spawn_env(), timeout=60,
+                               stdout=subprocess.DEVNULL,
+                               stderr=subprocess.DEVNULL)
+        except (OSError, subprocess.SubprocessError) as e:
+            raise FleetSpawnError(
+                f"cannot spawn JAX child processes: probe launch failed "
+                f"({type(e).__name__}: {e}) — {remedy}")
+        if r.returncode != 0:
+            raise FleetSpawnError(
+                f"cannot spawn JAX child processes: probe interpreter "
+                f"exited {r.returncode} — {remedy}")
+
+
+# ---------------------------------------------------------------------------
+# frame codec
+
+
+def _nd_encode(obj):
+    if isinstance(obj, np.ndarray):
+        return {"__nd__": str(obj.dtype), "shape": list(obj.shape),
+                "data": obj.tobytes()}
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    raise TypeError(f"cannot encode {type(obj).__name__} on the wire")
+
+
+def _nd_decode(d):
+    if "__nd__" in d:
+        data = d["data"]
+        if isinstance(data, str):                 # JSON fallback: base64
+            data = base64.b64decode(data)
+        return np.frombuffer(data, dtype=np.dtype(d["__nd__"])).reshape(
+            d["shape"]).copy()
+    return d
+
+
+def _pack(msg: dict) -> bytes:
+    if HAVE_MSGPACK:
+        return msgpack.packb(msg, default=_nd_encode, use_bin_type=True)
+
+    def jsonable(o):                              # pragma: no cover
+        if isinstance(o, np.ndarray):
+            return {"__nd__": str(o.dtype), "shape": list(o.shape),
+                    "data": base64.b64encode(o.tobytes()).decode()}
+        if isinstance(o, bytes):
+            return {"__b64__": base64.b64encode(o).decode()}
+        if isinstance(o, (np.integer,)):
+            return int(o)
+        if isinstance(o, (np.floating,)):
+            return float(o)
+        raise TypeError(type(o).__name__)
+    return json.dumps(msg, default=jsonable).encode()
+
+
+def _unpack(buf: bytes) -> dict:
+    if HAVE_MSGPACK:
+        return msgpack.unpackb(buf, raw=False, object_hook=_nd_decode,
+                               strict_map_key=False)
+
+    def hook(d):                                  # pragma: no cover
+        if "__b64__" in d:
+            return base64.b64decode(d["__b64__"])
+        return _nd_decode(d)
+    return json.loads(buf.decode(), object_hook=hook)
+
+
+def send_frame(sock: socket.socket, msg: dict,
+               lock: Optional[threading.Lock] = None) -> bytes:
+    buf = _pack(msg)
+    frame = struct.pack(">I", len(buf)) + buf
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+    return frame
+
+
+def recv_frame(sock: socket.socket) -> Optional[dict]:
+    """One frame, or None on clean EOF. Raises OSError on a broken
+    connection mid-frame."""
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack(">I", head)
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise OSError("connection closed mid-frame")
+    return _unpack(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(n - got)
+        if not c:
+            return None
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)
+
+
+# ---------------------------------------------------------------------------
+# parent side: the transport
+
+
+_ERRORS = {"QueueFull": None, "EngineDraining": None, "ValueError":
+           ValueError, "PoolExhausted": None, "RuntimeError": RuntimeError}
+
+
+def _raise_remote(name: str, msg: str):
+    from ..serve.engine import EngineDraining
+    from ..serve.kvpool import PoolExhausted
+    from ..serve.queue import QueueFull
+    cls = {"QueueFull": QueueFull, "EngineDraining": EngineDraining,
+           "ValueError": ValueError, "PoolExhausted": PoolExhausted,
+           }.get(name, RuntimeError)
+    raise cls(msg)
+
+
+class ProcessReplicaTransport(ReplicaTransport):
+    """One replica behind a real OS process. Spawn-time cost is a full
+    interpreter + jit warmup per replica — this transport is for fleets
+    that run, not for unit-test churn (tests mark it slow)."""
+
+    def __init__(self, spec: ReplicaSpec, *,
+                 clock=None,
+                 connect_timeout_s: float = 120.0,
+                 rpc_timeout_s: float = 120.0,
+                 reconnect_timeout_s: float = 5.0,
+                 executable: Optional[str] = None):
+        check_spawn_capability(executable)
+        self.spec = spec
+        self.clock = clock or time.monotonic
+        self._rpc_timeout_s = rpc_timeout_s
+        self._reconnect_timeout_s = reconnect_timeout_s
+        self.rpc_inflight = 0
+        self.rpc_retries = 0
+        self.handoff_bytes = 0
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._pending: Dict[int, list] = {}       # rpc id -> [event, reply]
+        self._pending_frames: Dict[int, bytes] = {}
+        self._rpc_next = 0
+        self._inflight: Dict[int, Request] = {}
+        self._responses: "deque[Response]" = deque()
+        self._hb: Dict[str, Any] = {}
+        self._hb_at: Optional[float] = None
+        self._dead: Optional[str] = None
+        self._draining = False
+        self._closed = False
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(1)
+        port = self._listener.getsockname()[1]
+        self._token = base64.b64encode(os.urandom(12)).decode()
+        exe = executable if executable is not None else sys.executable
+        self._proc = subprocess.Popen(
+            [exe, "-m", "pipe_tpu.fleet.proc",
+             "--port", str(port), "--token", self._token],
+            env=_spawn_env(jax_platform=spec.jax_platform),
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        try:
+            self._sock = self._accept(connect_timeout_s)
+            send_frame(self._sock,
+                       {"op": "spec", "spec": dataclasses.asdict(spec)},
+                       self._send_lock)
+            ready = recv_frame(self._sock)
+            if not ready or ready.get("op") != "ready":
+                err = b""
+                self._kill_child()
+                if self._proc.stderr is not None:
+                    err = self._proc.stderr.read() or b""
+                raise TransportError(
+                    f"replica child never became ready: {ready!r}; child "
+                    f"stderr: {err.decode(errors='replace')[-2000:]}")
+            self.default_max_new_tokens_ = int(
+                ready["default_max_new_tokens"])
+            self.queue_capacity_ = int(ready["queue_capacity"])
+            self.num_slots = int(ready["num_slots"])
+        except Exception:
+            self._kill_child()
+            raise
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="fleet-proc-reader",
+                                        daemon=True)
+        self._reader.start()
+
+    # -- connection management -------------------------------------------
+
+    def _accept(self, timeout_s: float) -> socket.socket:
+        # accept in short slices so a child that DIED (crash, SIGKILL)
+        # surfaces in ~a quarter second instead of silently eating the
+        # whole connect window — a place() RPC blocked behind this is
+        # inside the controller's tick loop
+        deadline = time.monotonic() + timeout_s
+        try:
+            while True:
+                if self._proc.poll() is not None:
+                    err = b""
+                    if self._proc.stderr is not None:
+                        err = self._proc.stderr.read() or b""
+                    raise TransportError(
+                        f"replica child exited rc={self._proc.returncode} "
+                        f"before connecting: "
+                        f"{err.decode(errors='replace')[-2000:]}")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TransportError(
+                        f"replica child did not connect within "
+                        f"{timeout_s}s")
+                try:
+                    self._listener.settimeout(min(0.25, remaining))
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError as e:
+                    # listener torn down by close() while we waited
+                    raise TransportError(f"listener closed: {e}")
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                hello = recv_frame(conn)
+                if hello and hello.get("op") == "hello" \
+                        and hello.get("token") == self._token:
+                    return conn
+                conn.close()                      # wrong token: not ours
+        finally:
+            try:
+                self._listener.settimeout(None)
+            except OSError:
+                pass
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            try:
+                msg = recv_frame(self._sock)
+                if msg is None:
+                    raise OSError("EOF")
+            except OSError as e:
+                if self._closed:
+                    return
+                if not self._reconnect():
+                    if not self._closed:
+                        self._mark_dead(
+                            f"connection lost ({e}) and reconnect "
+                            f"window expired")
+                    return
+                continue
+            self._dispatch(msg)
+
+    def _reconnect(self) -> bool:
+        """Wait for the child to re-dial the listener; re-send pending
+        RPC frames on the fresh connection (counted as retries)."""
+        if self._proc.poll() is not None:
+            return False
+        try:
+            conn = self._accept(self._reconnect_timeout_s)
+        except TransportError:
+            return False
+        with self._send_lock:
+            old, self._sock = self._sock, conn
+        try:
+            old.close()
+        except OSError:
+            pass
+        with self._state_lock:
+            frames = list(self._pending_frames.values())
+        for frame in frames:
+            try:
+                with self._send_lock:
+                    self._sock.sendall(frame)
+                self.rpc_retries += 1
+            except OSError:
+                return False
+        return True
+
+    def _dispatch(self, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "reply":
+            with self._state_lock:
+                ent = self._pending.get(msg.get("rpc"))
+            if ent is not None:
+                ent[1] = msg
+                ent[0].set()
+        elif op == "response":
+            rid = msg["id"]
+            with self._state_lock:
+                known = rid in self._inflight
+                if known:
+                    self._inflight.pop(rid, None)
+                    self._responses.append(Response(
+                        request_id=rid, tokens=list(msg["tokens"]),
+                        status=msg["status"],
+                        finish_reason=msg["finish_reason"],
+                        prompt_len=msg["prompt_len"],
+                        ttft=msg.get("ttft"), latency=msg.get("latency")))
+            # unknown id: the controller reclaimed it over a drop — the
+            # stale record is discarded HERE so delivery stays exactly-once
+        elif op == "hb":
+            with self._state_lock:
+                self._hb = msg
+                self._hb_at = time.monotonic()
+
+    def _mark_dead(self, reason: str) -> None:
+        self._dead = reason
+        with self._state_lock:
+            pend = list(self._pending.values())
+        for ent in pend:
+            ent[0].set()
+
+    def _check(self) -> None:
+        if self._dead is not None:
+            raise TransportError(f"replica transport dead: {self._dead}")
+        if self._proc.poll() is not None and self._proc.returncode != 0:
+            self._mark_dead(
+                f"replica process exited rc={self._proc.returncode}")
+            raise TransportError(f"replica transport dead: {self._dead}")
+
+    # -- rpc ---------------------------------------------------------------
+
+    def _rpc(self, msg: dict, timeout: Optional[float] = None):
+        self._check()
+        ev = threading.Event()
+        with self._state_lock:
+            rid = self._rpc_next
+            self._rpc_next += 1
+            self._pending[rid] = [ev, None]
+        msg = dict(msg, rpc=rid)
+        buf = _pack(msg)
+        frame = struct.pack(">I", len(buf)) + buf
+        with self._state_lock:
+            # register BEFORE sending: if the send races a connection
+            # drop, the reconnect replay finds the frame and re-sends
+            # it — marking the transport dead here would preempt a
+            # recovery the read loop was about to complete
+            self._pending_frames[rid] = frame
+        try:
+            try:
+                with self._send_lock:
+                    self._sock.sendall(frame)
+            except OSError:
+                pass        # reconnect replay (or _mark_dead) resolves it
+            self.rpc_inflight += 1
+            if not ev.wait(timeout if timeout is not None
+                           else self._rpc_timeout_s):
+                self._mark_dead(f"rpc {msg.get('op')} timed out")
+                raise TransportError(
+                    f"replica transport dead: {self._dead}")
+            with self._state_lock:
+                reply = self._pending[rid][1]
+            if reply is None:                     # woken by _mark_dead
+                raise TransportError(
+                    f"replica transport dead: {self._dead}")
+            if "error" in reply:
+                _raise_remote(reply["error"][0], reply["error"][1])
+            return reply.get("value")
+        finally:
+            with self._state_lock:
+                self._pending.pop(rid, None)
+                self._pending_frames.pop(rid, None)
+            self.rpc_inflight = max(self.rpc_inflight - 1, 0)
+
+    # -- ReplicaTransport ---------------------------------------------------
+
+    def place(self, req: Request) -> None:
+        now = self.clock()
+        remaining = (req.deadline - now if req.deadline is not None
+                     else None)
+        payload = {"op": "place", "id": req.id,
+                   "prompt": list(map(int, req.prompt)),
+                   "max_new_tokens": req.max_new_tokens,
+                   "seed": req.seed, "priority": req.priority,
+                   "attempts": req.attempts,
+                   "remaining_s": remaining,
+                   "age_s": max(now - req.submitted_at, 0.0),
+                   "cancelled": bool(req.cancelled)}
+        self._rpc(payload)                        # raises remote errors
+        req.attempts += 1                         # placement ledger
+        with self._state_lock:
+            self._inflight[req.id] = req
+
+    def poll(self) -> List[Response]:
+        self._check()
+        out: List[Response] = []
+        with self._state_lock:
+            while self._responses:
+                out.append(self._responses.popleft())
+        return out
+
+    def evict_queued(self) -> List[int]:
+        return [int(i) for i in (self._rpc({"op": "evict"}) or [])]
+
+    def cancel(self, request_id: int) -> bool:
+        return bool(self._rpc({"op": "cancel", "id": request_id}))
+
+    def drain(self) -> None:
+        self._draining = True
+        self._rpc({"op": "drain"})
+
+    @property
+    def drained(self) -> bool:
+        with self._state_lock:
+            quiet = not self._inflight and not self._responses
+        return self._draining and quiet and bool(self._hb.get("drained"))
+
+    @property
+    def idle(self) -> bool:
+        with self._state_lock:
+            return not self._inflight and not self._responses
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if self._dead is None and self._proc.poll() is None:
+                send_frame(self._sock, {"op": "shutdown"}, self._send_lock)
+                self._proc.wait(timeout=5)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        self._kill_child()
+        for s in (self._sock, self._listener):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _kill_child(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.kill()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:     # pragma: no cover
+                pass
+
+    # -- placement surface --------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        with self._state_lock:
+            live = int(self._hb.get("live", 0))
+            return max(len(self._inflight) - live, 0)
+
+    @property
+    def queue_capacity(self) -> int:
+        return self.queue_capacity_
+
+    @property
+    def live_slots(self) -> int:
+        return int(self._hb.get("live", 0))
+
+    def validate(self, prompt_len: int, max_new_tokens: int) -> None:
+        # mirror of the child's admission checks, evaluated lazily: the
+        # child re-validates at place() and ships the ValueError back
+        if max_new_tokens > self.default_max_new_tokens_:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} exceeds the engine cap "
+                f"({self.default_max_new_tokens_})")
+        if prompt_len + max_new_tokens > self.spec.max_len:
+            raise ValueError(
+                f"prompt_len {prompt_len} + max_new_tokens "
+                f"{max_new_tokens} exceeds the slot cache "
+                f"({self.spec.max_len} rows)")
+
+    @property
+    def default_max_new_tokens(self) -> int:
+        return self.default_max_new_tokens_
+
+    # -- health -------------------------------------------------------------
+
+    def health(self) -> ReplicaHealth:
+        alive = self._dead is None and self._proc.poll() is None
+        age = (time.monotonic() - self._hb_at
+               if self._hb_at is not None else float("inf"))
+        hb = self._hb
+        return ReplicaHealth(
+            slow_streak=int(hb.get("slow_streak", 0)),
+            miss_ewma=float(hb.get("miss_ewma", 0.0)),
+            stuck_slots=int(hb.get("stuck_slots", 0)),
+            consecutive_decode_errors=int(hb.get("decode_errors", 0)),
+            heartbeat_age_s=age if self._hb_at is not None else 0.0,
+            alive=alive)
+
+    # -- KV handoff ---------------------------------------------------------
+
+    def export_prefix(self, prompt: Sequence[int]) -> Optional[dict]:
+        payload = self._rpc({"op": "export_prefix",
+                             "prompt": list(map(int, prompt))})
+        return payload or None
+
+    def import_prefix(self, payload: dict) -> int:
+        n = int(self._rpc({"op": "import_prefix", "payload": payload}) or 0)
+        if n:
+            self.handoff_bytes += int(payload.get("nbytes", 0))
+        return n
+
+    def invalidate_prefix(self, prompt: Sequence[int]) -> int:
+        return int(self._rpc({"op": "invalidate_prefix",
+                              "prompt": list(map(int, prompt))}) or 0)
+
+    def cached_prefix_blocks(self, prompt: Sequence[int]) -> int:
+        return int(self._rpc({"op": "cached_prefix",
+                              "prompt": list(map(int, prompt))}) or 0)
+
+    # -- test hook ----------------------------------------------------------
+
+    def drop_connection(self) -> None:
+        """Sever the current socket WITHOUT touching the child — the
+        transport-drop drill. The child's reconnect loop re-dials the
+        listener; pending RPCs re-send on the fresh connection."""
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# child side: the replica worker
+
+
+def _build_engine(spec: ReplicaSpec):
+    """Construct the replica's model/backend/engine from the handshake
+    spec — imports deferred so the parent-side transport never pays
+    for jax."""
+    if spec.jax_platform == "cpu" and spec.local_devices > 1:
+        from ..utils.platform import force_cpu_platform
+        force_cpu_platform(spec.local_devices)
+    import jax
+
+    from ..inference import GenerationConfig
+    from ..models.transformer_lm import LMConfig, PipelinedLM
+    from ..resilience import TickWatchdog
+    from ..serve.buckets import BucketSpec
+    from ..serve.engine import ServeEngine, SingleDeviceSlotBackend
+    from ..serve.queue import RequestQueue
+
+    model = PipelinedLM(LMConfig(**spec.lm_cfg), spec.n_stages)
+    params = model.init(jax.random.key(spec.init_seed))
+    gen = GenerationConfig(**spec.gen)
+    buckets = (BucketSpec.of(*spec.buckets)
+               if spec.buckets is not None else None)
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=spec.num_slots, max_len=spec.max_len,
+        gen=gen, buckets=buckets, decode_chunk=spec.decode_chunk,
+        kv_block_size=spec.kv_block_size,
+        kv_pool_blocks=spec.kv_pool_blocks, kv_dtype=spec.kv_dtype,
+        prefill_chunk=spec.prefill_chunk)
+    wd = TickWatchdog() if spec.watchdog else None
+    return ServeEngine(backend,
+                       RequestQueue(capacity=spec.queue_capacity),
+                       watchdog=wd)
+
+
+def _child_op(engine, msg: dict, now: float):
+    """Apply one parent op; returns the reply value (exceptions
+    propagate to the op loop, which ships them back by name)."""
+    op = msg["op"]
+    if op == "place":
+        req = Request(
+            id=int(msg["id"]), prompt=list(msg["prompt"]),
+            max_new_tokens=int(msg["max_new_tokens"]),
+            seed=int(msg["seed"]), priority=int(msg["priority"]),
+            deadline=(now + msg["remaining_s"]
+                      if msg.get("remaining_s") is not None else None),
+            submitted_at=now - float(msg.get("age_s", 0.0)),
+            cancelled=bool(msg.get("cancelled", False)),
+            # engine.place() increments: the wire ships the
+            # pre-placement count so both ledgers agree after
+            attempts=int(msg["attempts"]))
+        engine.place(req)
+        return True
+    if op == "cancel":
+        return engine.cancel(int(msg["id"]))
+    if op == "evict":
+        return [r.id for r in engine.evict_queued()]
+    if op == "drain":
+        engine.drain()
+        return True
+    backend = engine.backend
+    pool = getattr(backend, "pool", None)
+    if op == "export_prefix":
+        exp = getattr(backend, "export_prefix_payload", None)
+        return exp(msg["prompt"], codec="int8") if exp is not None else None
+    if op == "import_prefix":
+        imp = getattr(backend, "import_prefix_payload", None)
+        return imp(msg["payload"]) if imp is not None else 0
+    if op == "invalidate_prefix":
+        if pool is None:
+            return 0
+        return pool.invalidate(pool.prefix_hashes(msg["prompt"]))
+    if op == "cached_prefix":
+        if pool is None:
+            return 0
+        return pool.cached_prefix_blocks(msg["prompt"])
+    raise ValueError(f"unknown fleet op {op!r}")
+
+
+def _heartbeat(engine) -> dict:
+    wd = engine.watchdog
+    return {"op": "hb",
+            "slow_streak": wd.slow_streak if wd is not None else 0,
+            "miss_ewma": wd.miss_ewma if wd is not None else 0.0,
+            "stuck_slots": wd.stuck_slots if wd is not None else 0,
+            "decode_errors": engine.consecutive_decode_errors,
+            "depth": engine.queue.depth, "live": engine.live_slots,
+            "idle": engine.idle, "draining": engine.draining,
+            "drained": engine.drained}
+
+
+def worker(port: int, token: str) -> None:
+    """The replica process: connect back to the parent, build the
+    engine from the spec frame, then self-tick — serve ops between
+    ticks, stream terminal responses, heartbeat on an interval, and
+    re-dial the listener if the connection drops."""
+    import selectors
+
+    def dial() -> socket.socket:
+        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_frame(s, {"op": "hello", "token": token})
+        return s
+
+    sock = dial()
+    spec_msg = recv_frame(sock)
+    assert spec_msg and spec_msg.get("op") == "spec", spec_msg
+    spec = ReplicaSpec(**spec_msg["spec"])
+    engine = _build_engine(spec)
+    send_frame(sock, {"op": "ready",
+                      "default_max_new_tokens":
+                          engine.backend.gen.max_new_tokens,
+                      "queue_capacity": engine.queue.capacity,
+                      "num_slots": engine.backend.num_slots})
+
+    sel = selectors.DefaultSelector()
+    sel.register(sock, selectors.EVENT_READ)
+    send_lock = threading.Lock()
+    link = {"sock": sock, "up": True}
+
+    def resync(old: socket.socket) -> Optional[socket.socket]:
+        """Reconnect loop: re-dial the parent's listener until it
+        answers or the window closes."""
+        sel.unregister(old)
+        try:
+            old.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                s = dial()
+            except OSError:
+                time.sleep(0.1)
+                continue
+            sel.register(s, selectors.EVENT_READ)
+            link["sock"] = s
+            return s
+        return None
+
+    def hb_pump() -> None:
+        # Heartbeats come from their OWN thread: the main loop blocks
+        # for seconds inside jit compiles (first prefill/decode of each
+        # bucket), and a parent watching heartbeat age would declare a
+        # perfectly healthy-but-compiling replica wedged. XLA releases
+        # the GIL while compiling, so this thread keeps the health
+        # signal flowing through exactly those stalls. Send failures
+        # are ignored — the main loop owns reconnect.
+        while link["up"]:
+            time.sleep(spec.heartbeat_interval_s)
+            try:
+                send_frame(link["sock"], _heartbeat(engine), send_lock)
+            except OSError:
+                pass
+
+    threading.Thread(target=hb_pump, daemon=True).start()
+
+    while True:
+        now = time.monotonic()
+        busy = not engine.idle or (engine.draining and not engine.drained)
+        events = sel.select(timeout=0.0 if busy else 0.02)
+        for _ in events:
+            try:
+                msg = recv_frame(sock)
+                if msg is None:
+                    raise OSError("EOF")
+            except OSError:
+                sock = resync(sock)
+                if sock is None:
+                    return
+                continue
+            if msg.get("op") == "shutdown":
+                try:
+                    send_frame(sock, {"op": "reply",
+                                      "rpc": msg.get("rpc"),
+                                      "value": True}, send_lock)
+                except OSError:
+                    pass
+                return
+            try:
+                value = _child_op(engine, msg, time.monotonic())
+                reply = {"op": "reply", "rpc": msg.get("rpc"),
+                         "value": value}
+            except Exception as e:                # noqa: BLE001 — wire it
+                reply = {"op": "reply", "rpc": msg.get("rpc"),
+                         "error": [type(e).__name__, str(e)]}
+            try:
+                send_frame(sock, reply, send_lock)
+            except OSError:
+                sock = resync(sock)
+                if sock is None:
+                    return
+
+        if busy:
+            for resp in engine.tick():
+                try:
+                    send_frame(sock, {
+                        "op": "response", "id": resp.request_id,
+                        "tokens": list(map(int, resp.tokens)),
+                        "status": resp.status,
+                        "finish_reason": resp.finish_reason,
+                        "prompt_len": resp.prompt_len,
+                        "ttft": resp.ttft, "latency": resp.latency},
+                        send_lock)
+                except OSError:
+                    sock = resync(sock)
+                    if sock is None:
+                        return
+
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="pipe_tpu fleet replica worker (spawned by "
+                    "ProcessReplicaTransport; not a user entry point)")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--token", required=True)
+    args = ap.parse_args(argv)
+    worker(args.port, args.token)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

@@ -1,0 +1,118 @@
+"""Mesh-aware replica spawn plans: carve the device grid into fleets.
+
+A serving fleet multiplies the pipeline topology: each replica wants
+its OWN ``(stage, data)`` sub-mesh (``runtime/distributed
+.global_pipeline_mesh`` shape), and replicas must not interleave
+devices — a replica that straddles two processes would put its stage
+ring's ``ppermute`` on the cross-host fabric AND couple its failure
+domain to a neighbour's. The carve here is therefore contiguous and
+process-aligned: replica *i* owns devices
+``[i*per, (i+1)*per)`` of the (process-major) global device list, so a
+replica either fits inside one process or owns whole processes — never
+a fractional share of two.
+
+:func:`replica_device_plan` is the pure planning half (validation +
+index ranges, no jax import needed beyond the device list);
+:func:`carve_replica_meshes` materializes one
+:class:`jax.sharding.Mesh` per replica via the same
+``global_pipeline_mesh`` builder the trainer uses, so every sub-mesh
+inherits the stage-on-ICI / data-on-DCN axis discipline.
+
+The process transport composes with this per-replica: a spawn plan's
+``local_devices`` count feeds :class:`~.proc.ReplicaSpec` so each
+child interpreter forces exactly its share of (host) devices — on CPU
+that is the ``--xla_force_host_platform_device_count`` trick, on real
+hardware each child process would enumerate only its visible chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+__all__ = ["ReplicaDevices", "replica_device_plan", "carve_replica_meshes"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaDevices:
+    """One replica's slice of the device grid: global-list index range
+    ``[start, stop)`` plus the (n_stages, n_data) mesh shape it will be
+    folded into."""
+
+    index: int
+    start: int
+    stop: int
+    n_stages: int
+    n_data: int
+
+    @property
+    def n_devices(self) -> int:
+        return self.stop - self.start
+
+
+def replica_device_plan(n_replicas: int, n_stages: int,
+                        n_data: Optional[int] = None, *,
+                        n_devices: Optional[int] = None,
+                        devices_per_process: Optional[int] = None
+                        ) -> List[ReplicaDevices]:
+    """Split ``n_devices`` into ``n_replicas`` contiguous
+    ``n_stages x n_data`` sub-meshes; raises ``ValueError`` with the
+    arithmetic spelled out when the grid doesn't divide.
+
+    ``devices_per_process`` (when known) adds the process-alignment
+    check: each replica's share must be a multiple OR a divisor of one
+    process's device count, so no replica takes a fractional share of
+    two processes.
+    """
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    if n_stages < 1:
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if n_devices is None:
+        import jax
+        n_devices = len(jax.devices())
+    if n_devices % n_replicas:
+        raise ValueError(
+            f"{n_devices} devices do not split over {n_replicas} "
+            f"replicas ({n_devices} % {n_replicas} != 0)")
+    per = n_devices // n_replicas
+    if per % n_stages:
+        raise ValueError(
+            f"each replica's {per} devices do not fold into "
+            f"n_stages={n_stages} ({per} % {n_stages} != 0)")
+    data = per // n_stages if n_data is None else n_data
+    if n_stages * data != per:
+        raise ValueError(
+            f"replica mesh {n_stages}x{data} needs {n_stages * data} "
+            f"devices but each replica owns {per}")
+    if devices_per_process is not None and devices_per_process > 0:
+        if per % devices_per_process and devices_per_process % per:
+            raise ValueError(
+                f"replica share of {per} devices straddles the process "
+                f"boundary ({devices_per_process} devices/process): a "
+                f"replica must own whole processes or fit inside one")
+    return [ReplicaDevices(index=i, start=i * per, stop=(i + 1) * per,
+                           n_stages=n_stages, n_data=data)
+            for i in range(n_replicas)]
+
+
+def carve_replica_meshes(n_replicas: int, n_stages: int,
+                         n_data: Optional[int] = None, *,
+                         devices: Optional[Sequence] = None,
+                         stage_across: bool = False) -> list:
+    """One ``(stage, data)`` :class:`jax.sharding.Mesh` per replica,
+    carved contiguously from ``devices`` (default: all global devices)
+    through the same builder the trainer uses — returns a list of
+    meshes, index-aligned with the plan from
+    :func:`replica_device_plan`."""
+    import jax
+
+    from ..runtime.distributed import global_pipeline_mesh
+    devices = list(devices if devices is not None else jax.devices())
+    plan = replica_device_plan(n_replicas, n_stages, n_data,
+                               n_devices=len(devices))
+    return [global_pipeline_mesh(
+                n_stages, rd.n_data,
+                devices=devices[rd.start:rd.stop],
+                stage_across=stage_across)
+            for rd in plan]

@@ -1177,6 +1177,114 @@ class SingleDeviceSlotBackend:
         return {"prefill_programs": len(self._prefill_programs),
                 "decode_chunk": self.decode_chunk, "kv": "slab"}
 
+    # -- KV handoff (fleet session remap) ----------------------------------
+
+    def export_prefix_payload(self, prompt: Sequence[int],
+                              codec: str = "int8") -> Optional[dict]:
+        """Serialize this backend's cached shared-prefix blocks covering
+        ``prompt`` for a fleet KV handoff. ``codec="raw"`` ships the
+        pool's stored bytes exactly (in-process handoff — bitwise, so
+        prefix hits on the destination preserve token parity);
+        ``codec="int8"`` quantizes float rows through
+        :func:`~..inference.quant.quantize_kv_rows` for the wire (int8
+        pools are already their own int8 path and ship raw either way).
+        Returns None when there is no pool or no cached prefix."""
+        if not self.paged:
+            return None
+        if codec not in ("raw", "int8"):
+            raise ValueError(f"codec must be raw|int8, got {codec!r}")
+        entries = self.pool.cached_prefix_entries(prompt)
+        if not entries:
+            return None
+        bids = jnp.asarray([b for _, b in entries], jnp.int32)
+        int8_storage = "k_scale" in self._pool_kv
+        arrays = {}
+        if int8_storage or codec == "raw":
+            names = (("k", "v", "k_scale", "v_scale") if int8_storage
+                     else ("k", "v"))
+            for name in names:
+                arrays[name] = np.asarray(
+                    jnp.take(self._pool_kv[name], bids, axis=1))
+            wire_codec = "raw"
+        else:
+            from ..inference.quant import quantize_kv_rows
+            for name in ("k", "v"):
+                q, s = quantize_kv_rows(
+                    jnp.take(self._pool_kv[name], bids, axis=1))
+                arrays[name] = np.asarray(q)
+                arrays[name + "_scale"] = np.asarray(s)
+            wire_codec = "int8"
+        nbytes = sum(a.nbytes for a in arrays.values())
+        get_registry().counter("serve.kv.prefix_exported").inc(len(entries))
+        return {"hashes": [h for h, _ in entries],
+                "block_size": self.pool.block_size,
+                "n_layers": self._n_layers,
+                "codec": wire_codec,
+                "int8_storage": int8_storage,
+                "arrays": arrays,
+                "nbytes": nbytes}
+
+    def import_prefix_payload(self, payload: dict) -> int:
+        """Seat an exported prefix payload into this backend's pool:
+        allocate destination blocks, write the rows onto the device
+        arrays, and register the hashes as refs-0 cached entries (the
+        next admission takes the refs). Hashes already cached locally
+        are skipped; returns the number of blocks actually seated (0
+        for slab backends or a geometry mismatch — a handoff between
+        heterogeneous pools is a silent no-op, not an error: the
+        destination simply re-prefills cold)."""
+        if not self.paged:
+            return 0
+        if (payload.get("block_size") != self.pool.block_size
+                or payload.get("n_layers") != self._n_layers):
+            return 0
+        int8_storage = "k_scale" in self._pool_kv
+        fresh = [(i, h) for i, h in enumerate(payload["hashes"])
+                 if h not in self.pool._cached]
+        if not fresh:
+            return 0
+        dst = self.pool.take_blocks(len(fresh))
+        fresh = fresh[:len(dst)]
+        if not fresh:
+            return 0
+        src_idx = jnp.asarray([i for i, _ in fresh], jnp.int32)
+        dst_idx = jnp.asarray(dst, jnp.int32)
+        arrays = payload["arrays"]
+        codec = payload.get("codec", "raw")
+        if codec == "raw" and payload.get("int8_storage") == int8_storage:
+            names = (("k", "v", "k_scale", "v_scale") if int8_storage
+                     else ("k", "v"))
+            for name in names:
+                rows = jnp.take(jnp.asarray(arrays[name]), src_idx, axis=1)
+                self._pool_kv[name] = self._pool_kv[name].at[
+                    :, dst_idx].set(rows.astype(self._pool_kv[name].dtype))
+        else:
+            # cross-codec: materialize float rows, then store in this
+            # pool's own layout (re-quantizing for int8 storage)
+            from ..inference.quant import quantize_kv_rows
+            for name in ("k", "v"):
+                rows = jnp.take(jnp.asarray(arrays[name]), src_idx, axis=1)
+                if codec == "int8" or payload.get("int8_storage"):
+                    scale = jnp.take(
+                        jnp.asarray(arrays[name + "_scale"]), src_idx,
+                        axis=1)
+                    rows = rows.astype(jnp.float32) * scale
+                if int8_storage:
+                    q, s = quantize_kv_rows(rows)
+                    self._pool_kv[name] = \
+                        self._pool_kv[name].at[:, dst_idx].set(q)
+                    sa = self._pool_kv[name + "_scale"]
+                    self._pool_kv[name + "_scale"] = \
+                        sa.at[:, dst_idx].set(s)
+                else:
+                    self._pool_kv[name] = self._pool_kv[name].at[
+                        :, dst_idx].set(
+                            rows.astype(self._pool_kv[name].dtype))
+        seated = self.pool.seat_prefix(
+            [(h, int(b)) for (_, h), b in zip(fresh, dst)])
+        get_registry().counter("serve.kv.prefix_imported").inc(seated)
+        return seated
+
 
 class ServeEngine:
     """The continuous-batching scheduler over a slot backend.

@@ -199,6 +199,48 @@ class KvPool:
             return 0
         return self._lookup(self.prefix_hashes(prompt))
 
+    def cached_prefix_entries(
+            self, prompt: Sequence[int]) -> List[Tuple[str, int]]:
+        """The leading cached full blocks of ``prompt`` as
+        ``(hash, physical_block_id)`` pairs — what a KV handoff exports
+        from a session's old home replica."""
+        if not self.prefix_cache:
+            return []
+        hashes = self.prefix_hashes(prompt)
+        return [(h, self._cached[h].block)
+                for h in hashes[:self._lookup(hashes)]]
+
+    def take_blocks(self, n: int) -> List[int]:
+        """Pop up to ``n`` physical blocks (free first, then LRU
+        eviction) for an external write — the import side of a KV
+        handoff. Returns fewer than ``n`` when the pool can't cover it;
+        the caller seats what fit."""
+        out: List[int] = []
+        for _ in range(n):
+            try:
+                out.append(self._alloc())
+            except PoolExhausted:
+                break
+        return out
+
+    def seat_prefix(self, entries: Sequence[Tuple[str, int]]) -> int:
+        """Register externally-written blocks as cached prefix entries
+        (refs=0 → LRU-evictable, exactly the state :meth:`release`
+        leaves a retired slot's published blocks in). The block content
+        must already be on device. Skips hashes already cached —
+        returning the colliding block to the free list — so a handoff
+        racing a local prefill never double-registers."""
+        n = 0
+        for h, bid in entries:
+            if not self.prefix_cache or h in self._cached:
+                self._free.append(bid)
+                continue
+            self._cached[h] = _Cached(bid)
+            self._lru[h] = bid
+            self._lru.move_to_end(h)
+            n += 1
+        return n
+
     def invalidate(self, hashes: Sequence[str]) -> int:
         """Drop cached entries (router KV handoff: a session remapped
         off a sick home replica must not find a stale prefix here).

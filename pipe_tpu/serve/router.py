@@ -1,11 +1,14 @@
 """Fleet front door: N serve-engine replicas behind one request queue.
 
-One :class:`~.engine.ServeEngine` is S slots on one device (or one
-ring); the north star serves heavy traffic, which means N replicas and
-the question PR 5 left open: what happens when one of them wedges? The
-:class:`Router` answers it the same way the rest of the stack answers
-everything — host-side table maintenance over signals the hot path
-already produces:
+The placement/health/exactly-once state machine PR 7 built here now
+lives in :mod:`~..fleet.control` (the transport-agnostic
+:class:`~..fleet.control.FleetController`), where it coordinates both
+in-process engines and real OS-process replicas
+(:mod:`~..fleet.proc`). This module keeps the original engine-facing
+constructor — :class:`Router` is the controller over
+:class:`~..fleet.control.InProcessTransport` wrappers, one per engine —
+so every existing caller and the pinned ``tests/test_router.py`` suite
+run unchanged, byte-for-byte:
 
 * **One front queue, N replica queues.** Callers submit to the router's
   bounded :class:`~.queue.RequestQueue` (ids are fleet-unique — replica
@@ -22,9 +25,7 @@ already produces:
   the engines already export — the :class:`~..resilience.TickWatchdog`
   read-only surface (``slow_streak``, ``miss_ewma``) plus
   ``ServeEngine.consecutive_decode_errors`` and retryable-failure
-  responses. No extra device syncs: health is decided from host
-  bookkeeping, keeping the per-replica hot path as host-free as the SET
-  stream-event-triggered direction demands. States::
+  responses. States::
 
       HEALTHY --(slow streak / decode error / retryable failure)--> SUSPECT
       SUSPECT --(recover_healthy_ticks clean ticks)--> HEALTHY
@@ -57,135 +58,37 @@ already produces:
 The router is strictly additive: not constructing one changes nothing
 anywhere (``apps/serve.py`` keeps the direct single-engine path, and
 the engines' decode HLO is byte-identical — same opt-out-is-absent
-discipline as the resilience layer). Single-threaded like the engine
-tick loop; replica chaos (``wedge_replica``/``kill_replica``/
-``slow_replica``) wraps the replica backends only when a
+discipline as the resilience layer). The default serial mode is
+single-threaded like the engine tick loop; ``async_tick=True`` gives
+each replica its own tick thread (:class:`~..fleet.control
+.InProcessTransport` async mode), so one slow replica no longer stalls
+its siblings — the fleet ``tick()`` then only sweeps/places/delivers.
+Replica chaos (``wedge_replica``/``kill_replica``/``slow_replica``)
+wraps the replica backends only when a
 :class:`~..resilience.ChaosPlan` is passed.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence
 
-from ..obs.events import NULL_EVENT_LOG, REQUEST
-from ..obs.telemetry import get_registry, labelled
-from .engine import EngineDraining, ServeEngine
-from .queue import QueueFull, Request, RequestQueue, Response
+from ..fleet.control import (DRAINING, HEALTHY, RETIRED, RETRYABLE_REASONS,
+                             STATES, SUSPECT, WEDGED, _STATE_CODE,
+                             FleetController, InProcessTransport, Replica,
+                             RouterPolicy)
+from .engine import ServeEngine
+from .queue import RequestQueue
 
 __all__ = ["Router", "RouterPolicy", "Replica",
            "HEALTHY", "SUSPECT", "WEDGED", "DRAINING", "RETIRED"]
 
-HEALTHY = "healthy"
-SUSPECT = "suspect"
-WEDGED = "wedged"
-DRAINING = "draining"
-RETIRED = "retired"
-STATES = (HEALTHY, SUSPECT, WEDGED, DRAINING, RETIRED)
-_STATE_CODE = {s: i for i, s in enumerate(STATES)}
-
-# Engine finish_reasons the router may retry on another replica; every
-# other terminal outcome is delivered as-is.
-RETRYABLE_REASONS = ("backend_error", "stuck")
+# re-exported for callers that imported them from here
+_ = (STATES, _STATE_CODE, RETRYABLE_REASONS)
 
 
-@dataclasses.dataclass
-class RouterPolicy:
-    """Fleet policy knobs. Defaults are deliberately conservative —
-    quick to stop placing on a sick replica (SUSPECT is cheap: work
-    just goes elsewhere), slow to wedge (WEDGED is one-way).
-
-    ``placement`` — ``least_loaded`` picks the replica with the fewest
-    queued+live requests (ties: lowest index); ``session`` pins each
-    ``session`` key to its first replica while that replica is HEALTHY
-    (KV-cache/prefix locality for multi-turn traffic) and falls back to
-    least-loaded — remapping the session — when it isn't.
-
-    ``retry_budget`` — max *placements* per request (``Request.attempts``
-    is the ledger); a retryable failure at ``attempts >= retry_budget``
-    is terminal. ``backoff_base_s``/``backoff_max_s`` shape the parked
-    delay ``min(base * 2^(attempts-1), max)``; base 0 retries on the
-    next tick (what deterministic fake-clock tests want — a parked
-    request is only eligible once the queue clock passes its delay).
-
-    SUSPECT triggers: ``suspect_slow_streak`` consecutive over-budget
-    ticks (watchdog), any decode error, any retryable failure this
-    tick, or ``suspect_miss_ewma`` (None disables the EWMA trigger).
-    ``recover_healthy_ticks`` clean ticks clear SUSPECT. WEDGE
-    triggers: ``wedge_slow_streak`` consecutive slow ticks,
-    ``wedge_decode_errors`` consecutive decode errors (keep it below
-    the engine's ``decode_error_limit``, which resets the streak), or
-    ``wedge_error_ticks`` *cumulative* ticks that produced retryable
-    failures (catches prefill-side death, where no decode streak ever
-    forms).
-
-    Lifecycle: ``spawn_depth``/``spawn_sustain_ticks``/``max_replicas``
-    gate the spawn hook; ``retire_idle_ticks``/``min_replicas`` gate
-    idle retirement (None disables).
-    """
-
-    placement: str = "least_loaded"
-    retry_budget: int = 3
-    backoff_base_s: float = 0.05
-    backoff_max_s: float = 2.0
-    suspect_slow_streak: int = 2
-    suspect_miss_ewma: Optional[float] = None
-    recover_healthy_ticks: int = 3
-    wedge_slow_streak: int = 6
-    wedge_decode_errors: int = 2
-    wedge_error_ticks: int = 3
-    spawn_depth: Optional[int] = None
-    spawn_sustain_ticks: int = 10
-    max_replicas: int = 8
-    retire_idle_ticks: Optional[int] = None
-    min_replicas: int = 1
-
-    def __post_init__(self):
-        if self.placement not in ("least_loaded", "session"):
-            raise ValueError(
-                f"placement must be least_loaded|session, got "
-                f"{self.placement!r}")
-        if self.retry_budget < 1:
-            raise ValueError(
-                f"retry_budget must be >= 1, got {self.retry_budget}")
-        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
-            raise ValueError("backoff seconds must be >= 0")
-        for fld in ("suspect_slow_streak", "recover_healthy_ticks",
-                    "wedge_slow_streak", "wedge_decode_errors",
-                    "wedge_error_ticks", "spawn_sustain_ticks",
-                    "max_replicas", "min_replicas"):
-            if getattr(self, fld) < 1:
-                raise ValueError(f"{fld} must be >= 1")
-
-
-class Replica:
-    """Router-side record of one engine replica: health state plus the
-    hysteresis counters the state machine runs on."""
-
-    __slots__ = ("index", "engine", "state", "healthy_streak",
-                 "idle_ticks", "error_ticks", "had_error_this_tick")
-
-    def __init__(self, index: int, engine: ServeEngine):
-        self.index = index
-        self.engine = engine
-        self.state = HEALTHY
-        self.healthy_streak = 0
-        self.idle_ticks = 0
-        self.error_ticks = 0          # cumulative ticks with retryable fails
-        self.had_error_this_tick = False
-
-    @property
-    def load(self) -> int:
-        return self.engine.queue.depth + self.engine.live_slots
-
-    def __repr__(self) -> str:
-        return (f"Replica({self.index}, state={self.state}, "
-                f"load={self.load})")
-
-
-class Router:
+class Router(FleetController):
     """Shard one front :class:`~.queue.RequestQueue` across N
     :class:`~.engine.ServeEngine` replicas with health-gated failover.
 
@@ -196,7 +99,8 @@ class Router:
     ``chaos`` arms replica-level fault injection
     (:data:`~..resilience.chaos.REPLICA_KINDS`, addressed by
     ``Fault.stage`` = replica index); None leaves the backends
-    untouched.
+    untouched. ``async_tick=True`` runs each replica under its own tick
+    thread instead of the serial per-``tick()`` round-robin.
 
     The surface mirrors :class:`~.engine.ServeEngine` — ``submit`` /
     ``tick`` / ``cancel`` / ``response`` / ``drain`` / ``idle`` /
@@ -209,7 +113,8 @@ class Router:
                  policy: RouterPolicy = RouterPolicy(),
                  spawn_fn: Optional[Callable[[], ServeEngine]] = None,
                  chaos=None, event_log=None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 async_tick: bool = False):
         engines = list(engines)
         if not engines:
             raise ValueError("Router needs at least one engine replica")
@@ -233,32 +138,25 @@ class Router:
                 raise ValueError(
                     "every replica engine must run on the front queue's "
                     "clock (deadlines are absolute in one clock domain)")
-        self.queue = queue
-        self.clock = queue.clock
-        self.policy = policy
-        self.spawn_fn = spawn_fn
         self.chaos = chaos
-        self.events = event_log if event_log is not None else NULL_EVENT_LOG
-        self.replicas: List[Replica] = []
-        for eng in engines:
-            self._add_replica(eng)
-        self._responses: Dict[int, Response] = {}
-        self._tracked: Dict[int, Request] = {}
-        self._parked: List[Tuple[float, Request]] = []
-        self._session_of: Dict[int, str] = {}
-        self._session_map: Dict[str, int] = {}
-        self._placed_on: Dict[int, int] = {}
-        self._tick_index = 0
-        self._depth_streak = 0
-        self._draining = False
+        self.async_tick = bool(async_tick)
+        wrapped_spawn = None
+        if spawn_fn is not None:
+            def wrapped_spawn():
+                return InProcessTransport(spawn_fn(),
+                                          async_tick=self.async_tick)
+        super().__init__(
+            [InProcessTransport(e, async_tick=self.async_tick)
+             for e in engines],
+            queue, policy=policy, spawn_fn=wrapped_spawn,
+            event_log=event_log)
 
     # -- construction helpers ----------------------------------------------
 
-    def _add_replica(self, engine: ServeEngine) -> Replica:
-        rep = Replica(len(self.replicas), engine)
+    def _add_replica(self, transport: InProcessTransport) -> Replica:
+        rep = super()._add_replica(transport)
         if self.chaos is not None:
             self._install_chaos(rep)
-        self.replicas.append(rep)
         return rep
 
     def _install_chaos(self, rep: Replica) -> None:
@@ -306,455 +204,3 @@ class Router:
 
         backend.decode = chaotic_decode
         backend.prefill = chaotic_prefill
-
-    # -- front door --------------------------------------------------------
-
-    def submit(self, prompt: Sequence[int], *,
-               max_new_tokens: Optional[int] = None, seed: int = 0,
-               priority: int = 0, timeout_s: Optional[float] = None,
-               session: Optional[str] = None) -> Request:
-        """Validate + enqueue at the fleet front door. Raises
-        ``ValueError`` on an unservable request,
-        :class:`~.engine.EngineDraining` after :meth:`drain`, and
-        :class:`~.queue.QueueFull` when the front queue is at capacity —
-        which is exactly what happens when every replica is SUSPECT or
-        worse: placement stops, the front fills, callers feel
-        backpressure instead of silent loss."""
-        reg = get_registry()
-        if self._draining:
-            raise EngineDraining(
-                "fleet is draining: live requests are finishing and no "
-                "new work is admitted")
-        backend = self.replicas[0].engine.backend
-        if max_new_tokens is None:
-            max_new_tokens = backend.gen.max_new_tokens
-        backend.validate(len(prompt), max_new_tokens)
-        try:
-            req = self.queue.submit(prompt, max_new_tokens=max_new_tokens,
-                                    seed=seed, priority=priority,
-                                    timeout_s=timeout_s)
-        except QueueFull:
-            reg.counter("serve.fleet.rejected").inc()
-            raise
-        self._tracked[req.id] = req
-        if session is not None:
-            self._session_of[req.id] = str(session)
-        reg.counter("serve.fleet.submitted").inc()
-        reg.gauge("serve.fleet.front_depth").set(self.queue.depth)
-        return req
-
-    def cancel(self, request_id: int) -> bool:
-        """Mark a live request cancelled wherever it currently sits —
-        front queue, parked for retry, a replica's queue, or a running
-        slot. One flag flip on the shared :class:`~.queue.Request`;
-        whichever sweep sees it first emits the single terminal
-        ``cancelled`` response. False for unknown/terminal ids."""
-        req = self._tracked.get(request_id)
-        if req is None:
-            return False
-        req.cancelled = True
-        return True
-
-    def response(self, request_id: int) -> Optional[Response]:
-        return self._responses.get(request_id)
-
-    # -- drain / status ----------------------------------------------------
-
-    def drain(self) -> None:
-        """Fleet-wide graceful shutdown: ``submit`` starts raising, the
-        next tick sheds front-queued and parked work
-        (``finish_reason="drain"``) and every replica drains its live
-        slots. Idempotent."""
-        if not self._draining:
-            self._draining = True
-            self.events.event("resilience", action="fleet_drain",
-                              front=self.queue.depth,
-                              parked=len(self._parked))
-            for rep in self.replicas:
-                if rep.state != RETIRED:
-                    rep.engine.drain()
-
-    @property
-    def draining(self) -> bool:
-        return self._draining
-
-    @property
-    def drained(self) -> bool:
-        return self._draining and self.idle
-
-    @property
-    def idle(self) -> bool:
-        return (self.queue.depth == 0 and not self._parked
-                and all(r.engine.idle for r in self.replicas))
-
-    def counts(self) -> Dict[str, int]:
-        """Replica count per health state (``{state: n}``)."""
-        out = {s: 0 for s in STATES}
-        for rep in self.replicas:
-            out[rep.state] += 1
-        return out
-
-    # -- delivery (the exactly-once ledger) --------------------------------
-
-    def _deliver(self, resp: Response) -> Response:
-        if resp.request_id in self._responses:
-            raise RuntimeError(
-                f"duplicate terminal response for request "
-                f"{resp.request_id} (exactly-once delivery violated)")
-        self._responses[resp.request_id] = resp
-        req = self._tracked.pop(resp.request_id, None)
-        self._session_of.pop(resp.request_id, None)
-        self._placed_on.pop(resp.request_id, None)
-        self.queue.forget(resp.request_id)
-        reg = get_registry()
-        reg.counter("serve.fleet.delivered").inc()
-        if resp.status == "ok":
-            reg.counter("serve.fleet.ok").inc()
-        if req is not None and req.attempts > 1:
-            reg.counter("serve.fleet.failed_over").inc()
-        return resp
-
-    def _finish_unplaced(self, req: Request, status: str, reason: str,
-                         now: float) -> Response:
-        """Terminal record for a request that never (re)reached a
-        replica: front-reaped, parked-reaped, shed on fleet drain, or
-        retries exhausted."""
-        resp = Response(request_id=req.id, tokens=[], status=status,
-                        finish_reason=reason, prompt_len=len(req.prompt),
-                        ttft=None, latency=now - req.submitted_at)
-        self.events.event(REQUEST, request=req.id, status=status,
-                          finish_reason=reason, replica=None,
-                          attempts=req.attempts)
-        return self._deliver(resp)
-
-    # -- retry parking -----------------------------------------------------
-
-    def reclaim(self, requests: List[Request], now: float) -> List[Response]:
-        """Re-absorb requests knocked off a replica — the ONE
-        park-or-finish decision both recovery paths share (a wedged
-        replica's evicted backlog and per-request retryable failures
-        from a live tick), so the exactly-once ledger has a single
-        writer. Per request: cancelled or past its deadline → parked
-        for the next sweep's terminal cancelled/timeout record; retry
-        budget remaining → parked with exponential backoff; else ONE
-        terminal ``retries_exhausted`` error. Returns the terminal
-        responses (already recorded in the ledger); parked requests
-        surface through later ticks."""
-        reg = get_registry()
-        finished: List[Response] = []
-        for req in requests:
-            if req.cancelled or (req.deadline is not None
-                                 and now >= req.deadline):
-                # next tick's parked sweep emits the terminal
-                # cancelled/timeout record
-                self._parked.append((now, req))
-            elif req.attempts < self.policy.retry_budget:
-                self._park(req, now)
-            else:
-                reg.counter("serve.fleet.retries_exhausted").inc()
-                finished.append(self._finish_unplaced(
-                    req, "error", "retries_exhausted", now))
-        return finished
-
-    def _park(self, req: Request, now: float) -> None:
-        p = self.policy
-        delay = min(p.backoff_base_s * (2.0 ** max(req.attempts - 1, 0)),
-                    p.backoff_max_s)
-        self._parked.append((now + delay, req))
-        get_registry().counter("serve.fleet.retried").inc()
-        self.events.event("resilience", action="retry_parked",
-                          request=req.id, attempts=req.attempts,
-                          delay_s=delay)
-
-    # -- placement ---------------------------------------------------------
-
-    def _placeable(self) -> List[Replica]:
-        return [r for r in self.replicas
-                if r.state == HEALTHY
-                and r.engine.queue.depth < r.engine.queue.capacity]
-
-    def _choose(self, req: Request, candidates: List[Replica]) -> Replica:
-        if self.policy.placement == "session":
-            sess = self._session_of.get(req.id)
-            if sess is not None:
-                home = self._session_map.get(sess)
-                for rep in candidates:
-                    if rep.index == home:
-                        return rep
-        return min(candidates, key=lambda r: (r.load, r.index))
-
-    def _kv_handoff(self, req: Request, sess: str, old_idx: int,
-                    new_rep: Replica) -> None:
-        """Session-remap KV bookkeeping (paged pools only — ``pool`` is
-        absent on slab backends and the whole hook is a no-op). The
-        prefix blocks the session populated on its old home are
-        invalidated there: the conversation's KV continues on the new
-        home, so a later remap BACK must re-prefill rather than extend a
-        stale prefix. The new home is probed for warm prefix blocks so
-        the handoff cost (cold re-prefill vs shared-prefix hit) is
-        observable per remap."""
-        reg = get_registry()
-        reg.counter("serve.fleet.kv_handoff_total").inc()
-        old_pool = getattr(
-            self.replicas[old_idx].engine.backend, "pool", None)
-        invalidated = 0
-        if old_pool is not None:
-            invalidated = old_pool.invalidate(
-                old_pool.prefix_hashes(req.prompt))
-            if invalidated:
-                reg.counter(
-                    "serve.fleet.kv_handoff_invalidated").inc(invalidated)
-        new_pool = getattr(new_rep.engine.backend, "pool", None)
-        warm = (new_pool.cached_prefix_blocks(req.prompt)
-                if new_pool is not None else 0)
-        reg.counter("serve.fleet.kv_handoff_warm" if warm
-                    else "serve.fleet.kv_handoff_cold").inc()
-        self.events.event("resilience", action="kv_handoff",
-                          request=req.id, session=sess,
-                          from_replica=old_idx, to_replica=new_rep.index,
-                          invalidated=invalidated, warm_blocks=warm)
-
-    def _try_place(self, req: Request, now: float) -> bool:
-        candidates = self._placeable()
-        if not candidates:
-            return False
-        rep = self._choose(req, candidates)
-        sess = self._session_of.get(req.id)
-        if sess is not None:
-            home = self._session_map.get(sess)
-            if home is not None and home != rep.index:
-                self._kv_handoff(req, sess, home, rep)
-        rep.engine.place(req)               # increments req.attempts
-        self._placed_on[req.id] = rep.index
-        if sess is not None and rep.state == HEALTHY:
-            self._session_map[sess] = rep.index
-        return True
-
-    # -- health state machine ----------------------------------------------
-
-    def _wedge(self, rep: Replica, reason: str, now: float) -> None:
-        """WEDGED: reclaim the backlog intact, re-place or park it under
-        the retry budget, and start draining the live slots. One-way."""
-        rep.state = WEDGED
-        get_registry().counter("serve.fleet.wedged").inc()
-        evicted = rep.engine.evict_queued()
-        self.events.event("resilience", action="replica_wedged",
-                          replica=rep.index, reason=reason,
-                          evicted=len(evicted))
-        # terminal responses land in the ledger; tick's delivered list
-        # picks them up via response() like any mid-health-pass finish
-        self.reclaim(evicted, now)
-        rep.engine.drain()
-        rep.state = DRAINING
-
-    def _update_health(self, rep: Replica, now: float) -> None:
-        p = self.policy
-        if rep.state == RETIRED:
-            return
-        if rep.state == DRAINING:
-            if rep.engine.drained:
-                rep.state = RETIRED
-                get_registry().counter("serve.fleet.retired").inc()
-                self.events.event("resilience", action="replica_retired",
-                                  replica=rep.index)
-            return
-
-        wd = rep.engine.watchdog
-        slow = wd.slow_streak if wd is not None else 0
-        ewma = wd.miss_ewma if wd is not None else 0.0
-        derr = rep.engine.consecutive_decode_errors
-        if rep.had_error_this_tick:
-            rep.error_ticks += 1
-
-        if (slow >= p.wedge_slow_streak or derr >= p.wedge_decode_errors
-                or rep.error_ticks >= p.wedge_error_ticks):
-            self._wedge(rep, f"slow_streak={slow} decode_errors={derr} "
-                             f"error_ticks={rep.error_ticks}", now)
-            return
-
-        bad = (slow >= p.suspect_slow_streak or derr > 0
-               or rep.had_error_this_tick
-               or (p.suspect_miss_ewma is not None
-                   and ewma > p.suspect_miss_ewma))
-        if rep.state == HEALTHY and bad:
-            rep.state = SUSPECT
-            rep.healthy_streak = 0
-            get_registry().counter("serve.fleet.suspected").inc()
-            self.events.event("resilience", action="replica_suspect",
-                              replica=rep.index, slow_streak=slow,
-                              decode_errors=derr, miss_ewma=ewma)
-        elif rep.state == SUSPECT:
-            if bad:
-                rep.healthy_streak = 0
-            else:
-                rep.healthy_streak += 1
-                if rep.healthy_streak >= p.recover_healthy_ticks:
-                    rep.state = HEALTHY
-                    rep.healthy_streak = 0
-                    get_registry().counter("serve.fleet.recovered").inc()
-                    self.events.event("resilience",
-                                      action="replica_recovered",
-                                      replica=rep.index)
-
-    def _lifecycle(self, now: float) -> None:
-        """Spawn on sustained front-queue depth; retire sustained-idle
-        replicas (never below ``min_replicas`` placeable ones)."""
-        p = self.policy
-        active = [r for r in self.replicas if r.state in (HEALTHY, SUSPECT)]
-        if p.spawn_depth is not None and self.spawn_fn is not None:
-            if self.queue.depth >= p.spawn_depth:
-                self._depth_streak += 1
-            else:
-                self._depth_streak = 0
-            if self._depth_streak >= p.spawn_sustain_ticks \
-                    and len(active) < p.max_replicas:
-                rep = self._add_replica(self.spawn_fn())
-                self._depth_streak = 0
-                get_registry().counter("serve.fleet.spawned").inc()
-                self.events.event("resilience", action="replica_spawned",
-                                  replica=rep.index,
-                                  front_depth=self.queue.depth)
-        if p.retire_idle_ticks is None:
-            return
-        for rep in self.replicas:
-            if rep.state != HEALTHY:
-                continue
-            if rep.engine.idle and self.queue.depth == 0 \
-                    and not self._parked:
-                rep.idle_ticks += 1
-            else:
-                rep.idle_ticks = 0
-            active = [r for r in self.replicas
-                      if r.state in (HEALTHY, SUSPECT)]
-            if rep.idle_ticks >= p.retire_idle_ticks \
-                    and len(active) > p.min_replicas:
-                rep.engine.drain()
-                rep.state = DRAINING
-                rep.idle_ticks = 0
-                get_registry().counter("serve.fleet.idle_retired").inc()
-                self.events.event("resilience",
-                                  action="replica_idle_retired",
-                                  replica=rep.index)
-
-    # -- the fleet tick ----------------------------------------------------
-
-    def tick(self) -> List[Response]:
-        """One fleet scheduling round: sweep the front/parked sets,
-        advance every replica's health machine, place onto HEALTHY
-        replicas, tick the replicas, then deliver-or-retry their
-        terminal responses. Returns the responses DELIVERED this tick
-        (retried failures are not delivered — they park)."""
-        reg = get_registry()
-        now = self.clock()
-        tick_idx = self._tick_index
-        delivered: List[Response] = []
-
-        # 0) fleet drain — push back everything not yet on a replica
-        if self._draining:
-            for req in self.queue.evict_all():
-                delivered.append(
-                    self._finish_unplaced(req, "shed", "drain", now))
-            for _, req in self._parked:
-                delivered.append(
-                    self._finish_unplaced(req, "shed", "drain", now))
-            self._parked = []
-
-        # 1) front + parked sweeps — deaths that never cost a replica
-        for req, reason in self.queue.reap(now):
-            status = "cancelled" if reason == "cancelled" else "timeout"
-            delivered.append(
-                self._finish_unplaced(req, status, reason, now))
-        still = []
-        for eligible_at, req in self._parked:
-            if req.cancelled:
-                delivered.append(
-                    self._finish_unplaced(req, "cancelled", "cancelled",
-                                          now))
-            elif req.deadline is not None and now >= req.deadline:
-                delivered.append(
-                    self._finish_unplaced(req, "timeout", "deadline", now))
-            else:
-                still.append((eligible_at, req))
-        self._parked = still
-
-        # 2) health transitions + lifecycle (uses last tick's signals)
-        for rep in self.replicas:
-            self._update_health(rep, now)
-            rep.had_error_this_tick = False
-        if not self._draining:
-            self._lifecycle(now)
-
-        # 2b) dead fleet — no replica can ever serve again (none healthy
-        # or recoverable, no spawn hook armed): fail the stranded work
-        # now instead of parking it forever
-        recoverable = any(r.state in (HEALTHY, SUSPECT)
-                          for r in self.replicas)
-        can_spawn = (self.spawn_fn is not None
-                     and self.policy.spawn_depth is not None)
-        if not recoverable and not can_spawn and not self._draining:
-            stranded = self.queue.evict_all() + [r for _, r in self._parked]
-            self._parked = []
-            for req in stranded:
-                reg.counter("serve.fleet.retries_exhausted").inc()
-                delivered.append(self._finish_unplaced(
-                    req, "error", "no_replicas", now))
-
-        # 3) placement — parked retries first (oldest work), then front
-        if not self._draining:
-            still = []
-            for eligible_at, req in self._parked:
-                if eligible_at > now or not self._try_place(req, now):
-                    still.append((eligible_at, req))
-            self._parked = still
-            while self.queue.depth and self._placeable():
-                req = self.queue.pop()
-                self._try_place(req, now)
-
-        # 4) tick the replicas, deliver-or-retry what they finish
-        for rep in self.replicas:
-            if rep.state == RETIRED:
-                continue
-            for resp in rep.engine.tick():
-                req = self._tracked.get(resp.request_id)
-                if (resp.status == "error"
-                        and resp.finish_reason in RETRYABLE_REASONS
-                        and req is not None):
-                    rep.had_error_this_tick = True
-                    delivered.extend(self.reclaim([req], now))
-                    continue
-                delivered.append(self._deliver(resp))
-
-        # 5) fleet gauges
-        counts = self.counts()
-        for state, n in counts.items():
-            reg.gauge(f"serve.fleet.replicas_{state}").set(n)
-        reg.gauge("serve.fleet.front_depth").set(self.queue.depth)
-        reg.gauge("serve.fleet.parked").set(len(self._parked))
-        for rep in self.replicas:
-            reg.gauge(labelled("serve.fleet.replica.state",
-                               replica=rep.index)).set(
-                _STATE_CODE[rep.state])
-            reg.gauge(labelled("serve.fleet.replica.queue_depth",
-                               replica=rep.index)).set(
-                rep.engine.queue.depth)
-            reg.gauge(labelled("serve.fleet.replica.live_slots",
-                               replica=rep.index)).set(
-                rep.engine.live_slots)
-        self._tick_index = tick_idx + 1
-        return delivered
-
-    # -- convenience loops -------------------------------------------------
-
-    def run_until_idle(self, max_ticks: int = 1_000_000) -> List[Response]:
-        """Tick until every tracked request delivered. With every
-        replica dead this still terminates: retries exhaust their
-        budgets and the dead-fleet sweep fails anything stranded."""
-        delivered: List[Response] = []
-        for _ in range(max_ticks):
-            if self.idle:
-                return delivered
-            delivered.extend(self.tick())
-        raise RuntimeError(
-            f"fleet not idle after {max_ticks} ticks (front="
-            f"{self.queue.depth}, parked={len(self._parked)}, "
-            f"replicas={self.counts()})")

@@ -557,3 +557,84 @@ def test_kill_failover_token_parity_real_model(model_and_params):
         resp = router.response(rid)
         assert resp.status == "ok" and resp.finish_reason == "length"
         np.testing.assert_array_equal(np.asarray(resp.tokens), refs[i])
+
+
+# ---------------------------------------------------------------------------
+# appended with the fleet split (pipe_tpu/fleet): the exactly-once
+# ledger across a TRANSPORT drop — the wire dies mid-flight while the
+# replica behind it may be perfectly healthy
+
+
+class _CutWire:
+    """Wrap a replica's transport so the wire can be cut mid-flight:
+    once ``severed``, every remote call raises TransportError while
+    local state reads (queue depth, counters) stay ungated — exactly
+    the failure surface of a dead socket under a live child process.
+    Plain class on purpose: inheriting ReplicaTransport's default
+    methods would shadow the ``__getattr__`` delegation."""
+
+    _LOCAL = frozenset(["queue_depth", "queue_capacity", "live_slots",
+                        "default_max_new_tokens", "rpc_inflight",
+                        "rpc_retries", "close", "idle", "drained",
+                        "engine"])
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.severed = False
+
+    def __getattr__(self, name):
+        from pipe_tpu.fleet import TransportError
+        attr = getattr(object.__getattribute__(self, "inner"), name)
+        if name in _CutWire._LOCAL:
+            return attr
+        if self.severed:
+            raise TransportError("wire cut (test)")
+        if callable(attr):
+            def call(*a, **k):
+                if self.severed:
+                    raise TransportError("wire cut (test)")
+                return attr(*a, **k)
+            return call
+        return attr
+
+
+def test_transport_drop_mid_flight_delivers_every_id_exactly_once():
+    """Cut one replica's wire (NOT the replica) with work in flight:
+    the drop path reclaims the stranded in-flight set exactly once —
+    every id resolves to one terminal through a sibling, the dropped
+    replica walks to RETIRED, and the ledger still refuses a forged
+    duplicate afterwards."""
+    router, t = make_fleet(3, slots=2)
+    ids = [router.submit([1, 2], max_new_tokens=8).id for _ in range(9)]
+    t[0] += 0.01
+    router.tick()                     # work in flight on every replica
+    rep = router.replicas[0]
+    wire = _CutWire(rep.transport)
+    rep.transport = wire
+    wire.severed = True
+    out = run(router, t)
+    assert sorted(r.request_id for r in out) == sorted(ids)
+    assert all(r.status == "ok" for r in out)
+    assert rep.state == RETIRED
+    assert [r.state for r in router.replicas[1:]] == [HEALTHY, HEALTHY]
+    with pytest.raises(RuntimeError, match="exactly-once"):
+        router._deliver(out[0])
+
+
+def test_transport_drop_of_whole_fleet_fails_each_id_once():
+    """Every wire cut at once, nothing recoverable: stranded and queued
+    work fails loudly (``no_replicas``) — but still exactly once per
+    id, never silently dropped and never doubled."""
+    router, t = make_fleet(2, slots=2)
+    ids = [router.submit([3, 4], max_new_tokens=8).id for _ in range(6)]
+    t[0] += 0.01
+    router.tick()
+    for rep in router.replicas:
+        wire = _CutWire(rep.transport)
+        rep.transport = wire
+        wire.severed = True
+    out = run(router, t)
+    assert sorted(r.request_id for r in out) == sorted(ids)
+    assert all(r.status == "error" for r in out)
+    assert all(r.finish_reason == "no_replicas" for r in out)
+    assert all(rep.state == RETIRED for rep in router.replicas)

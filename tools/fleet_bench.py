@@ -1,24 +1,43 @@
-"""Fleet bench: goodput vs replica count + kill-one-of-N failover proof.
+"""Fleet bench: goodput, kill-one-of-N failover, async ticks, KV handoff.
 
-Two questions, answered with the tiny LM on whatever backend is
-available (the numbers of record are the committed ``FLEET_r10.json``):
+Four questions, answered with the tiny LM on whatever backend is
+available (the numbers of record are the committed ``FLEET_r15.json``):
 
 1. **Scaling** — saturated fleet goodput (ok tokens/s through the
-   Router's exactly-once ledger) at N = 1, 2, 3 replicas. On a real pod
-   each replica is its own device and the curve is ~linear; on the CPU
-   host the replicas share one processor, so the artifact records the
+   controller's exactly-once ledger) at N = 1, 2, 3 replicas, over the
+   transport picked by ``--fleet``: same-process engines ticked
+   serially (``inproc``), same-process engines each under a tick
+   thread (``thread``), or one OS process per replica (``proc``, the
+   :mod:`pipe_tpu.fleet.proc` socket transport). On a real pod each
+   replica is its own device and the curve is ~linear; on the CPU host
+   the replicas share one processor, so the artifact records the
    honest (flat-ish) curve plus per-N slot counts for context.
-2. **Kill one of N** — N = 3 replicas, a ``kill_replica`` chaos fault
-   fires mid-stream. The per-delivery timeline is split into
-   before/failover/after windows around the kill: goodput must drop by
-   <= ~1/N (plus the retried work's lost progress), NOT to zero, and
-   recover in the tail as the router re-places the dead replica's
-   backlog onto the survivors. The ledger check rides along: every
-   submitted request id yields exactly one terminal response.
+2. **Kill one of N** — N = 3 replicas, one dies mid-stream. In-process
+   fleets inject a ``kill_replica`` chaos fault; the ``proc`` fleet
+   kills the actual OS process (SIGKILL, no goodbye) and recovery runs
+   through heartbeat loss + TransportError reclaim. Either way the
+   delivery timeline is windowed before/failover/after: goodput must
+   drop, NOT to zero, and recover as the controller re-places the dead
+   replica's backlog onto the survivors — and every submitted id still
+   yields exactly one terminal response.
+3. **Async ticks vs serial** — N = 3 in-process replicas, one of them
+   a deliberate straggler (decode sleeps). Serial router ticks pay the
+   straggler's stall on EVERY fleet tick; per-replica tick threads
+   confine it to its own replica. The bench asserts threaded goodput
+   >= serial goodput — the claim ``async_tick`` exists to make.
+4. **KV handoff TTFT** — a session remapped off its home replica
+   either ships its cached prefix blocks to the new home
+   (:meth:`FleetController._kv_handoff`) or re-prefills from scratch
+   (export disabled). Measures TTFT of the first post-remap request
+   both ways; the win is the prefill work the shipped blocks saved.
+
+Every summary stamps host contention (1-min load average vs CPU count):
+on a contended host the absolute numbers are noise — the flag says so
+instead of letting the artifact lie.
 
 Usage:
-  python tools/fleet_bench.py                 # full run -> FLEET_r10.json
-  python tools/fleet_bench.py --quick         # small run, one JSON line
+  python tools/fleet_bench.py                 # full run -> FLEET_r15.json
+  python tools/fleet_bench.py --quick --fleet proc   # bench.py embed
 Progress goes to stderr; the last stdout line is always the summary
 object, so ``bench.py`` embeds the --quick summary.
 """
@@ -37,8 +56,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
+from pipe_tpu.fleet import (FleetController, ProcessReplicaTransport,  # noqa: E402
+                            ReplicaSpec)
 from pipe_tpu.inference import GenerationConfig  # noqa: E402
 from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM  # noqa: E402
+from pipe_tpu.obs.telemetry import get_registry  # noqa: E402
 from pipe_tpu.resilience import ChaosPlan, Fault, TickWatchdog  # noqa: E402
 from pipe_tpu.serve import (BucketSpec, RequestQueue, Router,  # noqa: E402
                             RouterPolicy, ServeEngine,
@@ -57,6 +79,19 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def host_contention():
+    """1-min load average vs CPU count: above ~75% the host is fighting
+    itself and wall-clock goodput numbers are noise."""
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:                               # pragma: no cover
+        return {"host_load1": None, "cpu_count": os.cpu_count() or 1,
+                "contended": False}
+    cpus = os.cpu_count() or 1
+    return {"host_load1": round(load1, 2), "cpu_count": cpus,
+            "contended": bool(load1 > 0.75 * cpus)}
+
+
 def make_workload(n, rng):
     """(prompt, max_new) pairs with varied generation lengths, so
     retirements/admissions stagger across ticks and deliveries form a
@@ -68,7 +103,26 @@ def make_workload(n, rng):
             for p, m in zip(lens, news)]
 
 
-def make_fleet(model, params, n_replicas, *, chaos=None, capacity=256):
+def proc_spec():
+    return ReplicaSpec(
+        lm_cfg=dict(vocab=CFG.vocab, d_model=CFG.d_model, nhead=CFG.nhead,
+                    d_ff=CFG.d_ff, n_layers=CFG.n_layers,
+                    seq_len=CFG.seq_len, dropout=0.0),
+        n_stages=1, init_seed=0, num_slots=SLOTS, max_len=MAX_LEN,
+        gen=dict(max_new_tokens=MAX_NEW, temperature=0.0),
+        buckets=list(BUCKETS.lengths), decode_chunk=CHUNK,
+        heartbeat_interval_s=0.05)
+
+
+def make_fleet(model, params, n_replicas, *, fleet="inproc", chaos=None,
+               capacity=256):
+    if fleet == "proc":
+        transports = [ProcessReplicaTransport(proc_spec())
+                      for _ in range(n_replicas)]
+        return FleetController(
+            transports, RequestQueue(capacity=capacity),
+            policy=RouterPolicy(backoff_base_s=0.0,
+                                heartbeat_timeout_s=5.0))
     gen_cfg = GenerationConfig(max_new_tokens=MAX_NEW, temperature=0.0)
     engines = []
     for _ in range(n_replicas):
@@ -79,25 +133,40 @@ def make_fleet(model, params, n_replicas, *, chaos=None, capacity=256):
             backend, RequestQueue(capacity=capacity),
             watchdog=TickWatchdog(stuck_slack_ticks=None)))
     return Router(engines, RequestQueue(capacity=capacity),
-                  policy=RouterPolicy(backoff_base_s=0.0), chaos=chaos)
+                  policy=RouterPolicy(backoff_base_s=0.0), chaos=chaos,
+                  async_tick=(fleet == "thread"))
 
 
 def warm(router, n_replicas):
-    """Compile both prefill buckets + decode on every replica before
-    the clock matters (least-loaded placement round-robins equal-load
-    replicas, so 2N warm requests touch all of them)."""
+    """Compile both prefill buckets + CHUNKED decode on every replica
+    before the clock matters (least-loaded placement round-robins
+    equal-load replicas, so 2N warm requests touch all of them;
+    max_new > decode_chunk so the chunked decode graph compiles here,
+    not inside a measured window)."""
     for _ in range(n_replicas):
-        router.submit([1] * 8, max_new_tokens=1)
-        router.submit([1] * 16, max_new_tokens=1)
-    router.run_until_idle()
+        router.submit([1] * 8, max_new_tokens=2 * CHUNK)
+        router.submit([1] * 16, max_new_tokens=2 * CHUNK)
+    run_to_idle(router)
 
 
-def timed_run(router, workload):
+def run_to_idle(router, pace_s=0.01, timeout_s=600.0):
+    deadline = time.monotonic() + timeout_s
+    while not router.idle:
+        router.tick()
+        if pace_s:
+            time.sleep(pace_s)
+        assert time.monotonic() < deadline, "fleet never went idle"
+
+
+def timed_run(router, workload, pace_s=0.0, on_tick=None):
     """Submit everything, tick to idle, stamp each delivery with the
-    router tick index it arrived on. Returns (records, elapsed_s,
-    total_ticks) where records are (tick, status, n_tokens). Also runs
-    the exactly-once ledger check: every submitted id, one terminal
-    response."""
+    router tick index AND wall offset it arrived at. Returns (records,
+    elapsed_s, total_ticks) where records are (tick, status, n_tokens,
+    t_s). Also runs the exactly-once ledger check: every submitted id,
+    one terminal response. ``pace_s`` throttles the sweep loop for
+    self-ticking (thread/proc) replicas; ``on_tick(tick, router)`` is
+    the chaos hook for trials that act mid-stream (e.g. kill a child
+    process)."""
     submitted = [router.submit(p, max_new_tokens=m, seed=i).id
                  for i, (p, m) in enumerate(workload)]
     t0 = time.monotonic()
@@ -106,8 +175,14 @@ def timed_run(router, workload):
     while not router.idle:
         tick = ticks
         ticks += 1
+        if on_tick is not None:
+            on_tick(tick, router, records)
         for r in router.tick():
-            records.append((tick, r.status, len(r.tokens)))
+            records.append((tick, r.status, len(r.tokens),
+                            time.monotonic() - t0))
+        if pace_s:
+            time.sleep(pace_s)
+        assert time.monotonic() - t0 < 600.0, "trial never went idle"
     elapsed = time.monotonic() - t0
     missing = [i for i in submitted if router.response(i) is None]
     assert not missing, f"requests with no terminal response: {missing}"
@@ -116,44 +191,75 @@ def timed_run(router, workload):
 
 def tokens_per_tick(records, lo, hi):
     """ok tokens delivered per tick over tick window [lo, hi)."""
-    toks = sum(n for t, status, n in records
+    toks = sum(n for t, status, n, _ in records
                if status == "ok" and lo <= t < hi)
     return toks / max(hi - lo, 1)
 
 
-def scaling_trial(model, params, n_replicas, n_requests, seed):
+def tokens_per_sec(records, lo_s, hi_s):
+    """ok tokens delivered per second over wall window [lo_s, hi_s)."""
+    toks = sum(n for _, status, n, t in records
+               if status == "ok" and lo_s <= t < hi_s)
+    return toks / max(hi_s - lo_s, 1e-9)
+
+
+def ok_tokens(records):
+    return sum(n for _, s, n, _ in records if s == "ok")
+
+
+def scaling_trial(model, params, n_replicas, n_requests, seed, fleet):
     rng = np.random.RandomState(seed)
-    router = make_fleet(model, params, n_replicas)
-    warm(router, n_replicas)
-    records, elapsed, ticks = timed_run(router,
-                                        make_workload(n_requests, rng))
-    ok = sum(1 for _, s, _ in records if s == "ok")
-    ok_tokens = sum(n for _, s, n in records if s == "ok")
+    router = make_fleet(model, params, n_replicas, fleet=fleet)
+    try:
+        warm(router, n_replicas)
+        records, elapsed, ticks = timed_run(
+            router, make_workload(n_requests, rng),
+            pace_s=0.01 if fleet != "inproc" else 0.0)
+    finally:
+        router.close()
+    ok = sum(1 for _, s, _, _ in records if s == "ok")
     return {
         "replicas": n_replicas,
+        "transport": fleet,
         "slots_total": n_replicas * SLOTS,
         "requests": n_requests,
         "ok": ok,
         "ticks": ticks,
         "elapsed_s": round(elapsed, 3),
-        "goodput_tokens_s": round(ok_tokens / max(elapsed, 1e-9), 1),
-        "goodput_tokens_per_tick": round(ok_tokens / max(ticks, 1), 2),
+        "goodput_tokens_s": round(ok_tokens(records) / max(elapsed, 1e-9),
+                                  1),
+        "goodput_tokens_per_tick": round(
+            ok_tokens(records) / max(ticks, 1), 2),
     }
 
 
 def kill_trial(model, params, n_replicas, n_requests, seed, kill_tick,
-               window):
+               window, fleet):
     """N replicas, kill one mid-stream; window the delivery timeline
-    (in router ticks — tick wall time is roughly constant, and tick
-    indexing keeps the windows deterministic) around the kill to show
-    degrade-and-recover."""
+    around the kill to show degrade-and-recover. In-process fleets
+    kill via the chaos plan at a router tick (tick wall time is
+    roughly constant, so tick windows are deterministic). The proc
+    fleet SIGKILLs the real child process and windows on SECONDS
+    under a trickle-fed steady-state load: submitting the whole
+    stream up front would make the first parent tick one giant
+    placement-RPC burst and cluster every delivery at the end, so the
+    feed keeps a bounded number of requests outstanding and the
+    delivery timeline stays continuous through the kill."""
     rng = np.random.RandomState(seed)
+    if fleet == "proc":
+        return _kill_trial_proc(n_replicas, rng)
     chaos = ChaosPlan([Fault("kill_replica", step=kill_tick,
                              stage=n_replicas - 1)])
-    router = make_fleet(model, params, n_replicas, chaos=chaos)
-    warm(router, n_replicas)
-    records, elapsed, ticks = timed_run(router,
-                                        make_workload(n_requests, rng))
+    router = make_fleet(model, params, n_replicas, fleet=fleet,
+                        chaos=chaos)
+    try:
+        warm(router, n_replicas)
+        records, elapsed, ticks = timed_run(
+            router, make_workload(n_requests, rng),
+            pace_s=0.01 if fleet != "inproc" else 0.0)
+        states = router.counts()
+    finally:
+        router.close()
     assert ticks > kill_tick + window, (
         f"run finished in {ticks} ticks; needs > "
         f"{kill_tick + window} — raise the load")
@@ -162,25 +268,269 @@ def kill_trial(model, params, n_replicas, n_requests, seed, kill_tick,
     during = tokens_per_tick(records, kill_tick, kill_tick + window)
     after = tokens_per_tick(records, kill_tick + window, ticks)
     by_status = {}
-    for _, s, _ in records:
+    for _, s, _, _ in records:
         by_status[s] = by_status.get(s, 0) + 1
     return {
         "replicas": n_replicas,
+        "transport": fleet,
         "killed_replica": n_replicas - 1,
-        "kill_tick": kill_tick,
-        "window_ticks": window,
+        "kill_mode": "chaos_fault",
+        "kill_at": kill_tick,
+        "window": window,
+        "rate_unit": "tokens/tick",
         "requests": n_requests,
         "ticks": ticks,
         "elapsed_s": round(elapsed, 3),
-        "tokens_per_tick_before": round(before, 2),
-        "tokens_per_tick_failover": round(during, 2),
-        "tokens_per_tick_after": round(after, 2),
+        "rate_before": round(before, 2),
+        "rate_failover": round(during, 2),
+        "rate_after": round(after, 2),
         "drop_frac": round(1.0 - during / max(before, 1e-9), 3),
         "recovered_frac": round(after / max(before, 1e-9), 3),
-        "survived_failover": during > 0.0,
+        "survived_failover": during > 0.0 or after > 0.0,
         "responses_by_status": by_status,
         "exactly_once": len(records) == n_requests,
-        "replica_states": router.counts(),
+        "replica_states": states,
+    }
+
+
+def _kill_trial_proc(n_replicas, rng, kill_after_s=2.0, duration_s=6.0,
+                     max_outstanding=9):
+    """SIGKILL one of N real child processes mid-stream. Steady-state
+    feed: keep ``max_outstanding`` requests in flight, kill the last
+    replica at ``kill_after_s``, keep feeding, then drain. Goodput in
+    1 s windows before/during/after the kill shows the degrade (one
+    replica's work vanishes and its in-flight set pays a retry) and
+    the recovery (survivors absorb the stream)."""
+    router = make_fleet(None, None, n_replicas, fleet="proc")
+    # oversized pool: the feed must NOT run dry inside the measured
+    # windows (a drained feed deflates the post-kill rate and reads as
+    # a failed recovery)
+    work = make_workload(4096, rng)
+    submitted, records = [], []
+    kill_t = None
+    try:
+        warm(router, n_replicas)
+        t0 = time.monotonic()
+        i = 0
+        while time.monotonic() - t0 < duration_s:
+            now = time.monotonic() - t0
+            while len(submitted) - len(records) < max_outstanding \
+                    and i < len(work):
+                p, m = work[i]
+                submitted.append(router.submit(
+                    p, max_new_tokens=m, seed=i).id)
+                i += 1
+            if kill_t is None and now >= kill_after_s:
+                router.replicas[n_replicas - 1].transport._proc.kill()
+                kill_t = now
+            for r in router.tick():
+                records.append((0, r.status, len(r.tokens),
+                                time.monotonic() - t0))
+            time.sleep(0.005)
+        deadline = time.monotonic() + 120.0
+        while not router.idle:
+            for r in router.tick():
+                records.append((0, r.status, len(r.tokens),
+                                time.monotonic() - t0))
+            time.sleep(0.005)
+            assert time.monotonic() < deadline, "drain never finished"
+        elapsed = time.monotonic() - t0
+        states = router.counts()
+        missing = [x for x in submitted if router.response(x) is None]
+        assert not missing, f"requests with no terminal: {missing}"
+    finally:
+        router.close()
+    assert kill_t is not None, "run too short to reach the kill point"
+    w = min(1.0, kill_t, (elapsed - kill_t) / 2)
+    before = tokens_per_sec(records, kill_t - w, kill_t)
+    during = tokens_per_sec(records, kill_t, kill_t + w)
+    after = tokens_per_sec(records, kill_t + w, elapsed)
+    by_status = {}
+    for _, s, _, _ in records:
+        by_status[s] = by_status.get(s, 0) + 1
+    return {
+        "replicas": n_replicas,
+        "transport": "proc",
+        "killed_replica": n_replicas - 1,
+        "kill_mode": "sigkill_process",
+        "kill_at": round(kill_t, 3),
+        "window": round(w, 3),
+        "rate_unit": "tokens/s",
+        "requests": len(submitted),
+        "ticks": 0,
+        "elapsed_s": round(elapsed, 3),
+        "rate_before": round(before, 2),
+        "rate_failover": round(during, 2),
+        "rate_after": round(after, 2),
+        "drop_frac": round(1.0 - during / max(before, 1e-9), 3),
+        "recovered_frac": round(after / max(before, 1e-9), 3),
+        "survived_failover": during > 0.0 or after > 0.0,
+        "responses_by_status": by_status,
+        "exactly_once": len(records) == len(submitted),
+        "replica_states": states,
+    }
+
+
+def straggler_trial(model, params, n_requests, seed, sleep_s=0.05,
+                    duration_s=4.0):
+    """N=3, replica 2 a straggler (decode sleeps ``sleep_s``): serial
+    router ticks pay the sleep inline on EVERY fleet tick — nothing
+    else decodes while the straggler naps; per-replica tick threads
+    confine it to its own replica. Measured as steady-state goodput
+    over a fixed wall-clock window with the front queue kept fed (a
+    fixed-size workload would let the straggler's own tail dominate
+    both arms and hide the siblings' win). Asserts threaded goodput
+    >= serial goodput — the claim ``async_tick`` exists to make."""
+    out = {}
+    for mode in ("serial", "thread"):
+        rng = np.random.RandomState(seed)
+        router = make_fleet(model, params, 3,
+                            fleet="thread" if mode == "thread"
+                            else "inproc")
+        try:
+            warm(router, 3)
+            backend = router.replicas[2].engine.backend
+            orig = backend.decode
+
+            def slow_decode(live, _orig=orig):
+                time.sleep(sleep_s)
+                return _orig(live)
+
+            backend.decode = slow_decode
+            pace = 0.01 if mode == "thread" else 0.0
+            feed = iter(range(10_000))
+            t0 = time.monotonic()
+            deadline = t0 + duration_s
+            tokens = finished = 0
+            while time.monotonic() < deadline:
+                while self_depth(router) < 6:     # keep the fleet fed
+                    i = next(feed)
+                    p, m = make_workload(1, rng)[0]
+                    router.submit(p, max_new_tokens=m, seed=i)
+                for r in router.tick():
+                    if r.status == "ok":
+                        tokens += len(r.tokens)
+                        finished += 1
+                if pace:
+                    time.sleep(pace)
+            elapsed = time.monotonic() - t0
+            run_to_idle(router)                   # flush the remainder
+        finally:
+            router.close()
+        out[mode] = {
+            "window_s": round(elapsed, 3),
+            "ok": finished,
+            "ok_tokens": tokens,
+            "goodput_tokens_s": round(tokens / max(elapsed, 1e-9), 1),
+        }
+    serial = out["serial"]["goodput_tokens_s"]
+    threaded = out["thread"]["goodput_tokens_s"]
+    out["straggler_sleep_s"] = sleep_s
+    out["speedup"] = round(threaded / max(serial, 1e-9), 2)
+    out["async_beats_serial"] = bool(threaded >= serial)
+    assert threaded >= serial, (
+        f"async ticks lost to serial under a straggler: "
+        f"{threaded} < {serial} tokens/s")
+    return out
+
+
+def self_depth(router):
+    """Outstanding work visible to the feeder: front depth plus every
+    replica's queued+live share."""
+    return router.queue.depth + sum(
+        rep.transport.queue_depth + rep.transport.live_slots
+        for rep in router.replicas if rep.state != "retired")
+
+
+def handoff_trial(repeats=3):
+    """Session remap TTFT, handoff vs re-prefill. Two paged replicas;
+    a session decodes on its home (caching its prefix blocks), the
+    home is marked suspect, and the next session request remaps. With
+    KV handoff the destination imports the cached blocks and prefill
+    skips them; with export disabled it re-prefills the whole prompt.
+
+    Uses its own model config (wider + longer context than the fleet
+    CFG): the win IS the prefill work saved, so the prompt has to be
+    long enough that prefill costs more than shipping its blocks —
+    48 tokens of a 16-wide model re-prefill in ~7ms, which any
+    handoff overhead eats. Repeats each arm with a fresh fleet and
+    takes the min TTFT (min is robust against scheduler noise on a
+    shared host)."""
+    hcfg = LMConfig(vocab=67, d_model=32, nhead=2, d_ff=64,
+                    n_layers=4, seq_len=160, dropout=0.0)
+    model = PipelinedLM(hcfg, 1)
+    params = model.init(jax.random.key(5))
+    gen_cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    rng = np.random.RandomState(3)
+    prompt = list(rng.randint(1, hcfg.vocab, size=144))  # 18 blocks
+
+    def fleet():
+        def engine():
+            be = SingleDeviceSlotBackend(
+                model, params, num_slots=SLOTS, max_len=160,
+                gen=gen_cfg, kv_block_size=8, kv_pool_blocks=60,
+                prefill_chunk=8)
+            eng = ServeEngine(be, RequestQueue())
+            # compile EVERY prefill path this replica will run before
+            # anything is timed — including the resume-from-cached-
+            # prefix program (a different trace than full prefill: the
+            # remapped request must measure prefill work saved, not a
+            # cold jit cache on the destination). A throwaway prompt,
+            # served twice: full prefill, then the cached-prefix resume.
+            warm_p = list(rng.randint(1, hcfg.vocab, size=144))
+            for _ in range(2):
+                eng.submit(warm_p, max_new_tokens=4, seed=9)
+                eng.run_until_idle()
+            return eng
+        return Router([engine(), engine()], RequestQueue(),
+                      policy=RouterPolicy(placement="session"))
+
+    def serve_one(router):
+        rid = router.submit(prompt, max_new_tokens=4, seed=0,
+                            session="alice").id
+        for _ in range(10000):
+            router.tick()
+            resp = router.response(rid)
+            if resp is not None:
+                assert resp.status == "ok", resp
+                return resp
+        raise AssertionError("request never finished")
+
+    reg = get_registry()
+    ttfts = {"handoff": [], "reprefill": []}
+    shipped0 = reg.counter("serve.fleet.kv_handoff_shipped").value
+    bytes0 = reg.counter("serve.fleet.kv_handoff_bytes").value
+    for arm in ("handoff", "reprefill"):
+        for _ in range(repeats):
+            router = fleet()
+            serve_one(router)                      # warm the home + jit
+            serve_one(router)                      # steady-state TTFT
+            if arm == "reprefill":
+                for rep in router.replicas:        # sever the handoff
+                    rep.transport.export_prefix = lambda prompt: None
+            home = router._session_map["alice"]
+            router.replicas[home].state = "suspect"
+            resp = serve_one(router)               # remapped request
+            ttfts[arm].append(resp.ttft)
+            router.close()
+    shipped = reg.counter("serve.fleet.kv_handoff_shipped").value \
+        - shipped0
+    nbytes = reg.counter("serve.fleet.kv_handoff_bytes").value - bytes0
+    t_hand = min(ttfts["handoff"])
+    t_cold = min(ttfts["reprefill"])
+    return {
+        "prompt_len": len(prompt),
+        "kv_block_size": 8,
+        "repeats": repeats,
+        "blocks_shipped": int(shipped),
+        "handoff_bytes": int(nbytes),
+        "ttft_handoff_s": round(t_hand, 4),
+        "ttft_reprefill_s": round(t_cold, 4),
+        "ttft_win_s": round(t_cold - t_hand, 4),
+        "ttft_all_handoff_s": [round(t, 4) for t in ttfts["handoff"]],
+        "ttft_all_reprefill_s": [round(t, 4)
+                                 for t in ttfts["reprefill"]],
+        "handoff_moved_blocks": bool(shipped > 0),
     }
 
 
@@ -188,6 +538,11 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="small run; single-line JSON summary")
+    ap.add_argument("--fleet", choices=["inproc", "thread", "proc"],
+                    default="inproc",
+                    help="replica transport for the scaling + kill "
+                         "trials (straggler/handoff trials are always "
+                         "in-process)")
     ap.add_argument("--out", default=None,
                     help="also write the summary JSON here")
     ap.add_argument("--seed", type=int, default=0)
@@ -202,28 +557,44 @@ def main():
 
     scaling = []
     for n in replica_counts:
-        log(f"== scaling: {n} replica(s), {n_requests} requests")
-        r = scaling_trial(model, params, n, n_requests, args.seed)
+        log(f"== scaling[{args.fleet}]: {n} replica(s), "
+            f"{n_requests} requests")
+        r = scaling_trial(model, params, n, n_requests, args.seed,
+                          args.fleet)
         scaling.append(r)
         log(f"   {r}")
 
-    log("== kill one of 3 mid-stream")
+    log(f"== kill one of 3 mid-stream [{args.fleet}]")
     kill = kill_trial(model, params, 3, n_requests * 2, args.seed + 1,
-                      kill_tick=6, window=4)
+                      kill_tick=6, window=4, fleet=args.fleet)
     log(f"   {kill}")
 
+    log("== straggler: async ticks vs serial (N=3, in-process)")
+    straggler = straggler_trial(model, params, n_requests, args.seed + 2)
+    log(f"   {straggler}")
+
+    log("== session-remap KV handoff TTFT (2 paged replicas)")
+    handoff = handoff_trial(repeats=2 if args.quick else 3)
+    log(f"   {handoff}")
+
     ok = bool(kill["exactly_once"] and kill["survived_failover"]
-              and kill["recovered_frac"] > 0.3)
+              and kill["recovered_frac"] > 0.3
+              and straggler["async_beats_serial"]
+              and handoff["handoff_moved_blocks"])
     summary = {
-        "bench": "fleet", "rev": "r10",
+        "bench": "fleet", "rev": "r15",
         "quick": bool(args.quick),
+        "fleet": args.fleet,
         "platform": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "slots_per_replica": SLOTS,
         "decode_chunk": CHUNK,
         "max_new_tokens": MAX_NEW,
+        "contention": host_contention(),
         "scaling": scaling,
         "kill_one_of_n": kill,
+        "async_vs_serial": straggler,
+        "kv_handoff": handoff,
         "fleet_ok": ok,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
@@ -233,6 +604,7 @@ def main():
         log(f"wrote {args.out}")
     if args.quick:
         print(json.dumps({
+            "transport": args.fleet,
             "goodput_1_replica_tokens_s":
                 scaling[0]["goodput_tokens_s"],
             "goodput_3_replicas_tokens_s":
@@ -240,6 +612,11 @@ def main():
             "kill_drop_frac": kill["drop_frac"],
             "kill_recovered_frac": kill["recovered_frac"],
             "exactly_once": kill["exactly_once"],
+            "async_speedup": straggler["speedup"],
+            "async_beats_serial": straggler["async_beats_serial"],
+            "ttft_win_s": handoff["ttft_win_s"],
+            "handoff_moved_blocks": handoff["handoff_moved_blocks"],
+            "contended": summary["contention"]["contended"],
             "fleet_ok": ok,
         }))
     else:

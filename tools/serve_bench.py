@@ -77,6 +77,19 @@ AB_BUCKETS = BucketSpec.of(128)
 AB_MAX_LEN = SHARED_LEN + max(AB_TAILS) + AB_MAX_NEW    # 152
 
 
+def host_contention():
+    """1-min load average vs CPU count: above ~75% the host is fighting
+    itself and wall-clock goodput numbers are noise."""
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:                               # pragma: no cover
+        return {"host_load1": None, "cpu_count": os.cpu_count() or 1,
+                "contended": False}
+    cpus = os.cpu_count() or 1
+    return {"host_load1": round(load1, 2), "cpu_count": cpus,
+            "contended": bool(load1 > 0.75 * cpus)}
+
+
 def _backend_kv_kwargs(kv, pool_blocks=None):
     if kv == "slab":
         return {}
@@ -505,10 +518,12 @@ def main():
                         max_new=max_new, deadline_s=30.0,
                         capacity=4 * slots, kv=args.kv)
 
+    host = host_contention()
     summary = {
         "bench": "serve_bench",
         "platform": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
+        **host,
         "slots": slots,
         "decode_chunk": chunk,
         "kv": args.kv,
@@ -537,6 +552,7 @@ def main():
                 res_ab["resident_vs_nonresident_tokens_s"],
             "host_overhead_reduction":
                 res_ab["host_overhead_reduction"],
+            "contended": host["contended"],
         }))
         return
 
