@@ -524,8 +524,10 @@ def main():
     # Fleet probe: replica-count goodput scaling plus the
     # kill-one-of-3 failover proof over REAL child processes (SIGKILL
     # a replica process mid-stream: recovery + exactly-once ledger),
-    # the async-tick straggler win, and the session-remap KV handoff
-    # TTFT — fleet_ok must stay true every round (quick mode of
+    # the async-tick straggler win, the session-remap KV handoff
+    # TTFT, and the observability plane over the SIGKILL drill
+    # (delivered-token reconciliation + trace stitching) — fleet_ok
+    # must stay true every round (quick mode of
     # tools/fleet_bench.py --fleet proc; FLEET_r{N}.json is the full
     # record).
     fleet_summary = None
@@ -536,7 +538,7 @@ def main():
             [sys.executable, os.path.join(here, "tools",
                                           "fleet_bench.py"), "--quick",
              "--fleet", "proc",
-             "--out", os.path.join(here, "FLEET_r15.json")],
+             "--out", os.path.join(here, "FLEET_r16.json")],
             capture_output=True, text=True, timeout=900, env=env)
         if out.returncode == 0:
             fleet_summary = json.loads(out.stdout.strip().splitlines()[-1])
@@ -553,6 +555,19 @@ def main():
         assert fleet_summary["async_beats_serial"], (
             "async-tick fleet goodput fell below the serial tick loop "
             f"at N=3: {fleet_summary['async_speedup']}x")
+        # The stitched traces must reconstruct EVERY submitted id from
+        # the SIGKILL drill exactly once — parent-side skeleton events
+        # guarantee a timeline even when a child's events die with it,
+        # and trace ids minted once at submit keep a failed-over id in
+        # ONE trace (two placements, not two traces).
+        assert fleet_summary["trace_stitch_frac"] == 1.0, (
+            "trace stitching lost request ids in the proc kill drill: "
+            f"frac={fleet_summary['trace_stitch_frac']}")
+        assert fleet_summary["trace_stitch_exactly_once"], (
+            "a request id appeared in more than one stitched trace")
+        assert fleet_summary["tokens_reconciled"], (
+            "per-replica delivered-token counters no longer sum to "
+            "the parent ledger's delivered total")
 
     # Elastic probe: kill 1 of 4 stages mid-run -> heartbeat detection,
     # re-plan to 3, buddy restore, and the bitwise pin against the

@@ -16,7 +16,14 @@ rejected counters, TTFT percentiles, queue-depth/occupancy gauges).
 ``--replicas N`` (with ``--stages 1``) serves through the fleet
 instead: N replicas behind one front queue with health-gated failover;
 the summary gains per-replica lines and a fleet rollup, and SIGTERM
-drains the whole fleet. ``--fleet`` picks the replica transport:
+drains the whole fleet. The fleet observability plane
+(docs/observability.md, "Fleet observability") rides along:
+``--metrics-port`` serves the merged fleet registry as Prometheus text
+(``/metrics``; plus ``/slo`` and ``/fleet`` JSON — what
+``tools/fleet_top.py`` polls), ``--slo-*`` declare targets scored into
+a machine-readable ``summary["slo"]`` verdict, and ``--trace-out``
+writes the stitched per-request trace timelines (parent + shipped
+child events) as JSONL. ``--fleet`` picks the replica transport:
 
 * ``inproc`` (default) — engine replicas in this process, ticked
   serially by the router (the PR 7 behavior, byte-for-byte);
@@ -35,6 +42,10 @@ Usage:
         [--fleet inproc|thread|proc]
         [--eos ID] [--queue-capacity C] [--policy fifo|priority]
         [--timeout-s T] [--decode-chunk K] [--events F.jsonl] [--tiny]
+        [--metrics-port P] [--trace-out F.jsonl]
+        [--slo-ttft-p50 S] [--slo-ttft-p99 S] [--slo-e2e-p99 S]
+        [--slo-goodput-min F] [--slo-deadline-miss-max F]
+        [--slo-shed-max F]
         [--resident auto|on|off] [--resident-chunks R] [--spec-tokens K]
         [--cpu N]
 """
@@ -48,6 +59,55 @@ import sys
 import time
 
 from .generate import DriverError, load_params
+
+
+def _start_metrics_server(port, registry_fn, slo, observer):
+    """Daemon-thread HTTP server on 127.0.0.1 exposing the fleet
+    observability plane: ``/metrics`` renders ``registry_fn()`` as
+    Prometheus text, ``/slo`` the verdict JSON, ``/fleet`` the
+    per-replica JSON view (``tools/fleet_top.py`` polls these).
+    Returns the server (``.server_address[1]`` is the bound port)."""
+    import http.server
+    import threading
+
+    from ..obs.fleet_obs import prometheus_text
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
+            try:
+                if path == "/metrics":
+                    body = prometheus_text(registry_fn()).encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif path == "/slo":
+                    body = json.dumps(slo.verdict(registry_fn())).encode()
+                    ctype = "application/json"
+                elif path == "/fleet":
+                    per = (observer.per_replica()
+                           if observer is not None else {})
+                    body = json.dumps(
+                        {str(k): v for k, v in per.items()}).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+            except Exception as e:               # surface, don't crash
+                self.send_error(500, str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):               # keep stdout JSON-clean
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="metrics-http").start()
+    return srv
 
 
 def build_argparser() -> argparse.ArgumentParser:
@@ -105,6 +165,27 @@ def build_argparser() -> argparse.ArgumentParser:
                         "single-device backend only)")
     p.add_argument("--events", default=None,
                    help="write the request-span EventLog here (.jsonl)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve the merged fleet registry on "
+                        "127.0.0.1:<port>: /metrics (Prometheus text), "
+                        "/slo (verdict JSON), /fleet (per-replica JSON "
+                        "view). 0 picks an ephemeral port (printed to "
+                        "stderr)")
+    p.add_argument("--trace-out", default=None,
+                   help="with --replicas > 1: write the stitched "
+                        "per-request trace timelines here (.jsonl)")
+    p.add_argument("--slo-ttft-p50", type=float, default=None,
+                   help="SLO target: TTFT p50 seconds")
+    p.add_argument("--slo-ttft-p99", type=float, default=None,
+                   help="SLO target: TTFT p99 seconds")
+    p.add_argument("--slo-e2e-p99", type=float, default=None,
+                   help="SLO target: end-to-end latency p99 seconds")
+    p.add_argument("--slo-goodput-min", type=float, default=None,
+                   help="SLO target: minimum ok/delivered fraction")
+    p.add_argument("--slo-deadline-miss-max", type=float, default=None,
+                   help="SLO target: max timed_out/delivered fraction")
+    p.add_argument("--slo-shed-max", type=float, default=None,
+                   help="SLO target: max shed/delivered fraction")
     p.add_argument("--tick-budget-s", type=float, default=None,
                    help="watchdog: count ticks slower than this "
                         "(resilience.watchdog_slow_ticks)")
@@ -236,7 +317,18 @@ def main(argv=None) -> int:
             resident=resident, resident_chunks=args.resident_chunks,
             spec_tokens=args.spec_tokens, **kv_kwargs)
 
-    events = EventLog(args.events) if args.events else NULL_EVENT_LOG
+    trace_buf = None
+    if args.events:
+        events = EventLog(args.events)
+    elif args.trace_out and replicas > 1:
+        # --trace-out without --events: hold the parent-side request
+        # skeleton (queued/placed/delivered) in memory, or the stitched
+        # timelines would carry child-side stages only
+        from ..obs.fleet_obs import TraceBuffer
+        trace_buf = TraceBuffer(maxlen=200_000)
+        events = trace_buf
+    else:
+        events = NULL_EVENT_LOG
 
     def _make_watchdog():
         if args.tick_budget_s is None and args.shed_ewma is None:
@@ -306,6 +398,30 @@ def main(argv=None) -> int:
         eng = ServeEngine(backend, queue, event_log=events,
                           watchdog=_make_watchdog())
 
+    # Fleet observability plane: the observer merges shipped/shared
+    # replica metrics into one rollup registry; the SLO monitor scores
+    # it; --metrics-port exposes both live (what fleet_top polls).
+    from ..obs.fleet_obs import FleetObserver, SloMonitor, SloTargets
+    slo = SloMonitor(SloTargets(
+        ttft_p50_s=args.slo_ttft_p50, ttft_p99_s=args.slo_ttft_p99,
+        e2e_p99_s=args.slo_e2e_p99, goodput_min=args.slo_goodput_min,
+        deadline_miss_max=args.slo_deadline_miss_max,
+        shed_max=args.slo_shed_max))
+    observer = FleetObserver(eng, parent_events=(args.events or trace_buf)) \
+        if replicas > 1 else None
+
+    def _fleet_registry():
+        return observer.rollup() if observer is not None \
+            else get_registry()
+
+    metrics_server = None
+    if args.metrics_port is not None:
+        metrics_server = _start_metrics_server(
+            args.metrics_port, _fleet_registry, slo, observer)
+        print(f"metrics: http://127.0.0.1:"
+              f"{metrics_server.server_address[1]}/metrics",
+              file=sys.stderr, flush=True)
+
     # Graceful drain on SIGTERM/SIGINT: live slots finish, queued work is
     # shed back to callers, new admissions stop — then a clean summary.
     # With --replicas this drains the WHOLE fleet (every engine).
@@ -373,6 +489,7 @@ def main(argv=None) -> int:
         "host_overhead_per_token_us": round(
             1e6 * host_overhead_per_token(), 2),
         "buckets": list(buckets.lengths), "metrics": snap}
+    summary["slo"] = slo.verdict(_fleet_registry())
     if replicas > 1:
         def _rep_line(rep):
             line = {"replica": rep.index, "state": rep.state}
@@ -389,8 +506,24 @@ def main(argv=None) -> int:
             "rollup": eng.counts(),
             "per_replica": [_rep_line(rep) for rep in eng.replicas]}
         eng.close()   # stops tick threads / shuts replica processes down
+        # after close: the proc children ship their FINAL obs deltas on
+        # the shutdown RPC, and every obs_view/ledger read below is
+        # parent-side state that survives the replicas
+        if observer is not None:
+            per = observer.per_replica()
+            summary["fleet"]["staleness_s"] = {
+                str(i): v["staleness_s"] for i, v in per.items()}
+            summary["fleet"]["reconcile"] = observer.reconcile()
+            summary["slo"] = slo.verdict(_fleet_registry())
+            if args.trace_out:
+                # flush parent events so stitch() reads a complete log
+                events.flush()
+                summary["fleet"]["trace_records"] = \
+                    observer.write_stitched(args.trace_out)
     print(json.dumps({"summary": summary}))
     events.close()
+    if metrics_server is not None:
+        metrics_server.shutdown()
     return 0
 
 

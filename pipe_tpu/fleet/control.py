@@ -198,10 +198,28 @@ class ReplicaTransport:
     ``rpc_inflight``/``rpc_retries`` are wire-level telemetry
     (0 in-process); they surface through the per-replica labelled
     gauges the controller exports every tick.
+
+    ``obs_tokens_out``/``obs_responses_out`` are the
+    delivery-synchronized per-replica counters the fleet observer sums:
+    every transport bumps them at the exact moment a terminal response
+    crosses into the control plane (``poll`` in-process, response-frame
+    accept on the wire), so the per-replica sums reconcile with the
+    parent-observed delivered totals even when a replica is SIGKILLed
+    between two telemetry ships. ``obs_view()`` returns the shipped
+    telemetry view ``(registry, age_s, seq, events)`` for transports
+    that receive obs frames, None for transports the observer reads
+    directly (in-process).
     """
 
     rpc_inflight: int = 0
     rpc_retries: int = 0
+    obs_tokens_out: int = 0
+    obs_responses_out: int = 0
+
+    def obs_view(self):
+        """Shipped-telemetry view ``(registry, age_s, seq, events)`` or
+        None when this transport's replica is readable in-process."""
+        return None
 
     # -- work ------------------------------------------------------------
     def place(self, req: Request) -> None:
@@ -224,6 +242,19 @@ class ReplicaTransport:
 
     def cancel(self, request_id: int) -> bool:
         raise NotImplementedError
+
+    def salvage(self) -> List[Response]:
+        """Terminal responses already accepted on this side of the wire
+        but never drained by a ``poll`` — returned WITHOUT a liveness
+        check, so the drop path can read them after the wire is dead.
+        Transports that count ``obs_tokens_out`` at frame-accept time
+        (the process transport) MUST implement this: those tokens
+        already crossed into the control plane, so re-running their
+        requests on another replica would both waste a second decode
+        and break the delivered-token reconciliation. Transports that
+        count at drain time may return ``[]`` (the default) — their
+        buffered work is uncounted and safe to retry."""
+        return []
 
     # -- lifecycle -------------------------------------------------------
     def drain(self) -> None:
@@ -345,8 +376,12 @@ class InProcessTransport(ReplicaTransport):
             out = []
             while self._buffer:
                 out.append(self._buffer.popleft())
-            return out
-        return self.engine.tick()
+        else:
+            out = self.engine.tick()
+        for resp in out:
+            self.obs_tokens_out += len(resp.tokens)
+            self.obs_responses_out += 1
+        return out
 
     def evict_queued(self) -> List[Request]:
         with self._lock:
@@ -531,6 +566,7 @@ class FleetController:
         self._session_of: Dict[int, str] = {}
         self._session_map: Dict[str, int] = {}
         self._placed_on: Dict[int, int] = {}
+        self._pending_out: List[Response] = []
         self._tick_index = 0
         self._depth_streak = 0
         self._draining = False
@@ -578,6 +614,9 @@ class FleetController:
             self._session_of[req.id] = str(session)
         reg.counter("serve.fleet.submitted").inc()
         reg.gauge("serve.fleet.front_depth").set(self.queue.depth)
+        self.events.event(REQUEST, request=req.id, trace=req.trace_id,
+                          stage="queued", prompt_len=len(req.prompt),
+                          priority=req.priority, session=session)
         return req
 
     def cancel(self, request_id: int) -> bool:
@@ -661,14 +700,21 @@ class FleetController:
         self._responses[resp.request_id] = resp
         req = self._tracked.pop(resp.request_id, None)
         self._session_of.pop(resp.request_id, None)
-        self._placed_on.pop(resp.request_id, None)
+        placed_on = self._placed_on.pop(resp.request_id, None)
         self.queue.forget(resp.request_id)
         reg = get_registry()
         reg.counter("serve.fleet.delivered").inc()
+        reg.counter("serve.fleet.delivered_tokens").inc(len(resp.tokens))
         if resp.status == "ok":
             reg.counter("serve.fleet.ok").inc()
         if req is not None and req.attempts > 1:
             reg.counter("serve.fleet.failed_over").inc()
+        self.events.event(REQUEST, request=resp.request_id,
+                          trace=getattr(req, "trace_id", None),
+                          stage="delivered", status=resp.status,
+                          finish_reason=resp.finish_reason,
+                          tokens=len(resp.tokens), replica=placed_on,
+                          attempts=getattr(req, "attempts", 0))
         return resp
 
     def _finish_unplaced(self, req: Request, status: str, reason: str,
@@ -682,7 +728,8 @@ class FleetController:
                         ttft=None, latency=now - req.submitted_at)
         self.events.event(REQUEST, request=req.id, status=status,
                           finish_reason=reason, replica=None,
-                          attempts=req.attempts)
+                          attempts=req.attempts, trace=req.trace_id,
+                          stage="terminal")
         return self._deliver(resp)
 
     # -- retry parking -----------------------------------------------------
@@ -736,7 +783,8 @@ class FleetController:
         get_registry().counter("serve.fleet.retried").inc()
         self.events.event("resilience", action="retry_parked",
                           request=req.id, attempts=req.attempts,
-                          delay_s=delay)
+                          delay_s=delay, trace=req.trace_id,
+                          stage="retry_parked")
 
     # -- placement ---------------------------------------------------------
 
@@ -809,7 +857,9 @@ class FleetController:
                           request=req.id, session=sess,
                           from_replica=old_idx, to_replica=new_rep.index,
                           invalidated=invalidated, warm_blocks=warm,
-                          shipped_blocks=shipped, bytes=nbytes)
+                          shipped_blocks=shipped, bytes=nbytes,
+                          trace=req.trace_id, stage="handoff",
+                          attempts=req.attempts)
 
     def _try_place(self, req: Request, now: float) -> bool:
         candidates = self._placeable()
@@ -829,6 +879,9 @@ class FleetController:
         self._placed_on[req.id] = rep.index
         if sess is not None and rep.state == HEALTHY:
             self._session_map[sess] = rep.index
+        self.events.event(REQUEST, request=req.id, trace=req.trace_id,
+                          stage="placed", replica=rep.index,
+                          attempts=req.attempts)
         return True
 
     # -- health state machine ----------------------------------------------
@@ -852,11 +905,27 @@ class FleetController:
             return
         reg = get_registry()
         reg.counter("serve.fleet.transport_drops").inc()
+        # Responses the wire delivered before it died but no poll ever
+        # drained: deliver them. The work is done and (on the process
+        # transport) their tokens are already in ``obs_tokens_out``, so
+        # reclaiming those requests would run a second decode elsewhere
+        # and leave counted-but-undelivered tokens breaking the
+        # observer's reconciliation. Delivered BEFORE computing the
+        # in-flight set so they drop out of ``_placed_on`` first.
+        try:
+            salvaged = rep.transport.salvage()
+        except Exception:
+            salvaged = []
+        for resp in salvaged:
+            self._pending_out.append(self._deliver(resp))
+        if salvaged:
+            reg.counter("serve.fleet.salvaged").inc(len(salvaged))
         inflight = self._inflight_on(rep)
         for req in inflight:
             self._placed_on.pop(req.id, None)
         self.events.event("resilience", action="transport_drop",
-                          replica=rep.index, inflight=len(inflight))
+                          replica=rep.index, inflight=len(inflight),
+                          salvaged=len(salvaged))
         rep.state = RETIRED
         reg.counter("serve.fleet.retired").inc()
         try:
@@ -1125,6 +1194,11 @@ class FleetController:
                     h.heartbeat_age_s)
             except TransportError:
                 self._transport_drop(rep, now)
+        # responses salvaged off a dropped transport this tick (already
+        # in the ledger) — surface them through the normal return path
+        if self._pending_out:
+            delivered.extend(self._pending_out)
+            self._pending_out = []
         self._tick_index = tick_idx + 1
         return delivered
 

@@ -63,6 +63,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.telemetry import MetricsRegistry, get_registry
 from ..serve.queue import Request, Response
 from .control import ReplicaHealth, ReplicaTransport, TransportError
 
@@ -111,6 +112,15 @@ class ReplicaSpec:
     heartbeat_interval_s: float = 0.1
     jax_platform: str = "cpu"
     local_devices: int = 1
+    # fleet observability: when True the child snapshots its registry
+    # (mergeable deltas) and drains its trace-event buffer onto ``obs``
+    # frames piggybacked on the heartbeat cadence; when False the child
+    # runs a null registry + null event log and ships NOTHING — the
+    # zero-overhead pledge, asserted by the frame census test.
+    # ``obs_max_bytes`` bounds one obs frame; oversized telemetry is
+    # dropped (never blocks or backs up the data plane).
+    telemetry: bool = True
+    obs_max_bytes: int = 65536
 
 
 # ---------------------------------------------------------------------------
@@ -319,6 +329,18 @@ class ProcessReplicaTransport(ReplicaTransport):
         self._dead: Optional[str] = None
         self._draining = False
         self._closed = False
+        # shipped-telemetry state (the parent half of the obs plane):
+        # merged registry of everything this child ever shipped, age of
+        # the newest obs frame, child-reported drop count, and the
+        # bounded child trace-event stream the observer stitches
+        self.obs_tokens_out = 0
+        self.obs_responses_out = 0
+        self._obs_registry = MetricsRegistry()
+        self._obs_at: Optional[float] = None
+        self._obs_seq = -1
+        self._obs_dropped = 0
+        self._obs_events: "deque[dict]" = deque(maxlen=50_000)
+        self._frame_census: Dict[str, int] = {}
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -447,6 +469,7 @@ class ProcessReplicaTransport(ReplicaTransport):
 
     def _dispatch(self, msg: dict) -> None:
         op = msg.get("op")
+        self._frame_census[op] = self._frame_census.get(op, 0) + 1
         if op == "reply":
             with self._state_lock:
                 ent = self._pending.get(msg.get("rpc"))
@@ -465,12 +488,35 @@ class ProcessReplicaTransport(ReplicaTransport):
                         finish_reason=msg["finish_reason"],
                         prompt_len=msg["prompt_len"],
                         ttft=msg.get("ttft"), latency=msg.get("latency")))
+                    # delivery-synchronized per-replica accounting: the
+                    # tokens rode THIS frame, so the count can never
+                    # outrun (or trail) what the parent actually took —
+                    # the reconciliation invariant the observer sums
+                    self.obs_tokens_out += len(msg["tokens"])
+                    self.obs_responses_out += 1
             # unknown id: the controller reclaimed it over a drop — the
             # stale record is discarded HERE so delivery stays exactly-once
         elif op == "hb":
             with self._state_lock:
                 self._hb = msg
                 self._hb_at = time.monotonic()
+        elif op == "obs":
+            events = msg.get("events") or []
+            with self._state_lock:
+                self._obs_registry.merge_snapshot(msg.get("metrics") or {})
+                self._obs_events.extend(events)
+                self._obs_at = time.monotonic()
+                self._obs_seq = int(msg.get("seq", self._obs_seq + 1))
+                new_dropped = int(msg.get("dropped", 0))
+                just_dropped = max(new_dropped - self._obs_dropped, 0)
+                self._obs_dropped = new_dropped
+            reg = get_registry()
+            reg.counter("serve.fleet.obs_frames").inc()
+            reg.counter("serve.fleet.obs_bytes").inc(
+                int(msg.get("nbytes", 0)))
+            reg.counter("serve.fleet.obs_events").inc(len(events))
+            if just_dropped:
+                reg.counter("serve.fleet.obs_dropped").inc(just_dropped)
 
     def _mark_dead(self, reason: str) -> None:
         self._dead = reason
@@ -544,7 +590,8 @@ class ProcessReplicaTransport(ReplicaTransport):
                    "attempts": req.attempts,
                    "remaining_s": remaining,
                    "age_s": max(now - req.submitted_at, 0.0),
-                   "cancelled": bool(req.cancelled)}
+                   "cancelled": bool(req.cancelled),
+                   "trace": req.trace_id}
         self._rpc(payload)                        # raises remote errors
         req.attempts += 1                         # placement ledger
         with self._state_lock:
@@ -552,6 +599,19 @@ class ProcessReplicaTransport(ReplicaTransport):
 
     def poll(self) -> List[Response]:
         self._check()
+        out: List[Response] = []
+        with self._state_lock:
+            while self._responses:
+                out.append(self._responses.popleft())
+        return out
+
+    def salvage(self) -> List[Response]:
+        """Drain the parent-side response buffer WITHOUT the liveness
+        check. These responses were accepted off live frames (and their
+        tokens counted into ``obs_tokens_out``) before the wire died;
+        the controller's drop path delivers them instead of re-running
+        their requests, keeping the delivered-token reconciliation
+        exact across a SIGKILL."""
         out: List[Response] = []
         with self._state_lock:
             while self._responses:
@@ -637,6 +697,21 @@ class ProcessReplicaTransport(ReplicaTransport):
     def default_max_new_tokens(self) -> int:
         return self.default_max_new_tokens_
 
+    # -- shipped telemetry ---------------------------------------------------
+
+    def obs_view(self):
+        """The parent-side view of everything this child shipped:
+        ``(registry, age_s, seq, events)`` — the merged
+        :class:`~..obs.telemetry.MetricsRegistry`, seconds since the
+        newest obs frame (None before the first), the child's frame
+        sequence number, and a copy of the bounded trace-event stream.
+        """
+        with self._state_lock:
+            age = (time.monotonic() - self._obs_at
+                   if self._obs_at is not None else None)
+            return (self._obs_registry, age, self._obs_seq,
+                    list(self._obs_events))
+
     # -- health -------------------------------------------------------------
 
     def health(self) -> ReplicaHealth:
@@ -689,7 +764,7 @@ class ProcessReplicaTransport(ReplicaTransport):
 # child side: the replica worker
 
 
-def _build_engine(spec: ReplicaSpec):
+def _build_engine(spec: ReplicaSpec, event_log=None):
     """Construct the replica's model/backend/engine from the handshake
     spec — imports deferred so the parent-side transport never pays
     for jax."""
@@ -719,7 +794,7 @@ def _build_engine(spec: ReplicaSpec):
     wd = TickWatchdog() if spec.watchdog else None
     return ServeEngine(backend,
                        RequestQueue(capacity=spec.queue_capacity),
-                       watchdog=wd)
+                       watchdog=wd, event_log=event_log)
 
 
 def _child_op(engine, msg: dict, now: float):
@@ -737,7 +812,8 @@ def _child_op(engine, msg: dict, now: float):
             cancelled=bool(msg.get("cancelled", False)),
             # engine.place() increments: the wire ships the
             # pre-placement count so both ledgers agree after
-            attempts=int(msg["attempts"]))
+            attempts=int(msg["attempts"]),
+            trace_id=msg.get("trace"))
         engine.place(req)
         return True
     if op == "cancel":
@@ -795,7 +871,17 @@ def worker(port: int, token: str) -> None:
     spec_msg = recv_frame(sock)
     assert spec_msg and spec_msg.get("op") == "spec", spec_msg
     spec = ReplicaSpec(**spec_msg["spec"])
-    engine = _build_engine(spec)
+    if spec.telemetry:
+        from ..obs.fleet_obs import TraceBuffer
+        trace_buf = TraceBuffer()
+    else:
+        # zero-overhead pledge: a disabled registry hands the jitted
+        # bodies the shared null instruments (HLO byte-identical) and
+        # the wire carries no obs frames at all
+        from ..obs.telemetry import null_registry, set_registry
+        set_registry(null_registry())
+        trace_buf = None
+    engine = _build_engine(spec, event_log=trace_buf)
     send_frame(sock, {"op": "ready",
                       "default_max_new_tokens":
                           engine.backend.gen.max_new_tokens,
@@ -827,6 +913,37 @@ def worker(port: int, token: str) -> None:
             return s
         return None
 
+    obs_state = {"seq": 0, "base": {}, "dropped": 0}
+    obs_lock = threading.Lock()
+
+    def ship_obs() -> None:
+        # Telemetry piggybacks on the heartbeat cadence: a mergeable
+        # registry delta plus the drained trace-event buffer, bounded
+        # by spec.obs_max_bytes. Oversized payloads shed their events
+        # first (metrics are tiny and keep counters continuous), then
+        # drop outright — telemetry is strictly lossy-over-blocking and
+        # can never stall the data plane.
+        with obs_lock:
+            reg = get_registry()
+            metrics = reg.snapshot(mergeable=True, base=obs_state["base"])
+            events = trace_buf.drain() if trace_buf is not None else []
+            if not metrics and not events:
+                return
+            obs_state["seq"] += 1
+            msg = {"op": "obs", "seq": obs_state["seq"], "metrics": metrics,
+                   "events": events, "dropped": obs_state["dropped"]}
+            buf = _pack(msg)
+            if len(buf) > spec.obs_max_bytes and events:
+                obs_state["dropped"] += len(events)
+                msg["events"] = []
+                msg["dropped"] = obs_state["dropped"]
+                buf = _pack(msg)
+            if len(buf) > spec.obs_max_bytes:
+                obs_state["dropped"] += 1
+                return
+            msg["nbytes"] = len(buf)
+        send_frame(link["sock"], msg, send_lock)
+
     def hb_pump() -> None:
         # Heartbeats come from their OWN thread: the main loop blocks
         # for seconds inside jit compiles (first prefill/decode of each
@@ -839,6 +956,8 @@ def worker(port: int, token: str) -> None:
             time.sleep(spec.heartbeat_interval_s)
             try:
                 send_frame(link["sock"], _heartbeat(engine), send_lock)
+                if spec.telemetry:
+                    ship_obs()
             except OSError:
                 pass
 
@@ -860,6 +979,8 @@ def worker(port: int, token: str) -> None:
                 continue
             if msg.get("op") == "shutdown":
                 try:
+                    if spec.telemetry:
+                        ship_obs()    # final deltas before the lights go out
                     send_frame(sock, {"op": "reply",
                                       "rpc": msg.get("rpc"),
                                       "value": True}, send_lock)

@@ -5,6 +5,8 @@ See ``docs/observability.md`` for the full subsystem tour.
 """
 
 from .events import (EventLog, NULL_EVENT_LOG, NullEventLog, SPAN_KINDS)
+from .fleet_obs import (FleetObserver, SloMonitor, SloTargets, TraceBuffer,
+                        prometheus_text)
 from .meters import (BubbleMeter, device_memory_report, measured_bubble_slope,
                      measured_bubble_two_point, profile_trace,
                      stage_busy_from_trace, stage_scope,
@@ -20,6 +22,8 @@ __all__ = [
     "measured_bubble_two_point", "profile_trace", "stage_busy_from_trace",
     "stage_scope", "stage_timeline_from_trace",
     "EventLog", "NullEventLog", "NULL_EVENT_LOG", "SPAN_KINDS",
+    "FleetObserver", "SloMonitor", "SloTargets", "TraceBuffer",
+    "prometheus_text",
     "Counter", "EwmaTimer", "Gauge", "Histogram", "MetricsRegistry",
     "StepReport", "device_memory_peaks", "get_registry", "null_registry",
     "peak_flops_per_chip", "set_registry", "train_flops_per_token",

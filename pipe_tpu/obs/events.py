@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, IO, List, Optional
@@ -59,12 +60,26 @@ SPAN_KINDS = (STEP, STAGE, MICROBATCH, COMM, RECOMPUTE, REQUEST)
 
 
 class EventLog:
-    """Append-only JSONL event sink with nested span support."""
+    """Append-only JSONL event sink with nested span support.
 
-    def __init__(self, path: str, *, autoflush: bool = True):
+    ``max_bytes`` arms size-bounded rotation: once the live file would
+    exceed it, the file is renamed to ``<path>.1`` (replacing any
+    previous rollover — at most two files ever exist) and a fresh file
+    opens with a ``log_open`` header carrying ``rotated=True``. Long
+    fleet drills keep at most ``2 * max_bytes`` on disk. A reader that
+    races a writer (or a crash mid-line) can leave a torn final line;
+    :meth:`read` tolerates exactly that — a final line that does not
+    parse is dropped, a torn line anywhere else still raises."""
+
+    def __init__(self, path: str, *, autoflush: bool = True,
+                 max_bytes: Optional[int] = None):
+        if max_bytes is not None and max_bytes < 1024:
+            raise ValueError(f"max_bytes must be >= 1024, got {max_bytes}")
         self.path = path
         self._autoflush = autoflush
+        self._max_bytes = max_bytes
         self._file: Optional[IO[str]] = open(path, "a")
+        self._written = self._file.tell()
         self._lock = threading.Lock()
         self._local = threading.local()
         self._next_id = 0
@@ -89,9 +104,27 @@ class EventLog:
         with self._lock:
             if self._file is None:
                 return
+            if self._max_bytes is not None \
+                    and self._written + len(line) + 1 > self._max_bytes \
+                    and self._written > 0:
+                self._rotate_locked()
             self._file.write(line + "\n")
+            self._written += len(line) + 1
             if self._autoflush:
                 self._file.flush()
+
+    def _rotate_locked(self) -> None:
+        """Roll the live file to ``<path>.1`` (caller holds the lock)."""
+        self._file.close()
+        os.replace(self.path, self.path + ".1")
+        self._file = open(self.path, "a")
+        self._written = 0
+        header = json.dumps({"kind": "log_open", "wall_time": time.time(),
+                             "id": self._alloc_id(), "parent": None,
+                             "t": time.perf_counter() - self._t0,
+                             "rotated": True})
+        self._file.write(header + "\n")
+        self._written += len(header) + 1
 
     # -- recording ---------------------------------------------------------
 
@@ -154,13 +187,25 @@ class EventLog:
 
     @staticmethod
     def read(path: str) -> List[Dict[str, Any]]:
-        """All records in file order (children precede their parent span)."""
-        out: List[Dict[str, Any]] = []
+        """All records in file order (children precede their parent span).
+
+        A torn FINAL line — the one artifact a crash or a reader racing
+        the writer can legitimately produce on an append-only file — is
+        dropped silently; corruption anywhere else still raises."""
         with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    out.append(json.loads(line))
+            lines = [ln.strip() for ln in f]
+        while lines and not lines[-1]:
+            lines.pop()
+        out: List[Dict[str, Any]] = []
+        for i, line in enumerate(lines):
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    break
+                raise
         return out
 
 

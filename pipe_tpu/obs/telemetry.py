@@ -40,6 +40,17 @@ __all__ = [
 ]
 
 
+def _escape_label(value) -> str:
+    """Escape the characters that carry structure in a labelled name
+    (``\\ . { } , =``) so replica ids like ``host.1`` or ``a,b=c``
+    cannot collide with a differently-labelled instrument or with the
+    ``.``-suffixed export keys ``scalars()`` derives."""
+    s = str(value)
+    for ch in ("\\", ".", "{", "}", ",", "="):
+        s = s.replace(ch, "\\" + ch)
+    return s
+
+
 def labelled(name: str, **labels) -> str:
     """Canonical labelled-instrument name: ``name{k=v,k2=v2}`` with keys
     sorted, so every call site derives the same registry key. The
@@ -47,10 +58,13 @@ def labelled(name: str, **labels) -> str:
     a *naming convention*, which keeps the null-registry fast path and
     the ``scalars()`` dump untouched while letting fleet consumers
     filter per-replica series by prefix (e.g.
-    ``serve.fleet.replica.queue_depth{replica=2}``)."""
+    ``serve.fleet.replica.queue_depth{replica=2}``). Label *values* are
+    escaped (:func:`_escape_label`) so structured replica ids stay
+    collision-safe; plain ints and simple strings pass through
+    unchanged."""
     if not labels:
         return name
-    body = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    body = ",".join(f"{k}={_escape_label(labels[k])}" for k in sorted(labels))
     return f"{name}{{{body}}}"
 
 
@@ -241,8 +255,30 @@ class MetricsRegistry:
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
-    def snapshot(self) -> Dict[str, Any]:
-        """All instruments as plain data (histograms/timers as dicts)."""
+    def snapshot(self, *, mergeable: bool = False,
+                 base: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """All instruments as plain data.
+
+        Default form (``mergeable=False``): histograms/timers as summary
+        dicts, counters/gauges as raw values — the human-readable shape
+        the event log and bench artifacts record.
+
+        ``mergeable=True`` emits the *wire* form the fleet obs plane
+        ships between processes: typed records that another registry can
+        fold in with :meth:`merge_snapshot` — counters as **deltas**
+        (``{"k": "c", "d": n}``), gauges as last-value
+        (``{"k": "g", "v": x}``), timers as count/total deltas plus
+        last-value ewma (``{"k": "t", ...}``), histograms as sparse
+        per-bucket **count deltas** over the shared log2 edges
+        (``{"k": "h", "b": [[bucket, d], ...], ...}``) so percentile
+        shape survives merging. ``base`` is the caller's delta ledger (a
+        mutable dict, updated in place): pass the same dict every call
+        and each snapshot carries only what changed since the last one.
+        Zero-delta instruments are omitted, which bounds frame size on
+        quiet replicas.
+        """
+        if mergeable:
+            return self._mergeable_snapshot(base if base is not None else {})
         out: Dict[str, Any] = {}
         for name, inst in sorted(self._instruments.items()):
             if isinstance(inst, (Counter, Gauge)):
@@ -253,6 +289,79 @@ class MetricsRegistry:
             else:
                 out[name] = inst.summary()
         return out
+
+    def _mergeable_snapshot(self, base: Dict[str, Any]) -> Dict[str, Any]:
+        # shipped from a telemetry thread while the tick thread creates
+        # instruments: copy the name->instrument map under the lock
+        with self._lock:
+            items = sorted(self._instruments.items())
+        out: Dict[str, Any] = {}
+        for name, inst in items:
+            if isinstance(inst, Counter):
+                prev = base.get(name, 0)
+                if inst.value != prev:
+                    out[name] = {"k": "c", "d": inst.value - prev}
+                    base[name] = inst.value
+            elif isinstance(inst, Gauge):
+                if base.get(name) != inst.value:
+                    out[name] = {"k": "g", "v": inst.value}
+                    base[name] = inst.value
+            elif isinstance(inst, EwmaTimer):
+                pc, pt = base.get(name, (0, 0.0))
+                if inst.count != pc:
+                    out[name] = {"k": "t", "dc": inst.count - pc,
+                                 "dt": inst.total - pt, "ewma": inst.ewma,
+                                 "last": inst.last, "alpha": inst.alpha}
+                    base[name] = (inst.count, inst.total)
+            elif isinstance(inst, Histogram):
+                prev_counts = base.get(name)
+                if prev_counts is None:
+                    prev_counts = [0] * len(inst.counts)
+                buckets = [[i, c - prev_counts[i]]
+                           for i, c in enumerate(inst.counts)
+                           if c != prev_counts[i]]
+                if buckets:
+                    dn = sum(d for _, d in buckets)
+                    ds = inst.sum - base.get(name + "\0sum", 0.0)
+                    out[name] = {"k": "h", "b": buckets, "dn": dn, "ds": ds,
+                                 "min": (None if inst.min is math.inf
+                                         else inst.min),
+                                 "max": inst.max}
+                    base[name] = list(inst.counts)
+                    base[name + "\0sum"] = inst.sum
+        return out
+
+    def merge_snapshot(self, snap: Dict[str, Any]) -> None:
+        """Fold a ``snapshot(mergeable=True)`` dict from another registry
+        (typically another process's) into this one: counter deltas add,
+        gauges last-write-win, timer count/total add (ewma/last taken
+        from the source — the shipper's steady-state view), histogram
+        bucket deltas add bucket-wise so merged percentiles stay exact
+        at bucket resolution. Instruments are created on first sight;
+        merging into a disabled registry is a no-op."""
+        if not self.enabled:
+            return
+        for name, rec in snap.items():
+            kind = rec.get("k") if isinstance(rec, dict) else None
+            if kind == "c":
+                self.counter(name).inc(rec["d"])
+            elif kind == "g":
+                self.gauge(name).set(rec["v"])
+            elif kind == "t":
+                t = self.timer(name, rec.get("alpha", 0.1))
+                t.count += rec["dc"]
+                t.total += rec["dt"]
+                t.ewma = rec["ewma"]
+                t.last = rec["last"]
+            elif kind == "h":
+                h = self.histogram(name)
+                for i, d in rec["b"]:
+                    h.counts[i] += d
+                h.count += rec["dn"]
+                h.sum += rec["ds"]
+                if rec.get("min") is not None:
+                    h.min = min(h.min, rec["min"])
+                h.max = max(h.max, rec["max"])
 
     def scalars(self) -> Dict[str, float]:
         """Flat name → float view for ``ScalarWriter`` export (timer →

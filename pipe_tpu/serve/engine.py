@@ -1447,11 +1447,13 @@ class ServeEngine:
 
     # -- retirement --------------------------------------------------------
 
-    def _record(self, resp: Response, bucket: Optional[int]) -> None:
+    def _record(self, resp: Response, bucket: Optional[int],
+                req: Optional[Request] = None) -> None:
         self._responses[resp.request_id] = resp
         self.queue.forget(resp.request_id)
         reg = get_registry()
         reg.counter("serve.engine.retired").inc()
+        reg.histogram("serve.engine.e2e_sec").observe(resp.latency)
         if resp.status == "timeout":
             reg.counter("serve.engine.timed_out").inc()
         elif resp.status == "cancelled":
@@ -1470,7 +1472,9 @@ class ServeEngine:
             REQUEST, request=resp.request_id, status=resp.status,
             finish_reason=resp.finish_reason, prompt_len=resp.prompt_len,
             bucket=bucket, tokens=len(resp.tokens), ttft=resp.ttft,
-            latency=resp.latency)
+            latency=resp.latency, stage="terminal",
+            trace=getattr(req, "trace_id", None),
+            attempts=getattr(req, "attempts", 0))
 
     def _finish_queued(self, req: Request, reason: str,
                        now: float) -> Response:
@@ -1478,7 +1482,7 @@ class ServeEngine:
         resp = Response(request_id=req.id, tokens=[], status=status,
                         finish_reason=reason, prompt_len=len(req.prompt),
                         ttft=None, latency=now - req.submitted_at)
-        self._record(resp, None)
+        self._record(resp, None, req)
         return resp
 
     def _shed_queued(self, req: Request, reason: str,
@@ -1488,7 +1492,7 @@ class ServeEngine:
         resp = Response(request_id=req.id, tokens=[], status="shed",
                         finish_reason=reason, prompt_len=len(req.prompt),
                         ttft=None, latency=now - req.submitted_at)
-        self._record(resp, None)
+        self._record(resp, None, req)
         return resp
 
     def _fail_queued(self, req: Request, exc: Exception,
@@ -1504,7 +1508,7 @@ class ServeEngine:
                         finish_reason="backend_error",
                         prompt_len=len(req.prompt),
                         ttft=None, latency=now - req.submitted_at)
-        self._record(resp, None)
+        self._record(resp, None, req)
         return resp
 
     def _retire(self, slot: int, status: str, reason: str,
@@ -1522,7 +1526,7 @@ class ServeEngine:
                         status=status, finish_reason=reason,
                         prompt_len=len(req.prompt), ttft=st.ttft,
                         latency=now - req.submitted_at)
-        self._record(resp, bucket)
+        self._record(resp, bucket, req)
         return resp
 
     # -- the tick ----------------------------------------------------------
@@ -1640,6 +1644,10 @@ class ServeEngine:
             self._slots[slot] = st
             reg.counter("serve.engine.admitted").inc()
             reg.histogram("serve.engine.ttft_sec").observe(st.ttft)
+            self.events.event(REQUEST, request=req.id, stage="prefill",
+                              trace=req.trace_id, slot=slot, ttft=st.ttft,
+                              attempts=req.attempts,
+                              prompt_len=len(req.prompt))
             if eos is not None and tok0 == eos:
                 finished.append(self._retire(slot, "ok", "eos", t_first))
             elif req.max_new_tokens == 1:

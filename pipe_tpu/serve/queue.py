@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import time
+import uuid
 from typing import Callable, List, Optional, Sequence, Tuple
 
 __all__ = ["QueueFull", "Request", "Response", "RequestQueue"]
@@ -59,7 +60,12 @@ class Request:
     the router's retry budget; a request served directly by one engine
     keeps it at 0. ``submitted_at`` and ``deadline`` are set exactly
     once, at the original submit: a failed-over request keeps them
-    through every re-queue, so it never regains deadline credit."""
+    through every re-queue, so it never regains deadline credit.
+    ``trace_id`` is the distributed-tracing correlation key, minted
+    exactly once at the original :meth:`RequestQueue.submit` and carried
+    verbatim through placement, retry park, KV handoff and failover —
+    including across the process-replica wire — so every span a request
+    touches, in any process, lands in one stitched timeline."""
 
     id: int
     prompt: List[int]
@@ -70,6 +76,7 @@ class Request:
     submitted_at: float = 0.0
     cancelled: bool = False
     attempts: int = 0
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -144,7 +151,7 @@ class RequestQueue:
                       max_new_tokens=int(max_new_tokens), seed=int(seed),
                       priority=int(priority),
                       deadline=None if timeout_s is None else now + timeout_s,
-                      submitted_at=now)
+                      submitted_at=now, trace_id=uuid.uuid4().hex[:16])
         self._waiting.append(req)
         self._by_id[req.id] = req
         return req

@@ -155,6 +155,17 @@ class Router(FleetController):
 
     def _add_replica(self, transport: InProcessTransport) -> Replica:
         rep = super()._add_replica(transport)
+        # trace completeness for the in-process fleet: replica engines
+        # built without their own event log inherit the router's, so
+        # per-request prefill/terminal records land in the SAME stream
+        # the controller's queued/placed/delivered records use and
+        # FleetObserver.stitch() sees one complete timeline (the
+        # process-fleet equivalent ships child events over the wire)
+        from ..obs.events import NULL_EVENT_LOG
+        eng = getattr(transport, "engine", None)
+        if eng is not None and eng.events is NULL_EVENT_LOG \
+                and self.events is not NULL_EVENT_LOG:
+            eng.events = self.events
         if self.chaos is not None:
             self._install_chaos(rep)
         return rep

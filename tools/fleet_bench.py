@@ -1,7 +1,7 @@
 """Fleet bench: goodput, kill-one-of-N failover, async ticks, KV handoff.
 
 Four questions, answered with the tiny LM on whatever backend is
-available (the numbers of record are the committed ``FLEET_r15.json``):
+available (the numbers of record are the committed ``FLEET_r16.json``):
 
 1. **Scaling** — saturated fleet goodput (ok tokens/s through the
    controller's exactly-once ledger) at N = 1, 2, 3 replicas, over the
@@ -31,12 +31,23 @@ available (the numbers of record are the committed ``FLEET_r15.json``):
    (export disabled). Measures TTFT of the first post-remap request
    both ways; the win is the prefill work the shipped blocks saved.
 
+The kill trials also exercise the fleet observability plane
+(docs/observability.md, "Fleet observability"): the controller runs
+under a :class:`~pipe_tpu.obs.fleet_obs.TraceBuffer` event log and a
+:class:`~pipe_tpu.obs.fleet_obs.FleetObserver`, and the summary stamps
+the delivered-token reconciliation (per-replica delivery-synchronized
+token counters must sum to the parent ledger's delivered total — across
+the SIGKILL), per-replica metric staleness, the SLO verdict over the
+merged rollup, and trace-stitch stats: every submitted id must
+reconstruct into exactly one stitched timeline, failed-over ids showing
+both placements in one trace. ``bench.py --quick`` asserts those.
+
 Every summary stamps host contention (1-min load average vs CPU count):
 on a contended host the absolute numbers are noise — the flag says so
 instead of letting the artifact lie.
 
 Usage:
-  python tools/fleet_bench.py                 # full run -> FLEET_r15.json
+  python tools/fleet_bench.py                 # full run -> FLEET_r16.json
   python tools/fleet_bench.py --quick --fleet proc   # bench.py embed
 Progress goes to stderr; the last stdout line is always the summary
 object, so ``bench.py`` embeds the --quick summary.
@@ -60,6 +71,8 @@ from pipe_tpu.fleet import (FleetController, ProcessReplicaTransport,  # noqa: E
                             ReplicaSpec)
 from pipe_tpu.inference import GenerationConfig  # noqa: E402
 from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM  # noqa: E402
+from pipe_tpu.obs.fleet_obs import (FleetObserver, SloMonitor,  # noqa: E402
+                                    SloTargets, TraceBuffer)
 from pipe_tpu.obs.telemetry import get_registry  # noqa: E402
 from pipe_tpu.resilience import ChaosPlan, Fault, TickWatchdog  # noqa: E402
 from pipe_tpu.serve import (BucketSpec, RequestQueue, Router,  # noqa: E402
@@ -115,14 +128,15 @@ def proc_spec():
 
 
 def make_fleet(model, params, n_replicas, *, fleet="inproc", chaos=None,
-               capacity=256):
+               capacity=256, event_log=None):
     if fleet == "proc":
         transports = [ProcessReplicaTransport(proc_spec())
                       for _ in range(n_replicas)]
         return FleetController(
             transports, RequestQueue(capacity=capacity),
             policy=RouterPolicy(backoff_base_s=0.0,
-                                heartbeat_timeout_s=5.0))
+                                heartbeat_timeout_s=5.0),
+            event_log=event_log)
     gen_cfg = GenerationConfig(max_new_tokens=MAX_NEW, temperature=0.0)
     engines = []
     for _ in range(n_replicas):
@@ -134,7 +148,7 @@ def make_fleet(model, params, n_replicas, *, fleet="inproc", chaos=None,
             watchdog=TickWatchdog(stuck_slack_ticks=None)))
     return Router(engines, RequestQueue(capacity=capacity),
                   policy=RouterPolicy(backoff_base_s=0.0), chaos=chaos,
-                  async_tick=(fleet == "thread"))
+                  async_tick=(fleet == "thread"), event_log=event_log)
 
 
 def warm(router, n_replicas):
@@ -186,7 +200,7 @@ def timed_run(router, workload, pace_s=0.0, on_tick=None):
     elapsed = time.monotonic() - t0
     missing = [i for i in submitted if router.response(i) is None]
     assert not missing, f"requests with no terminal response: {missing}"
-    return records, elapsed, ticks
+    return records, elapsed, ticks, submitted
 
 
 def tokens_per_tick(records, lo, hi):
@@ -207,12 +221,53 @@ def ok_tokens(records):
     return sum(n for _, s, n, _ in records if s == "ok")
 
 
+def obs_report(observer, submitted):
+    """Observability-plane stamp for a kill trial: the delivered-token
+    reconciliation, per-replica metric staleness, the SLO verdict over
+    the merged fleet rollup, and trace-stitch stats — every submitted
+    id must reconstruct into EXACTLY one stitched timeline (trace ids
+    are minted once and survive failover), and failed-over ids must
+    show both placements in one trace. Call AFTER router.close(): the
+    proc children ship their final obs deltas on the shutdown RPC, and
+    everything read here is parent-side state that survives them."""
+    reconcile = observer.reconcile()
+    per = observer.per_replica()
+    stitched = observer.stitch_by_request()
+    owners = {}
+    for key, recs in observer.stitch().items():
+        for r in recs:
+            if r.get("request") is not None:
+                owners.setdefault(int(r["request"]), set()).add(key)
+    have = [i for i in submitted if i in stitched]
+    exactly_once = all(len(owners.get(i, ())) == 1 for i in submitted)
+    failed_over = sum(
+        1 for i in submitted
+        if len({r.get("attempts") for r in stitched.get(i, [])
+                if r.get("stage") == "placed"}) >= 2)
+    verdict = SloMonitor(SloTargets(goodput_min=0.5)).verdict(
+        observer.rollup())
+    return {
+        "reconcile": reconcile,
+        "staleness_s": {str(i): (None if v["staleness_s"] is None
+                                 else round(v["staleness_s"], 3))
+                        for i, v in per.items()},
+        "trace_stitch": {
+            "submitted": len(submitted),
+            "stitched": len(have),
+            "frac": round(len(have) / max(len(submitted), 1), 4),
+            "exactly_once": bool(exactly_once),
+            "failed_over_with_both_placements": failed_over,
+        },
+        "slo": verdict,
+    }
+
+
 def scaling_trial(model, params, n_replicas, n_requests, seed, fleet):
     rng = np.random.RandomState(seed)
     router = make_fleet(model, params, n_replicas, fleet=fleet)
     try:
         warm(router, n_replicas)
-        records, elapsed, ticks = timed_run(
+        records, elapsed, ticks, _ = timed_run(
             router, make_workload(n_requests, rng),
             pace_s=0.01 if fleet != "inproc" else 0.0)
     finally:
@@ -250,16 +305,20 @@ def kill_trial(model, params, n_replicas, n_requests, seed, kill_tick,
         return _kill_trial_proc(n_replicas, rng)
     chaos = ChaosPlan([Fault("kill_replica", step=kill_tick,
                              stage=n_replicas - 1)])
+    trace_buf = TraceBuffer(maxlen=200_000)
     router = make_fleet(model, params, n_replicas, fleet=fleet,
-                        chaos=chaos)
+                        chaos=chaos, event_log=trace_buf)
     try:
         warm(router, n_replicas)
-        records, elapsed, ticks = timed_run(
+        records, elapsed, ticks, submitted = timed_run(
             router, make_workload(n_requests, rng),
             pace_s=0.01 if fleet != "inproc" else 0.0)
         states = router.counts()
     finally:
         router.close()
+    obs = obs_report(FleetObserver(router,
+                                   parent_events=trace_buf.drain()),
+                     submitted)
     assert ticks > kill_tick + window, (
         f"run finished in {ticks} ticks; needs > "
         f"{kill_tick + window} — raise the load")
@@ -290,6 +349,7 @@ def kill_trial(model, params, n_replicas, n_requests, seed, kill_tick,
         "responses_by_status": by_status,
         "exactly_once": len(records) == n_requests,
         "replica_states": states,
+        "obs": obs,
     }
 
 
@@ -301,7 +361,9 @@ def _kill_trial_proc(n_replicas, rng, kill_after_s=2.0, duration_s=6.0,
     1 s windows before/during/after the kill shows the degrade (one
     replica's work vanishes and its in-flight set pays a retry) and
     the recovery (survivors absorb the stream)."""
-    router = make_fleet(None, None, n_replicas, fleet="proc")
+    trace_buf = TraceBuffer(maxlen=200_000)
+    router = make_fleet(None, None, n_replicas, fleet="proc",
+                        event_log=trace_buf)
     # oversized pool: the feed must NOT run dry inside the measured
     # windows (a drained feed deflates the post-kill rate and reads as
     # a failed recovery)
@@ -340,6 +402,9 @@ def _kill_trial_proc(n_replicas, rng, kill_after_s=2.0, duration_s=6.0,
         assert not missing, f"requests with no terminal: {missing}"
     finally:
         router.close()
+    obs = obs_report(FleetObserver(router,
+                                   parent_events=trace_buf.drain()),
+                     submitted)
     assert kill_t is not None, "run too short to reach the kill point"
     w = min(1.0, kill_t, (elapsed - kill_t) / 2)
     before = tokens_per_sec(records, kill_t - w, kill_t)
@@ -368,6 +433,7 @@ def _kill_trial_proc(n_replicas, rng, kill_after_s=2.0, duration_s=6.0,
         "responses_by_status": by_status,
         "exactly_once": len(records) == len(submitted),
         "replica_states": states,
+        "obs": obs,
     }
 
 
@@ -577,12 +643,16 @@ def main():
     handoff = handoff_trial(repeats=2 if args.quick else 3)
     log(f"   {handoff}")
 
+    stitch = kill["obs"]["trace_stitch"]
     ok = bool(kill["exactly_once"] and kill["survived_failover"]
               and kill["recovered_frac"] > 0.3
               and straggler["async_beats_serial"]
-              and handoff["handoff_moved_blocks"])
+              and handoff["handoff_moved_blocks"]
+              and kill["obs"]["reconcile"]["reconciled"]
+              and stitch["frac"] == 1.0
+              and stitch["exactly_once"])
     summary = {
-        "bench": "fleet", "rev": "r15",
+        "bench": "fleet", "rev": "r16",
         "quick": bool(args.quick),
         "fleet": args.fleet,
         "platform": jax.default_backend(),
@@ -617,6 +687,10 @@ def main():
             "ttft_win_s": handoff["ttft_win_s"],
             "handoff_moved_blocks": handoff["handoff_moved_blocks"],
             "contended": summary["contention"]["contended"],
+            "tokens_reconciled": kill["obs"]["reconcile"]["reconciled"],
+            "trace_stitch_frac": stitch["frac"],
+            "trace_stitch_exactly_once": stitch["exactly_once"],
+            "slo_ok": kill["obs"]["slo"]["ok"],
             "fleet_ok": ok,
         }))
     else:

@@ -1,0 +1,133 @@
+"""fleet_top: live terminal view of the fleet observability plane.
+
+Polls the ``/fleet`` and ``/slo`` endpoints that
+``pipe_tpu.apps.serve --metrics-port`` serves (docs/observability.md,
+"Fleet observability") and renders a top(1)-style screen: one row per
+replica — health state, queue depth, live slots, the
+delivery-synchronized ``tokens_out``/``responses_out`` counters, obs
+frame seq and metric staleness — plus the fleet SLO verdict line with
+any violations called out.
+
+The screen is produced by the pure :func:`render` (fleet dict + slo
+verdict in, string out) so tests exercise the layout without a server
+or a terminal; the CLI is just fetch → clear → print in a loop.
+
+Usage:
+  python -m pipe_tpu.apps.serve ... --replicas 3 --metrics-port 9100 &
+  python tools/fleet_top.py --url http://127.0.0.1:9100
+  python tools/fleet_top.py --url http://127.0.0.1:9100 --once  # one frame
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ["render", "fetch"]
+
+_COLS = ("replica", "state", "depth", "live", "tokens_out",
+         "responses", "obs_seq", "stale")
+
+
+def fetch(base_url: str, timeout_s: float = 2.0):
+    """(fleet dict, slo verdict dict) from a serve --metrics-port
+    endpoint. Raises urllib errors on an unreachable server."""
+    out = []
+    for path in ("/fleet", "/slo"):
+        with urllib.request.urlopen(base_url.rstrip("/") + path,
+                                    timeout=timeout_s) as resp:
+            out.append(json.loads(resp.read().decode()))
+    return out[0], out[1]
+
+
+def _fmt_stale(v) -> str:
+    if v is None:
+        return "-"
+    return f"{float(v):.2f}s"
+
+
+def render(fleet, slo, title: str = "fleet_top") -> str:
+    """One screen: per-replica table + SLO verdict. ``fleet`` is the
+    ``/fleet`` JSON ({replica index -> view dict}); ``slo`` the
+    ``/slo`` verdict. Pure — no I/O, no clock."""
+    rows = []
+    tok_sum = resp_sum = 0
+    for idx in sorted(fleet, key=lambda k: int(k)):
+        v = fleet[idx]
+        tok_sum += int(v.get("tokens_out") or 0)
+        resp_sum += int(v.get("responses_out") or 0)
+        rows.append((str(idx), str(v.get("state", "?")),
+                     str(v.get("queue_depth", "-")),
+                     str(v.get("live_slots", "-")),
+                     str(v.get("tokens_out", 0)),
+                     str(v.get("responses_out", 0)),
+                     "-" if v.get("obs_seq") is None
+                     else str(v["obs_seq"]),
+                     _fmt_stale(v.get("staleness_s"))))
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(_COLS)]
+    ok = bool(slo.get("ok", True))
+    lines = [f"{title} — {len(rows)} replica(s)   "
+             f"SLO: {'OK' if ok else 'VIOLATED'}",
+             "  ".join(c.ljust(w) for c, w in zip(_COLS, widths))]
+    for r in rows:
+        lines.append("  ".join(x.ljust(w) for x, w in zip(r, widths)))
+    lines.append(f"fleet: tokens_out={tok_sum} responses={resp_sum}")
+    obs = slo.get("observed", {})
+    if obs:
+        lines.append(
+            "observed: "
+            f"ttft p50 {obs.get('ttft_p50_s', 0):.4f}s "
+            f"p99 {obs.get('ttft_p99_s', 0):.4f}s | "
+            f"e2e p99 {obs.get('e2e_p99_s', 0):.4f}s | "
+            f"goodput {obs.get('goodput', 0):.3f} | "
+            f"miss {obs.get('deadline_miss_rate', 0):.3f} | "
+            f"shed {obs.get('shed_rate', 0):.3f} | "
+            f"delivered {obs.get('delivered', 0)}")
+    for v in slo.get("violations", []):
+        lines.append(f"VIOLATION {v['slo']}: observed "
+                     f"{v['observed']:.4f} vs target {v['target']:.4f}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default="http://127.0.0.1:9100",
+                    help="base URL of a serve --metrics-port endpoint")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="poll period in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clear)")
+    ap.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of redrawing the screen")
+    args = ap.parse_args()
+
+    while True:
+        try:
+            fleet, slo = fetch(args.url)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            print(f"fleet_top: {args.url} unreachable: {e}",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        frame = render(fleet, slo)
+        if args.once:
+            print(frame)
+            return 0
+        if not args.no_clear:
+            sys.stdout.write("\x1b[2J\x1b[H")      # clear + home
+        print(frame, flush=True)
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
