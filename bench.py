@@ -494,6 +494,18 @@ def main():
         assert serve_summary["kv_paged_vs_slab_equal_slots"] >= 1.0, (
             "paged KV slower than slab at equal live slots: "
             f"{serve_summary['kv_paged_vs_slab_equal_slots']}x")
+        # The radix tree's reason to exist: on the multi-tenant
+        # workload (divergent full-block tails) it must reuse strictly
+        # more blocks than the gen-1 whole-prefix counterfactual — and
+        # the offload round trip must never change a token.
+        assert (serve_summary["kv_radix_hit_block_fraction"]
+                > serve_summary["kv_whole_prefix_hit_fraction"]), (
+            "radix prefix reuse no better than a whole-prefix cache: "
+            f"{serve_summary['kv_radix_hit_block_fraction']} vs "
+            f"{serve_summary['kv_whole_prefix_hit_fraction']}")
+        assert serve_summary["kv_offload_bitwise"], (
+            "KV offload drill produced different tokens than the "
+            "unpressured run")
         # The resident while_loop exists to remove per-chunk host
         # round-trips; it must not LOSE tokens/s at equal live slots.
         assert serve_summary["resident_vs_nonresident_tokens_s"] >= 1.0, (

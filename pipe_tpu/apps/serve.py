@@ -201,6 +201,23 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--kv-pool-blocks", type=int, default=None,
                    help="pool size in blocks with --kv paged "
                         "(default: the slab's row footprint)")
+    p.add_argument("--kv-offload", action="store_true",
+                   help="with --kv paged: spill cold refcount-0 blocks "
+                        "to host RAM under pressure and restore on "
+                        "re-reference instead of hard-evicting")
+    p.add_argument("--kv-offload-blocks", type=int, default=None,
+                   help="host store capacity in blocks for --kv-offload "
+                        "(default: the device pool size)")
+    p.add_argument("--placement",
+                   choices=["least_loaded", "session", "prefix"],
+                   default="least_loaded",
+                   help="fleet placement: least_loaded, session "
+                        "pinning, or prefix (score replicas by matched "
+                        "prefix depth x occupancy headroom)")
+    p.add_argument("--kv-hot-refs", type=int, default=None,
+                   help="fleet: replicate prefixes shared by at least "
+                        "N live slots to a sibling proactively "
+                        "(requires --kv paged; >= 2)")
     p.add_argument("--int8", action="store_true",
                    help="int8 weight-only quantized block weights")
     p.add_argument("--family", choices=["lm", "gpt2"], default="lm")
@@ -290,9 +307,17 @@ def main(argv=None) -> int:
     # spec lane: K-1 rows of verify-write slack on top of the request cap
     max_len = buckets.max_len + args.max_new + (
         args.spec_tokens - 1 if args.spec_tokens else 0)
+    if (args.kv_offload or args.kv_hot_refs is not None
+            or args.placement == "prefix") and args.kv != "paged":
+        print("--kv-offload/--kv-hot-refs/--placement prefix need "
+              "--kv paged (the slab has no blocks to spill, share, or "
+              "advertise)", file=sys.stderr)
+        return 2
     kv_kwargs = {} if args.kv == "slab" else {
         "kv_block_size": args.kv_block_size,
-        "kv_pool_blocks": args.kv_pool_blocks}
+        "kv_pool_blocks": args.kv_pool_blocks,
+        "kv_offload": args.kv_offload,
+        "kv_offload_blocks": args.kv_offload_blocks}
     resident = {"auto": "auto", "on": True, "off": False}[args.resident]
     if args.spec_tokens is not None and n_stages > 1:
         print("--spec-tokens requires --stages 1 (the ring's sampled "
@@ -360,14 +385,20 @@ def main(argv=None) -> int:
                      temperature=args.temperature, top_k=args.top_k,
                      eos_token_id=args.eos),
             **({"kv_block_size": args.kv_block_size,
-                "kv_pool_blocks": args.kv_pool_blocks}
+                "kv_pool_blocks": args.kv_pool_blocks,
+                "kv_offload": args.kv_offload,
+                "kv_offload_blocks": args.kv_offload_blocks,
+                "kv_hot_refs": args.kv_hot_refs}
                if args.kv == "paged" else {}))
         transports = [ProcessReplicaTransport(spec)
                       for _ in range(replicas)]
         queue = RequestQueue(capacity=args.queue_capacity,
                              policy=args.policy)
-        eng = FleetController(transports, queue, policy=RouterPolicy(),
-                              event_log=events)
+        eng = FleetController(
+            transports, queue,
+            policy=RouterPolicy(placement=args.placement,
+                                kv_hot_refs=args.kv_hot_refs),
+            event_log=events)
     elif replicas > 1:
         # in-process fleet: one front queue, N engines each with its own
         # queue/watchdog, the Router in between. The single-replica path
@@ -390,7 +421,10 @@ def main(argv=None) -> int:
                    for b in backends]
         queue = RequestQueue(capacity=args.queue_capacity,
                              policy=args.policy)
+        from ..serve import RouterPolicy
         eng = Router(engines, queue, event_log=events,
+                     policy=RouterPolicy(placement=args.placement,
+                                         kv_hot_refs=args.kv_hot_refs),
                      async_tick=(args.fleet == "thread"))
     else:
         queue = RequestQueue(capacity=args.queue_capacity,

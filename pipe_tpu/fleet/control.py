@@ -145,6 +145,17 @@ class RouterPolicy:
     """
 
     placement: str = "least_loaded"
+    # prefix placement + hot replication (paged pools only).
+    # ``placement="prefix"`` scores candidates by matched-prefix depth x
+    # occupancy headroom from each replica's advertised prefix
+    # directory, falling back to session pin / least-loaded when nothing
+    # matches. ``kv_hot_refs`` (None disables) proactively replicates
+    # prefix chains shared by that many live slots to the
+    # least-occupied sibling lacking them, via the same
+    # export/import path a session remap uses; at most
+    # ``kv_replicate_max_per_tick`` ships per tick.
+    kv_hot_refs: Optional[int] = None
+    kv_replicate_max_per_tick: int = 1
     retry_budget: int = 3
     backoff_base_s: float = 0.05
     backoff_max_s: float = 2.0
@@ -162,10 +173,18 @@ class RouterPolicy:
     min_replicas: int = 1
 
     def __post_init__(self):
-        if self.placement not in ("least_loaded", "session"):
+        if self.placement not in ("least_loaded", "session", "prefix"):
             raise ValueError(
-                f"placement must be least_loaded|session, got "
+                f"placement must be least_loaded|session|prefix, got "
                 f"{self.placement!r}")
+        if self.kv_hot_refs is not None and self.kv_hot_refs < 2:
+            raise ValueError(
+                f"kv_hot_refs must be >= 2 (a block one slot holds is "
+                f"not hot) or None, got {self.kv_hot_refs}")
+        if self.kv_replicate_max_per_tick < 1:
+            raise ValueError(
+                f"kv_replicate_max_per_tick must be >= 1, got "
+                f"{self.kv_replicate_max_per_tick}")
         if self.retry_budget < 1:
             raise ValueError(
                 f"retry_budget must be >= 1, got {self.retry_budget}")
@@ -316,6 +335,22 @@ class ReplicaTransport:
         """Leading full prompt blocks already cached here (the
         warm-handoff probe)."""
         return 0
+
+    def prefix_directory(self) -> Optional[dict]:
+        """This replica's advertised KV residency: ``{"block_size",
+        "digests", "occupancy", "blocks_free", "blocks_total"}`` (the
+        pool's ``prefix_digest_summary``), or None when the replica has
+        no paged pool / the directory hasn't arrived yet. Process
+        replicas ship it on the heartbeat cadence — it may be a beat
+        stale, which placement tolerates (a miss just means a cold
+        prefill)."""
+        return None
+
+    def hot_prefixes(self, min_refs: int) -> List[dict]:
+        """Prefix chains shared by >= ``min_refs`` live slots, each as
+        ``{"digest", "refs", "depth", "tokens"}`` with the full token
+        chain — the proactive-replication feed."""
+        return []
 
 
 class InProcessTransport(ReplicaTransport):
@@ -485,6 +520,18 @@ class InProcessTransport(ReplicaTransport):
             return 0
         return pool.cached_prefix_blocks(prompt)
 
+    def prefix_directory(self) -> Optional[dict]:
+        pool = getattr(self.engine.backend, "pool", None)
+        if pool is None:
+            return None
+        return pool.prefix_digest_summary()
+
+    def hot_prefixes(self, min_refs: int) -> List[dict]:
+        pool = getattr(self.engine.backend, "pool", None)
+        if pool is None:
+            return []
+        return pool.hot_prefixes(min_refs)
+
 
 # ---------------------------------------------------------------------------
 # replica record
@@ -566,6 +613,7 @@ class FleetController:
         self._session_of: Dict[int, str] = {}
         self._session_map: Dict[str, int] = {}
         self._placed_on: Dict[int, int] = {}
+        self._kv_replicated: Dict[str, set] = {}
         self._pending_out: List[Response] = []
         self._tick_index = 0
         self._depth_streak = 0
@@ -794,7 +842,11 @@ class FleetController:
                 and r.transport.queue_depth < r.transport.queue_capacity]
 
     def _choose(self, req: Request, candidates: List[Replica]) -> Replica:
-        if self.policy.placement == "session":
+        if self.policy.placement == "prefix":
+            rep = self._choose_by_prefix(req, candidates)
+            if rep is not None:
+                return rep
+        if self.policy.placement in ("session", "prefix"):
             sess = self._session_of.get(req.id)
             if sess is not None:
                 home = self._session_map.get(sess)
@@ -802,6 +854,38 @@ class FleetController:
                     if rep.index == home:
                         return rep
         return min(candidates, key=lambda r: (r.load, r.index))
+
+    def _choose_by_prefix(self, req: Request,
+                          candidates: List[Replica]) -> Optional[Replica]:
+        """Score candidates by matched-prefix depth x occupancy
+        headroom from their advertised directories: the request lands
+        where its prefix already lives UNLESS that replica is nearly
+        full (a deep match on a saturated pool would evict what it came
+        for). None when no candidate matches anything — the caller
+        falls back to session pin / least-loaded."""
+        from ..serve.kvpool import prefix_hashes, prefix_match_depth
+        best: Optional[Replica] = None
+        best_key: Tuple[float, int, int] = (0.0, 0, 0)
+        for rep in candidates:
+            try:
+                d = rep.transport.prefix_directory()
+            except TransportError:
+                continue
+            if not d or not d.get("digests") or not d.get("block_size"):
+                continue
+            depth = prefix_match_depth(
+                prefix_hashes(req.prompt, int(d["block_size"])),
+                set(d["digests"]))
+            if depth == 0:
+                continue
+            total = max(1, int(d.get("blocks_total", 1)))
+            headroom = max(0.05, int(d.get("blocks_free", 0)) / total)
+            key = (depth * headroom, -rep.load, -rep.index)
+            if key > best_key:
+                best, best_key = rep, key
+        if best is not None:
+            get_registry().counter("serve.fleet.prefix_placements").inc()
+        return best
 
     def _kv_handoff(self, req: Request, sess: str, old_idx: int,
                     new_rep: Replica) -> None:
@@ -860,6 +944,76 @@ class FleetController:
                           shipped_blocks=shipped, bytes=nbytes,
                           trace=req.trace_id, stage="handoff",
                           attempts=req.attempts)
+
+    def _replicate_hot_prefixes(self) -> None:
+        """Push hot prefixes (refcount >= ``policy.kv_hot_refs``) to one
+        sibling each, ahead of demand, through the same export/import
+        path a session remap uses. A digest ships at most once per
+        (digest, target) pair — ``_kv_replicated`` remembers what went
+        where — and at most ``kv_replicate_max_per_tick`` exports run
+        per tick so replication never starves placement."""
+        reg = get_registry()
+        budget = self.policy.kv_replicate_max_per_tick
+        healthy = [r for r in self.replicas if r.state == HEALTHY]
+        if len(healthy) < 2:
+            return
+        for src in healthy:
+            if budget <= 0:
+                return
+            try:
+                hot = src.transport.hot_prefixes(self.policy.kv_hot_refs)
+            except TransportError:
+                continue
+            for entry in hot:
+                if budget <= 0:
+                    return
+                digest = entry.get("digest")
+                tokens = entry.get("tokens")
+                if not digest or not tokens:
+                    continue
+                shipped_to = self._kv_replicated.setdefault(digest, set())
+                sibling = None
+                sib_free = -1.0
+                for rep in healthy:
+                    if rep is src or rep.index in shipped_to:
+                        continue
+                    try:
+                        d = rep.transport.prefix_directory()
+                    except TransportError:
+                        continue
+                    if d and digest in set(d.get("digests", ())):
+                        shipped_to.add(rep.index)   # already resident
+                        continue
+                    free = (int(d.get("blocks_free", 0))
+                            / max(1, int(d.get("blocks_total", 1)))
+                            if d else 0.0)
+                    if free > sib_free:
+                        sibling, sib_free = rep, free
+                if sibling is None:
+                    continue
+                budget -= 1
+                try:
+                    payload = src.transport.export_prefix(tokens)
+                except TransportError:
+                    continue
+                if payload is None:
+                    continue
+                try:
+                    seated = sibling.transport.import_prefix(payload)
+                except TransportError:
+                    continue
+                shipped_to.add(sibling.index)
+                if seated:
+                    nbytes = int(payload.get("nbytes", 0))
+                    reg.counter("serve.fleet.kv_replicated").inc(seated)
+                    reg.counter("serve.fleet.kv_replicated_bytes").inc(
+                        nbytes)
+                    self.events.event(
+                        "serve", action="kv_replicated",
+                        digest=digest[:12], blocks=seated,
+                        refs=entry.get("refs"),
+                        from_replica=src.index,
+                        to_replica=sibling.index, bytes=nbytes)
 
     def _try_place(self, req: Request, now: float) -> bool:
         candidates = self._placeable()
@@ -1145,6 +1299,11 @@ class FleetController:
                     # that just died → drop → False) and the request
                     # must survive it — park for the next sweep
                     self._parked.append((now, req))
+
+        # 3b) proactive hot-prefix replication — before the poll so a
+        # prefix shipped this tick is visible to next tick's placement
+        if self.policy.kv_hot_refs is not None and not self._draining:
+            self._replicate_hot_prefixes()
 
         # 4) poll the replicas, deliver-or-retry what they finish
         for rep in self.replicas:

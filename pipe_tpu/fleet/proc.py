@@ -106,6 +106,13 @@ class ReplicaSpec:
     kv_block_size: Optional[int] = None
     kv_pool_blocks: Optional[int] = None
     kv_dtype: Optional[str] = None
+    # KV gen-2: spill cold blocks to the child's host RAM under
+    # pressure, and (when kv_hot_refs is set) advertise the prefix
+    # directory + hot digests on heartbeat frames so the controller can
+    # place by prefix and replicate hot nodes proactively
+    kv_offload: bool = False
+    kv_offload_blocks: Optional[int] = None
+    kv_hot_refs: Optional[int] = None
     prefill_chunk: int = 16
     queue_capacity: int = 256
     watchdog: bool = True
@@ -748,6 +755,18 @@ class ProcessReplicaTransport(ReplicaTransport):
         return int(self._rpc({"op": "cached_prefix",
                               "prompt": list(map(int, prompt))}) or 0)
 
+    def prefix_directory(self) -> Optional[dict]:
+        # Read from the last heartbeat, never an RPC: placement runs
+        # every tick and must not add a round trip per candidate. The
+        # directory is at most one heartbeat stale — acceptable for a
+        # placement heuristic (a stale hit just degrades to cold).
+        kv = self._hb.get("kv")
+        return kv.get("directory") if kv else None
+
+    def hot_prefixes(self, min_refs: int) -> List[dict]:
+        kv = self._hb.get("kv")
+        return list(kv.get("hot", ())) if kv else []
+
     # -- test hook ----------------------------------------------------------
 
     def drop_connection(self) -> None:
@@ -790,6 +809,8 @@ def _build_engine(spec: ReplicaSpec, event_log=None):
         gen=gen, buckets=buckets, decode_chunk=spec.decode_chunk,
         kv_block_size=spec.kv_block_size,
         kv_pool_blocks=spec.kv_pool_blocks, kv_dtype=spec.kv_dtype,
+        kv_offload=spec.kv_offload,
+        kv_offload_blocks=spec.kv_offload_blocks,
         prefill_chunk=spec.prefill_chunk)
     wd = TickWatchdog() if spec.watchdog else None
     return ServeEngine(backend,
@@ -842,16 +863,29 @@ def _child_op(engine, msg: dict, now: float):
     raise ValueError(f"unknown fleet op {op!r}")
 
 
-def _heartbeat(engine) -> dict:
+def _heartbeat(engine, kv_hot_refs: Optional[int] = None) -> dict:
     wd = engine.watchdog
-    return {"op": "hb",
-            "slow_streak": wd.slow_streak if wd is not None else 0,
-            "miss_ewma": wd.miss_ewma if wd is not None else 0.0,
-            "stuck_slots": wd.stuck_slots if wd is not None else 0,
-            "decode_errors": engine.consecutive_decode_errors,
-            "depth": engine.queue.depth, "live": engine.live_slots,
-            "idle": engine.idle, "draining": engine.draining,
-            "drained": engine.drained}
+    hb = {"op": "hb",
+          "slow_streak": wd.slow_streak if wd is not None else 0,
+          "miss_ewma": wd.miss_ewma if wd is not None else 0.0,
+          "stuck_slots": wd.stuck_slots if wd is not None else 0,
+          "decode_errors": engine.consecutive_decode_errors,
+          "depth": engine.queue.depth, "live": engine.live_slots,
+          "idle": engine.idle, "draining": engine.draining,
+          "drained": engine.drained}
+    # KV gen-2 directory: piggybacked on the heartbeat cadence (one
+    # beat stale at the controller, which is fine — placement is a
+    # heuristic, correctness never depends on the directory). Only when
+    # kv_hot_refs is armed: an unarmed fleet ships exactly the PR 13
+    # heartbeat bytes.
+    if kv_hot_refs is not None:
+        pool = getattr(engine.backend, "pool", None)
+        if pool is not None:
+            hb["kv"] = {
+                "directory": pool.prefix_digest_summary(),
+                "hot": pool.hot_prefixes(kv_hot_refs),
+            }
+    return hb
 
 
 def worker(port: int, token: str) -> None:
@@ -955,7 +989,8 @@ def worker(port: int, token: str) -> None:
         while link["up"]:
             time.sleep(spec.heartbeat_interval_s)
             try:
-                send_frame(link["sock"], _heartbeat(engine), send_lock)
+                send_frame(link["sock"], _heartbeat(engine, spec.kv_hot_refs),
+                           send_lock)
                 if spec.telemetry:
                     ship_obs()
             except OSError:

@@ -112,6 +112,29 @@ class GenerationConfig:
                 "spec_tokens is a slot-decode lane; beam search has no "
                 "speculative form (num_beams must be 1)")
 
+    def check_kv_headroom(self, bucket_max_len: int,
+                          block_size: Optional[int] = None) -> None:
+        """Paged serving with length buckets: reject a block size that
+        does not divide the per-slot KV span ``bucket_max_len +
+        max_new_tokens`` cleanly — the last block would round up and
+        silently waste its tail rows on EVERY slot. Called by the slot
+        backends at construction (the span is only known once buckets
+        are chosen, so the check cannot live in ``__post_init__``)."""
+        bs = block_size if block_size is not None else self.kv_block_size
+        if bs is None:
+            return
+        span = int(bucket_max_len) + self.max_new_tokens
+        waste = -span % bs
+        if waste:
+            raise ValueError(
+                f"kv_block_size={bs} does not divide the KV headroom "
+                f"bucket_max_len + max_new_tokens = {bucket_max_len} + "
+                f"{self.max_new_tokens} = {span}: every slot's last "
+                f"block would waste {waste} of {bs} rows "
+                f"({waste / bs:.0%} of a block) as unwritable padding; "
+                f"pick a block size dividing {span} or adjust "
+                f"max_new_tokens by {waste}")
+
 
 def check_positions(model, prompt_len: int, max_new_tokens: int) -> None:
     """Fail loudly when decode would run past the positional table —

@@ -236,6 +236,18 @@ class FleetObserver:
                 if eng is not None:
                     view["queue_depth"] = eng.queue.depth
                     view["live_slots"] = eng.live_slots
+            # KV gen-2 directory view: digest count + block occupancy as
+            # the controller's placement sees them (heartbeat-stale for
+            # shipped transports, fresh in-process); absent for slab
+            # replicas and unarmed process fleets
+            d = self._safe(lambda t=tr: t.prefix_directory(), None)
+            if d:
+                view["kv"] = {
+                    "digests": len(d.get("digests", ())),
+                    "occupancy": d.get("occupancy"),
+                    "blocks_free": d.get("blocks_free"),
+                    "blocks_total": d.get("blocks_total"),
+                }
             out[rep.index] = view
         return out
 

@@ -54,9 +54,9 @@ from ..inference.quant import QuantLeaf, dequant_tree
 from ..obs.events import NULL_EVENT_LOG, REQUEST
 from ..obs.telemetry import get_registry, host_overhead_per_token
 from .buckets import BucketSpec
-from .kvpool import (KvPool, PoolExhausted, block_demand, copy_block,
-                     flat_row_index, gather_block_cache, scatter_block_rows,
-                     storage_for)
+from .kvpool import (HostKvStore, KvPool, PoolExhausted, block_demand,
+                     copy_block, flat_row_index, gather_block_cache,
+                     scatter_block_rows, storage_for)
 from .queue import QueueFull, Request, RequestQueue, Response
 
 __all__ = ["SingleDeviceSlotBackend", "ServeEngine", "EngineDraining"]
@@ -99,6 +99,8 @@ class SingleDeviceSlotBackend:
                  kv_pool_blocks: Optional[int] = None,
                  prefill_chunk: int = 16,
                  kv_dtype: Optional[str] = None,
+                 kv_offload: bool = False,
+                 kv_offload_blocks: Optional[int] = None,
                  resident="auto", resident_chunks: int = 8,
                  spec_tokens: Optional[int] = None):
         if not hasattr(model, "embed_at"):
@@ -184,12 +186,29 @@ class SingleDeviceSlotBackend:
             mb = -(-max_len // kbs)
             nb = kv_pool_blocks if kv_pool_blocks is not None \
                 else num_slots * mb + 1
+            if buckets is not None:
+                gen.check_kv_headroom(buckets.max_len, kbs)
             self.pool = KvPool(
                 num_blocks=nb, block_size=kbs, num_slots=num_slots,
                 max_len=max_len, prefix_cache=gen.prefix_cache,
                 gather_slack_rows=prefill_chunk)
             self._pool_kv = storage_for(
                 proto, self._n_layers, nb, kbs, kv_dtype=kv_dtype)
+            self.kv_offload = bool(kv_offload)
+            if self.kv_offload:
+                # host spill target for cold refcount-0 blocks: payloads
+                # are raw device bytes (int8 codes + scales for int8
+                # pools), so offload -> restore is a bitwise round trip
+                self._kv_store = HostKvStore(
+                    max_blocks=(kv_offload_blocks
+                                if kv_offload_blocks is not None
+                                else nb))
+                self.pool.attach_offload(self._kv_store,
+                                         self._offload_read_block)
+                self._restore_jit = jax.jit(self._restore_fn,
+                                            donate_argnums=(0,))
+            else:
+                self._kv_store = None
             self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(2,))
             self._sample_jit = jax.jit(self._sample_fn)
             self._fork_jit = jax.jit(self._fork_fn, donate_argnums=(0,))
@@ -212,6 +231,12 @@ class SingleDeviceSlotBackend:
                 raise ValueError(
                     "kv_dtype needs the paged pool (set kv_block_size); "
                     "the slab path stores KV in the compute dtype")
+            if kv_offload:
+                raise ValueError(
+                    "kv_offload needs the paged pool (set kv_block_size); "
+                    "the slab path has no block-level eviction to spill")
+            self.kv_offload = False
+            self._kv_store = None
             self.pool = None
             self._caches = jax.tree_util.tree_map(
                 lambda a: jnp.zeros(
@@ -440,6 +465,26 @@ class SingleDeviceSlotBackend:
         every fork)."""
         get_registry().counter("serve.kv.fork_traces").inc()
         return copy_block(pool_kv, src, dst, block_axis=1)
+
+    def _offload_read_block(self, bid: int) -> dict:
+        """Host copy of one physical block across every pool array —
+        the payload :class:`~.kvpool.HostKvStore` holds while the block
+        is offloaded. Raw storage bytes (int8 codes + scales for int8
+        pools), so the later restore is bitwise."""
+        return {name: np.asarray(a[:, bid])
+                for name, a in self._pool_kv.items()}
+
+    def _restore_fn(self, pool_kv, dst, payload):
+        """Write an offloaded block's host payload back at physical
+        block ``dst`` (traced — ONE program for every restore, the
+        mirror of :meth:`_fork_fn`; the view refresh rides the regather
+        flag the admitting prefill arms anyway)."""
+        get_registry().counter("serve.kv.restore_traces").inc()
+        out = dict(pool_kv)
+        for name, rows in payload.items():
+            out[name] = jax.lax.dynamic_update_slice_in_dim(
+                pool_kv[name], rows[:, None], dst, axis=1)
+        return out
 
     def _decode_paged_fn(self, block_stack, pre, post, pool_kv, tables,
                          tok, pos, key_data, views, regather):
@@ -1015,6 +1060,14 @@ class SingleDeviceSlotBackend:
         adm = self.pool.admit(slot, prompt, max_new_tokens,
                               chunk=self.prefill_chunk)
         try:
+            for dst, payload in adm.restores:
+                # offloaded prefix blocks this admission reuses come
+                # back from the host store BEFORE any fork/chunk writes;
+                # the regather armed below refreshes the decode views —
+                # no extra host decision per tick
+                self._pool_kv = self._restore_jit(
+                    self._pool_kv, jnp.int32(dst),
+                    {k: jnp.asarray(v) for k, v in payload.items()})
             for src, dst in adm.cow_forks:
                 self._pool_kv = self._fork_jit(
                     self._pool_kv, jnp.int32(src), jnp.int32(dst))
@@ -1281,7 +1334,8 @@ class SingleDeviceSlotBackend:
                         :, dst_idx].set(
                             rows.astype(self._pool_kv[name].dtype))
         seated = self.pool.seat_prefix(
-            [(h, int(b)) for (_, h), b in zip(fresh, dst)])
+            [(h, int(b)) for (_, h), b in zip(fresh, dst)],
+            chain=payload["hashes"])
         get_registry().counter("serve.kv.prefix_imported").inc(seated)
         return seated
 
@@ -1601,27 +1655,42 @@ class ServeEngine:
         # backend failure here is attributable to ONE request: fail it,
         # free the slot, keep admitting. Paged backends gate on BLOCK
         # availability too: when the pool can't cover the head request's
-        # demand, it parks at the head (FIFO order intact) until
-        # retirements free blocks — the slab masked this over-admission
-        # by reserving max_len rows for everyone up front.
+        # demand, the head PARKS (it keeps its place; FIFO/priority
+        # order is never rotated) but the scan tries the next request in
+        # pop order — a small request behind a parked giant no longer
+        # starves (serve.engine.admission_skipped counts the bypasses).
         device_sec = 0.0                    # prefill + decode launches
+        head_blocked_counted = False
         while self._free and not self._draining:
-            nxt = self.queue.peek()
-            if nxt is None:
-                break
             can = getattr(self.backend, "can_admit", None)
-            if can is not None and not can(
-                    len(nxt.prompt), nxt.max_new_tokens, nxt.prompt):
-                pool = getattr(self.backend, "pool", None)
-                detail = ({"blocks_free": pool.free_blocks,
-                           "blocks_evictable": pool.evictable_blocks}
-                          if pool is not None else {})
-                reg.counter("serve.kv.admission_blocked").inc()
-                self.events.event("serve", action="admission_blocked",
-                                  request=nxt.id, depth=self.queue.depth,
-                                  **detail)
+            candidates = self.queue.admission_order()
+            if not candidates:
                 break
-            req = self.queue.pop()
+            req = None
+            for cand in candidates:
+                if can is None or can(len(cand.prompt),
+                                      cand.max_new_tokens, cand.prompt):
+                    req = cand
+                    break
+                if cand is candidates[0] and not head_blocked_counted:
+                    head_blocked_counted = True
+                    pool = getattr(self.backend, "pool", None)
+                    detail = ({"blocks_free": pool.free_blocks,
+                               "blocks_evictable": pool.evictable_blocks}
+                              if pool is not None else {})
+                    reg.counter("serve.kv.admission_blocked").inc()
+                    self.events.event("serve", action="admission_blocked",
+                                      request=cand.id,
+                                      depth=self.queue.depth, **detail)
+            if req is None:
+                break                       # nothing admissible: park all
+            if req is not candidates[0]:
+                reg.counter("serve.engine.admission_skipped").inc()
+                self.events.event("serve", action="admission_skipped",
+                                  request=req.id,
+                                  parked=candidates[0].id,
+                                  depth=self.queue.depth)
+            self.queue.take(req.id)
             slot = self._free.pop()
             t_pre = self.clock()
             try:

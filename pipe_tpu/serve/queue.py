@@ -279,3 +279,25 @@ class RequestQueue:
         best = max(range(len(self._waiting)),
                    key=lambda i: (self._waiting[i].priority, -i))
         return self._waiting.pop(best)
+
+    def admission_order(self) -> List[Request]:
+        """Every queued request in the exact order repeated ``pop``
+        calls would return them, WITHOUT removing anything — the
+        engine's head-of-line-skip admission scan: when the head can't
+        seat (block demand too big for the pool right now), the next
+        admissible request in this order may go first."""
+        if self.policy == "fifo":
+            return list(self._waiting)
+        order = sorted(range(len(self._waiting)),
+                       key=lambda i: (-self._waiting[i].priority, i))
+        return [self._waiting[i] for i in order]
+
+    def take(self, request_id: int) -> Optional[Request]:
+        """Remove and return a SPECIFIC queued request by id (None when
+        it isn't queued) — the companion to :meth:`admission_order`:
+        after the scan picks a non-head request, ``take`` pulls exactly
+        that one, leaving the blocked head parked in place."""
+        for i, req in enumerate(self._waiting):
+            if req.id == request_id:
+                return self._waiting.pop(i)
+        return None

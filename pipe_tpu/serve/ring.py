@@ -74,6 +74,8 @@ class RingSlotBackend:
                  kv_pool_blocks: Optional[int] = None,
                  prefill_chunk: int = 16,
                  kv_dtype: Optional[str] = None,
+                 kv_offload: bool = False,
+                 kv_offload_blocks: Optional[int] = None,
                  resident="auto", resident_revolutions: int = 8):
         if STAGE_AXIS not in mesh.axis_names:
             raise ValueError(f"mesh must have a {STAGE_AXIS!r} axis")
@@ -146,6 +148,14 @@ class RingSlotBackend:
                 raise NotImplementedError(
                     "int8 KV blocks are single-device only for now; the "
                     "ring pool stores the compute dtype")
+            if kv_offload:
+                raise NotImplementedError(
+                    "kv_offload is single-device only for now: spilling "
+                    "a block means a host read of every stage's shard "
+                    "of it, which the ring's sharded pool layout does "
+                    "not expose yet")
+            if buckets is not None:
+                gen.check_kv_headroom(buckets.max_len, kbs)
             if prefill_chunk < 1:
                 raise ValueError(
                     f"prefill_chunk must be >= 1, got {prefill_chunk}")
@@ -170,6 +180,10 @@ class RingSlotBackend:
                 raise ValueError(
                     "kv_dtype needs the paged pool (set kv_block_size); "
                     "the slab path stores KV in the compute dtype")
+            if kv_offload:
+                raise ValueError(
+                    "kv_offload needs the paged pool (set kv_block_size); "
+                    "the slab path has no block-level eviction to spill")
             self.pool = None
             # sacrificial region: big enough to absorb a q=max_bucket
             # prefill write from an inactive stage AND any

@@ -194,8 +194,8 @@ _SLOW = {
     "[never-ppxdp]",
     "test_norm.py::test_table_executor_bn_matches_emulator[never-1f1b]",
     # phased executor parity grid: smoke keeps [never-1f1b]/[never-zb-h1]/
-    # interleaved/rejection/front-door; [never-gpipe], skip_lanes[never],
-    # policy_ulp and pp_dp stay as the per-shape reps
+    # interleaved/rejection/front-door; skip_lanes[never], policy_ulp and
+    # pp_dp stay as the per-shape reps ([never-gpipe] moved below, PR 17)
     "test_phase_compile.py::test_phased_bitwise_parity[except_last-gpipe]",
     "test_phase_compile.py::test_phased_bitwise_parity[except_last-zb-h1]",
     "test_phase_compile.py::test_phased_bitwise_parity[except_last-1f1b]",
@@ -317,6 +317,64 @@ _SLOW = {
     "test_kvpool.py::test_paged_staggered_parity_and_one_program[ring]",
     "test_kvpool.py::test_shared_prefix_cow_parity[ring]",
     "test_kvpool.py::test_paged_sampled_parity_ring_matches_slab_ring",
+    # ------------------------------------------------------------------
+    # Second expansion (PR 17), sized from a fresh single-core profile
+    # (--durations=0, uncontended): the default run had crept to 952s vs
+    # the 870s budget, and this 1-core host shows ~±6% run-to-run
+    # variance, so the target is ~790s measured. The entries below cut
+    # ~160s of measured call time. Kept coverage per entry:
+    #
+    # ~67s, three full train-step compiles under different kill scopes —
+    # the heaviest single tier-1 test; the (slow) elastic drill exercises
+    # heartbeat kill-detection end-to-end and persistent_hop_drop_and_
+    # hop_health keeps hop health in tier 1
+    "test_elastic.py::test_kill_heartbeat_localizes_stage",
+    # [except_last] stays as the dropout-key-folding rep (it covers both
+    # remat'd and non-remat'd stages in one run); the 65-case loss/grad
+    # matrix keeps every checkpoint mode on this executor
+    "test_scheduled.py::test_dropout_matches_ad_executor_bitwise[always]",
+    "test_scheduled.py::test_dropout_matches_ad_executor_bitwise[never]",
+    # mirror of the spmd pattern above: [except_last] stays as the rep
+    "test_sharded_params.py::test_sharded_gradient_transparency[never]",
+    "test_sharded_params.py::test_sharded_gradient_transparency[always]",
+    # interleaved trainer stays as the trainer-level e2e; zb-h1 schedule
+    # math is pinned by the [never-zb-h1] phase smoke + zb_split/zb_tables
+    "test_data_train.py::test_zb_h1_trainer",
+    # [greedy] + the int8 run-identical drill keep the engine-level
+    # offload/restore path in tier 1; sampled paged-decode parity is held
+    # by test_kvpool's sampled parity twin
+    "test_kv_radix.py::test_engine_offload_restore_bitwise_fp32[sampled]",
+    # gen-1 head-parking drill superseded in tier 1 by test_kv_radix's
+    # admission pins (blocked-head counter, priority-respecting skip);
+    # the full matrix keeps the parking path
+    "test_kvpool.py::test_admission_parks_at_head_until_blocks_free",
+    # unit-level dupes of kept composition smokes: the [2-2] pp x tp
+    # smoke + tp_gen/tp beam parity keep TP math; ffn[1] keeps MoE
+    "test_tp.py::test_tp_block_matches_unsharded",
+    # phased gpipe rides the same scan lowering as the kept [never-1f1b]
+    # / [never-zb-h1] smokes; table-level gpipe parity stays via the
+    # scheduled [2-8-except_last-gpipe] smoke
+    "test_phase_compile.py::test_phased_bitwise_parity[never-gpipe]",
+    # per-crossing parity dupes; the named sibling params stay in tier 1
+    # ([4-2-16-5] greedy cp rep, [2-4]/[4-2] pp x cp forwards,
+    # [2-4-8-6-3] beam, [2-4-8-6] greedy smoke + [2-2-8-1] one-token
+    # edge, [4-2-8-4] tp_gen greedy)
+    "test_long_context_gen.py::"
+    "test_context_sharded_greedy_matches_single_device[4-1-32-4]",
+    "test_long_context.py::test_pp_cp_forward_transparency[2-2]",
+    "test_pipelined_gen.py::"
+    "test_pipelined_beam_matches_single_device[4-4-5-4-2]",
+    "test_pipelined_gen.py::"
+    "test_pipelined_greedy_matches_single_device[4-4-5-5]",
+    "test_tp_gen.py::test_tp_sharded_greedy_matches_unsharded[2-2-8-6]",
+    # vit family-scale dupe: vit_pipelined_matches_sequential + the
+    # pipe_1f1b uneven-balance test keep both contracts
+    "test_model_zoo.py::test_vit_uneven_balance_through_pipe_mesh",
+    # table-executor BN gpipe crossings: the [except_last-1f1b] smoke
+    # keeps BN-through-table; gpipe tables stay via the scheduled smoke
+    "test_norm.py::test_table_executor_bn_matches_emulator"
+    "[except_last-gpipe]",
+    "test_norm.py::test_table_executor_bn_matches_emulator[never-gpipe]",
 }
 
 

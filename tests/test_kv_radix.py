@@ -1,0 +1,484 @@
+"""KV cache generation 2: the radix tree, block eviction/offload, and
+fleet-wide KV-aware placement.
+
+Three layers under test, mirroring the pool's own split:
+
+* **host allocator laws** (no device programs): the radix trie's
+  insert/match/split-on-divergence structure, the refcount lifecycle
+  across COW fork + retire, the eviction law (a node with resident
+  descendants is never freed — leaf-first, oldest-first), and the host
+  offload round trip (spill under pressure, restore on re-reference,
+  payloads bitwise).
+* **engine drills**: offload→restore through real prefill/decode stays
+  bitwise the one-shot Generator (fp32 greedy AND sampled) and
+  run-identical for int8; the admission loop skips a blocked head for a
+  smaller admissible request without reordering priorities.
+* **fleet**: placement scores replicas by matched prefix depth ×
+  occupancy headroom, and hot prefixes replicate to a sibling ahead of
+  demand through the PR 13 export/import path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipe_tpu.inference import GenerationConfig, Generator
+from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM
+from pipe_tpu.obs.telemetry import get_registry
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.spmd import stack_stage_params
+from pipe_tpu.serve import (KvPool, RequestQueue, Router, RouterPolicy,
+                            ServeEngine, SingleDeviceSlotBackend)
+from pipe_tpu.serve.kvpool import (HostKvStore, prefix_hashes,
+                                   prefix_match_depth)
+from pipe_tpu.serve.ring import RingSlotBackend
+
+CFG = LMConfig(vocab=89, d_model=32, nhead=4, d_ff=64, n_layers=4,
+               seq_len=32, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = PipelinedLM(CFG, n_stages=2)
+    return model, model.init(jax.random.key(0))
+
+
+def _one_shot_refs(model, params, prompts, gen_cfg, seed):
+    g = Generator(model, gen_cfg)
+    return [np.asarray(g.generate(params,
+                                  jnp.asarray(p, jnp.int32)[None],
+                                  jax.random.key(seed)))[0]
+            for p in prompts]
+
+
+def _mixed_prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(1, CFG.vocab, size=n)) for n in lengths]
+
+
+def _pool(**kw):
+    kw.setdefault("num_blocks", 9)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 16)
+    return KvPool(**kw)
+
+
+def _conserved(pool):
+    s = pool.stats()
+    return (s["blocks_free"] + s["blocks_in_use"] + s["blocks_evictable"]
+            == s["blocks_total"])
+
+
+def _fake_payload(bid):
+    # deterministic per-physical-block content, two dtypes so the
+    # bitwise round-trip check covers fp32 and int8 storage at once
+    rng = np.random.RandomState(bid)
+    return {"k": rng.randn(2, 4, 8).astype(np.float32),
+            "scale": np.full((2, 4), float(bid), np.float32),
+            "codes": rng.randint(-128, 128, (2, 4, 8)).astype(np.int8)}
+
+
+# ---------------------------------------------------------------------------
+# radix laws (host only)
+
+
+def test_prefix_hash_chain_and_match_depth():
+    # rolling chain: digest i commits to blocks 0..i
+    h = prefix_hashes(list(range(1, 13)), 4)
+    assert len(h) == 3 and len(set(h)) == 3
+    bent = list(range(1, 13))
+    bent[0] = 77                       # perturb block 0 -> every digest
+    assert all(a != b for a, b in zip(h, prefix_hashes(bent, 4)))
+    bent2 = list(range(1, 13))
+    bent2[5] = 77                      # perturb block 1 -> digests 1, 2
+    h2 = prefix_hashes(bent2, 4)
+    assert h2[0] == h[0] and h2[1] != h[1] and h2[2] != h[2]
+    # match depth stops at the first non-resident digest
+    assert prefix_match_depth(h, set(h)) == 3
+    assert prefix_match_depth(h, {h[0], h[2]}) == 1
+    assert prefix_match_depth(h, set()) == 0
+
+
+def test_radix_insert_match_and_split_on_divergence():
+    pool = _pool(num_blocks=17, max_len=32, num_slots=3)
+    a = _mixed_prompts((16,), seed=1)[0]          # 4 full blocks
+    pool.admit(0, a, 1, chunk=4)
+    pool.release(0)
+    # one path-compressed run holds the whole chain
+    assert pool._radix_node_count() == 1
+    assert pool.stats()["radix_nodes"] == 1
+    b = a[:8] + _mixed_prompts((8,), seed=2)[0]   # diverge after block 2
+    adm = pool.admit(0, b, 1, chunk=4)
+    assert adm.prefix_hits == 2                   # radix partial match
+    pool.release(0)
+    # split on divergence: [a0,a1] -> {[a2,a3], [b2,b3]}
+    assert pool._radix_node_count() == 3
+    ha, hb = pool.prefix_hashes(a), pool.prefix_hashes(b)
+    assert ha[:2] == hb[:2] and ha[2] != hb[2]
+    node, pos = pool._node_of[ha[1]]
+    assert pos == len(node.run) - 1 and len(node.children) == 2
+    # the directory advertises every digest on both arms
+    d = pool.prefix_digest_summary()
+    assert set(d["digests"]) == set(ha) | set(hb)
+    assert d["block_size"] == 4
+    assert _conserved(pool)
+
+
+def test_refcount_lifecycle_across_fork_and_retire():
+    pool = _pool(num_blocks=17, max_len=32, num_slots=3)
+    shared = _mixed_prompts((8,), seed=3)[0]      # 2 full blocks
+    ha = pool.prefix_hashes(shared)
+    pool.admit(0, shared + [7, 9], 2, chunk=4)
+    assert [pool._cached[h].refs for h in ha] == [1, 1]
+    pool.admit(1, shared + [11], 2, chunk=4)      # read-only share
+    assert [pool._cached[h].refs for h in ha] == [2, 2]
+    pool.release(0)
+    assert [pool._cached[h].refs for h in ha] == [1, 1]
+    pool.release(1)
+    assert [pool._cached[h].refs for h in ha] == [0, 0]
+    assert pool.evictable_blocks >= 2             # refs-0 -> LRU
+    # full-hit fork: the source entry is NOT re-referenced (the fork is
+    # a private copy) and survives the fork's retirement untouched
+    adm = pool.admit(2, shared, 2, chunk=4)
+    assert len(adm.cow_forks) == 1
+    assert pool._cached[ha[0]].refs == 1          # block 1 shared again
+    assert pool._cached[ha[1]].refs == 0          # block 2 fork source
+    pool.release(2)
+    assert [pool._cached[h].refs for h in ha] == [0, 0]
+    assert _conserved(pool)
+
+
+def test_eviction_never_frees_a_node_with_resident_descendants():
+    reg = get_registry()
+    pool = _pool(num_blocks=6, num_slots=2)       # 5 allocatable
+    p1 = _mixed_prompts((12,), seed=4)[0]         # 3 cached blocks
+    pool.admit(0, p1, 1, chunk=4)
+    pool.release(0)
+    assert pool.evictable_blocks == 3 and pool.free_blocks == 2
+    # 3-block demand against 2 free: ONE eviction — and although the
+    # chain head is the OLDEST entry on the clock, the leaf goes first
+    # (evicting h0 would strand h1/h2: their digests chain through it)
+    e0 = reg.counter("serve.kv.evictions").value
+    pool.admit(1, _mixed_prompts((9,), seed=5)[0], 4, chunk=4)
+    assert reg.counter("serve.kv.evictions").value - e0 == 1
+    assert pool.cached_prefix_blocks(p1) == 2     # h0, h1 intact
+    pool.release(1, )
+    assert _conserved(pool)
+
+
+# ---------------------------------------------------------------------------
+# host offload (pool level, fake device reader)
+
+
+def test_offload_spill_and_restore_roundtrip_bitwise():
+    reg = get_registry()
+    pool = _pool(num_blocks=6, num_slots=2)       # 5 allocatable
+    store = HostKvStore()
+    pool.attach_offload(store, _fake_payload)
+    p1 = _mixed_prompts((12,), seed=6)[0]
+    pool.admit(0, p1, 1, chunk=4)
+    leaf_block = pool._cached[pool.prefix_hashes(p1)[2]].block
+    pool.release(0)
+    o0 = reg.counter("serve.kv.offload_out").value
+    r0 = reg.counter("serve.kv.offload_restores").value
+    pool.admit(1, _mixed_prompts((9,), seed=7)[0], 4, chunk=4)
+    # pressure spilled the leaf to host instead of dropping it
+    assert reg.counter("serve.kv.offload_out").value - o0 == 1
+    assert pool.offloaded_blocks == 1
+    assert pool.cached_prefix_blocks(p1) == 3     # offloaded still hits
+    assert pool.stats()["blocks_offloaded"] == 1
+    assert pool.stats()["host_kv_bytes"] == store.nbytes
+    pool.release(1)
+    pool.invalidate(pool.prefix_hashes(
+        _mixed_prompts((9,), seed=7)[0]))         # make room
+    # a LONGER re-admission reuses the offloaded leaf read-only:
+    # restored onto a fresh device block with the EXACT bytes that were
+    # spilled (fp32 and int8 alike) — an identical-length prompt would
+    # instead fork it (recompute tail) and leave the original on host
+    adm = pool.admit(0, p1 + _mixed_prompts((4,), seed=10)[0], 1,
+                     chunk=4)
+    assert reg.counter("serve.kv.offload_restores").value - r0 == 1
+    assert len(adm.restores) >= 1
+    want = _fake_payload(leaf_block)
+    _, payload = adm.restores[0]
+    for name in want:
+        np.testing.assert_array_equal(payload[name], want[name])
+        assert payload[name].dtype == want[name].dtype
+    assert pool.offloaded_blocks == 0             # resident again
+    pool.release(0)
+    assert _conserved(pool)
+
+
+def test_host_store_caps_age_out_oldest():
+    store = HostKvStore(max_blocks=2)
+    pay = _fake_payload(1)
+    assert store.put("a", pay) == []
+    assert store.put("b", pay) == []
+    assert store.put("c", pay) == ["a"]           # oldest ages out
+    assert "a" not in store and "b" in store and len(store) == 2
+    # a byte cap smaller than one payload rejects the put itself
+    tiny = HostKvStore(max_bytes=8)
+    assert "x" in tiny.put("x", pay)
+    assert "x" not in tiny
+    # pop removes (restore-for-reuse), get keeps (fork of offloaded)
+    assert store.get("b") is pay and "b" in store
+    assert store.pop("b") is pay and "b" not in store
+
+
+def test_pool_survives_store_dropping_its_own_put():
+    reg = get_registry()
+    pool = _pool(num_blocks=6, num_slots=2)
+    pool.attach_offload(HostKvStore(max_bytes=8), _fake_payload)
+    p1 = _mixed_prompts((12,), seed=8)[0]
+    pool.admit(0, p1, 1, chunk=4)
+    pool.release(0)
+    d0 = reg.counter("serve.kv.offload_dropped").value
+    pool.admit(1, _mixed_prompts((9,), seed=9)[0], 4, chunk=4)
+    # the payload never fit: hard eviction, counted, no phantom entry
+    assert reg.counter("serve.kv.offload_dropped").value - d0 == 1
+    assert pool.offloaded_blocks == 0
+    assert pool.cached_prefix_blocks(p1) == 2
+    pool.release(1)
+    assert _conserved(pool)
+
+
+# ---------------------------------------------------------------------------
+# engine drills
+
+
+def _offload_workload():
+    shared = _mixed_prompts((8,), seed=21)[0]     # 2 cacheable blocks
+    filler = _mixed_prompts((12,), seed=22)[0]    # evicts them
+    return [shared + [3, 5], filler, shared + [7, 9]]
+
+
+@pytest.mark.parametrize("gen_kw", [
+    dict(temperature=0.0),
+    dict(temperature=0.8, top_k=12),
+], ids=["greedy", "sampled"])
+def test_engine_offload_restore_bitwise_fp32(gen_kw, model_and_params):
+    """Spill mid-run, restore on re-reference: tokens stay bitwise the
+    one-shot Generator, greedy and sampled."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=4, **gen_kw)
+    prompts = _offload_workload()
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=6)
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=2, max_len=16, gen=gen_cfg,
+        kv_block_size=4, prefill_chunk=4, kv_pool_blocks=6,
+        kv_offload=True)
+    reg = get_registry()
+    o0 = reg.counter("serve.kv.offload_out").value
+    r0 = reg.counter("serve.kv.offload_restores").value
+    eng = ServeEngine(backend)
+    resps = []
+    for p in prompts:                 # serial: force evict-then-restore
+        rid = eng.submit(p, seed=6).id
+        eng.run_until_idle()
+        resps.append(eng.response(rid))
+    assert reg.counter("serve.kv.offload_out").value - o0 > 0
+    assert reg.counter("serve.kv.offload_restores").value - r0 > 0
+    for resp, ref in zip(resps, refs):
+        assert resp.status == "ok"
+        np.testing.assert_array_equal(np.asarray(resp.tokens), ref)
+
+
+def test_engine_offload_restore_run_identical_int8(model_and_params):
+    """int8 KV payloads spill as raw codes+scales, so an offloaded run
+    is token-identical to an unpressured one — the round trip never
+    requantizes."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    prompts = _offload_workload()
+
+    def run(pool_blocks, offload):
+        be = SingleDeviceSlotBackend(
+            model, params, num_slots=2, max_len=16, gen=gen_cfg,
+            kv_block_size=4, prefill_chunk=4, kv_pool_blocks=pool_blocks,
+            kv_dtype="int8", kv_offload=offload)
+        eng = ServeEngine(be)
+        out = []
+        for p in prompts:
+            rid = eng.submit(p, seed=0).id
+            eng.run_until_idle()
+            out.append(np.asarray(eng.response(rid).tokens))
+        return out
+
+    reg = get_registry()
+    o0 = reg.counter("serve.kv.offload_out").value
+    want = run(32, False)             # roomy: nothing evicts
+    assert reg.counter("serve.kv.offload_out").value == o0
+    got = run(6, True)                # tight: spill + restore
+    assert reg.counter("serve.kv.offload_out").value - o0 > 0
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_offload_requires_paged_and_single_device(model_and_params):
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=4)
+    with pytest.raises(ValueError, match="paged"):
+        SingleDeviceSlotBackend(model, params, num_slots=2, max_len=16,
+                                gen=gen_cfg, kv_offload=True)
+    sp, pre, post = params
+    with pytest.raises(NotImplementedError, match="single-device"):
+        RingSlotBackend(make_mesh(2, 1), model, stack_stage_params(sp),
+                        pre, post, max_len=16, gen=gen_cfg,
+                        kv_block_size=4, kv_offload=True)
+
+
+def test_kv_headroom_validation_names_the_waste(model_and_params):
+    gen = GenerationConfig(max_new_tokens=6)
+    gen.check_kv_headroom(18, 8)      # 24 rows: divides, fine
+    with pytest.raises(ValueError) as ei:
+        gen.check_kv_headroom(16, 8)  # 22 rows: 2 wasted of 8
+    msg = str(ei.value)
+    assert "does not divide" in msg and "waste 2 of 8 rows" in msg
+    # the backend runs the same check against its bucket ceiling
+    from pipe_tpu.serve import BucketSpec
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="does not divide"):
+        SingleDeviceSlotBackend(
+            model, params, num_slots=2, max_len=24, gen=gen,
+            buckets=BucketSpec.of(16), kv_block_size=8, prefill_chunk=4)
+
+
+def test_admission_skips_blocked_head_for_smaller_request(
+        model_and_params):
+    """Head-of-line fix: a head too big for the current pool parks (the
+    PR 11 containment pin) but a smaller admissible request behind it
+    is admitted past it, counted by serve.engine.admission_skipped —
+    and everyone's tokens stay bitwise."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    big_a, big_b = _mixed_prompts((5, 6), seed=31)      # 3 blocks each
+    small = _mixed_prompts((4,), seed=32)[0]            # 4+6-1 rows? no:
+    refs = _one_shot_refs(model, params, [big_a, big_b, small],
+                          gen_cfg, seed=2)
+    # 5 allocatable: big_a (3 blocks) leaves 2 free — big_b blocks at
+    # the head, small (plen 4 + 2 new - 1 -> 2 blocks) fits
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=2, max_len=16, gen=gen_cfg,
+        kv_block_size=4, prefill_chunk=4, kv_pool_blocks=6)
+    reg = get_registry()
+    s0 = reg.counter("serve.engine.admission_skipped").value
+    b0 = reg.counter("serve.kv.admission_blocked").value
+    eng = ServeEngine(backend)
+    ra = eng.submit(big_a, seed=2).id
+    eng.tick()                                          # big_a live
+    rb = eng.submit(big_b, seed=2).id
+    rs = eng.submit(small, max_new_tokens=2, seed=2).id
+    eng.tick()
+    assert reg.counter("serve.kv.admission_blocked").value - b0 >= 1
+    assert reg.counter("serve.engine.admission_skipped").value - s0 == 1
+    # small got past the parked head; big_b still waits at it
+    assert eng.response(rb) is None and eng.queue.depth == 1
+    eng.run_until_idle()
+    for rid, ref, n in ((ra, refs[0], None), (rb, refs[1], None),
+                        (rs, refs[2], 2)):
+        resp = eng.response(rid)
+        assert resp.status == "ok"
+        want = ref if n is None else ref[:len(big_a) - 1 + n]
+        got = np.asarray(resp.tokens)
+        np.testing.assert_array_equal(got, want[:len(got)])
+
+
+def test_admission_skip_respects_priority_order(model_and_params):
+    """With a priority queue the skip scan walks candidates in pop
+    order: a blocked high-priority head is bypassed by the HIGHEST
+    priority admissible request, never an arbitrary one."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    big = _mixed_prompts((6,), seed=33)[0]
+    lo, hi = _mixed_prompts((4, 4), seed=34)
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=2, max_len=16, gen=gen_cfg,
+        kv_block_size=4, prefill_chunk=4, kv_pool_blocks=6)
+    eng = ServeEngine(backend, RequestQueue(policy="priority"))
+    filler = _mixed_prompts((5,), seed=35)[0]
+    eng.submit(filler, seed=0, priority=9)
+    eng.tick()                                          # 3 blocks live
+    eng.submit(big, seed=0, priority=8)                 # head: blocked
+    rl = eng.submit(lo, max_new_tokens=2, seed=0, priority=1).id
+    rh = eng.submit(hi, max_new_tokens=2, seed=0, priority=5).id
+    eng.tick()
+    # the priority-5 bypasser got the slot; priority-1 still waits
+    # behind the parked head
+    assert eng.response(rl) is None
+    assert eng.queue.depth == 2
+    eng.run_until_idle()
+    assert eng.response(rh).status == "ok"
+    assert eng.response(rl).status == "ok"
+
+
+# ---------------------------------------------------------------------------
+# fleet: prefix-aware placement + proactive replication
+
+
+def _fleet(model, params, gen_cfg, policy, n=2):
+    engines = [ServeEngine(SingleDeviceSlotBackend(
+        model, params, num_slots=2, max_len=16, gen=gen_cfg,
+        kv_block_size=4, prefill_chunk=4))
+        for _ in range(n)]
+    return engines, Router(engines, RequestQueue(), policy=policy)
+
+
+def test_prefix_placement_lands_where_the_prefix_lives(model_and_params):
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    shared = _mixed_prompts((8,), seed=41)[0]
+    engines, router = _fleet(model, params, gen_cfg,
+                             RouterPolicy(placement="prefix"))
+    # warm replica 1 out of band — least-loaded would now pick 0
+    engines[1].submit(shared + [3], seed=0)
+    engines[1].run_until_idle()
+    reg = get_registry()
+    p0 = reg.counter("serve.fleet.prefix_placements").value
+    rid = router.submit(shared + [5, 6], max_new_tokens=4, seed=0).id
+    for _ in range(50):
+        router.tick()
+        if router.response(rid) is not None:
+            break
+    assert router.response(rid).status == "ok"
+    assert reg.counter("serve.fleet.prefix_placements").value - p0 == 1
+    # replica 0 never saw it: its pool cached nothing
+    assert not engines[0].backend.pool._cached
+    assert engines[1].backend.pool.cached_prefix_blocks(shared) == 2
+
+
+def test_hot_prefix_replicates_to_sibling(model_and_params):
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    shared = _mixed_prompts((8,), seed=43)[0]
+    engines, router = _fleet(
+        model, params, gen_cfg,
+        RouterPolicy(placement="prefix", kv_hot_refs=2))
+    reg = get_registry()
+    k0 = reg.counter("serve.fleet.kv_replicated").value
+    ra = router.submit(shared + [3], max_new_tokens=6, seed=0).id
+    router.tick()                       # lands replica 0, publishes
+    rb = router.submit(shared + [5], max_new_tokens=6, seed=0).id
+    done = []
+    for _ in range(60):
+        router.tick()
+        done = [router.response(r) for r in (ra, rb)]
+        if all(d is not None for d in done):
+            break
+    assert all(d.status == "ok" for d in done)
+    # both rode replica 0 (prefix score beat least-loaded), refs hit 2,
+    # and the controller shipped the hot chain to the idle sibling
+    assert reg.counter("serve.fleet.kv_replicated").value - k0 == 2
+    assert engines[1].backend.pool.cached_prefix_blocks(shared) == 2
+    assert not router._parked
+
+
+def test_router_policy_validates_gen2_knobs():
+    RouterPolicy(placement="prefix", kv_hot_refs=2)
+    with pytest.raises(ValueError, match="least_loaded|session|prefix"):
+        RouterPolicy(placement="hash")
+    with pytest.raises(ValueError, match="not hot"):
+        RouterPolicy(kv_hot_refs=1)
+    with pytest.raises(ValueError):
+        RouterPolicy(kv_replicate_max_per_tick=0)

@@ -600,6 +600,101 @@ def handoff_trial(repeats=3):
     }
 
 
+def prefix_placement_trial(repeats=3):
+    """Gen-2 KV-aware placement: one replica holds a session's prefix
+    blocks; ``placement="prefix"`` scores candidates by matched depth x
+    occupancy headroom and lands the request there, vs least-loaded
+    which (ties by index) sends it to the COLD replica. The TTFT gap is
+    the prefill work the directory lookup saved. Plus the proactive
+    arm: two concurrent sessions push a chain's refcount to the
+    ``kv_hot_refs`` threshold and the controller replicates it to the
+    idle sibling ahead of any remap."""
+    hcfg = LMConfig(vocab=67, d_model=32, nhead=2, d_ff=64,
+                    n_layers=4, seq_len=160, dropout=0.0)
+    model = PipelinedLM(hcfg, 1)
+    params = model.init(jax.random.key(6))
+    gen_cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    rng = np.random.RandomState(7)
+    shared = list(rng.randint(1, hcfg.vocab, size=136))  # 17 blocks
+
+    def engine():
+        be = SingleDeviceSlotBackend(
+            model, params, num_slots=SLOTS, max_len=160,
+            gen=gen_cfg, kv_block_size=8, kv_pool_blocks=60,
+            prefill_chunk=8)
+        eng = ServeEngine(be, RequestQueue())
+        warm_p = list(rng.randint(1, hcfg.vocab, size=144))
+        for _ in range(2):                  # jit full + resume prefill
+            eng.submit(warm_p, max_new_tokens=4, seed=9)
+            eng.run_until_idle()
+        return eng
+
+    def fleet(policy):
+        engines = [engine(), engine()]
+        # replica 1 is the warm home: its pool already holds the
+        # shared chain (least-loaded ties break toward replica 0)
+        engines[1].submit(shared + [7], max_new_tokens=4, seed=0)
+        engines[1].run_until_idle()
+        return Router(engines, RequestQueue(), policy=policy)
+
+    def serve_one(router, prompt):
+        rid = router.submit(prompt, max_new_tokens=4, seed=0).id
+        for _ in range(10000):
+            router.tick()
+            resp = router.response(rid)
+            if resp is not None:
+                assert resp.status == "ok", resp
+                return resp
+        raise AssertionError("request never finished")
+
+    reg = get_registry()
+    p0 = reg.counter("serve.fleet.prefix_placements").value
+    ttfts = {"prefix": [], "least_loaded": []}
+    for arm in ttfts:
+        for i in range(repeats):
+            router = fleet(RouterPolicy(placement=arm))
+            resp = serve_one(router, shared + [11, 13 + i])
+            ttfts[arm].append(resp.ttft)
+            router.close()
+    placements = reg.counter("serve.fleet.prefix_placements").value - p0
+
+    # proactive replication: both sessions live on replica 0 push the
+    # shared chain to refs=2; the controller ships it to replica 1
+    rep0 = reg.counter("serve.fleet.kv_replicated").value
+    router = Router(
+        [engine(), engine()], RequestQueue(),
+        policy=RouterPolicy(placement="prefix", kv_hot_refs=2))
+    hot = list(rng.randint(1, hcfg.vocab, size=64))      # 8 blocks
+    ra = router.submit(hot + [3], max_new_tokens=4, seed=0).id
+    router.tick()
+    rb = router.submit(hot + [5], max_new_tokens=4, seed=0).id
+    for _ in range(10000):
+        router.tick()
+        if all(router.response(r) is not None for r in (ra, rb)):
+            break
+    replicated = reg.counter("serve.fleet.kv_replicated").value - rep0
+    sibling_warm = router.replicas[1].transport.engine.backend.pool \
+        .cached_prefix_blocks(hot)
+    router.close()
+
+    t_pre = min(ttfts["prefix"])
+    t_ll = min(ttfts["least_loaded"])
+    return {
+        "prompt_len": len(shared) + 2,
+        "kv_block_size": 8,
+        "repeats": repeats,
+        "prefix_placements": int(placements),
+        "ttft_prefix_s": round(t_pre, 4),
+        "ttft_least_loaded_s": round(t_ll, 4),
+        "ttft_win_s": round(t_ll - t_pre, 4),
+        "replicated_blocks": int(replicated),
+        "sibling_warm_blocks": int(sibling_warm),
+        "placement_found_prefix": bool(placements == repeats),
+        "hot_chain_replicated": bool(replicated > 0
+                                     and sibling_warm > 0),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
@@ -643,11 +738,17 @@ def main():
     handoff = handoff_trial(repeats=2 if args.quick else 3)
     log(f"   {handoff}")
 
+    log("== prefix-aware placement + hot replication (2 paged replicas)")
+    placement = prefix_placement_trial(repeats=2 if args.quick else 3)
+    log(f"   {placement}")
+
     stitch = kill["obs"]["trace_stitch"]
     ok = bool(kill["exactly_once"] and kill["survived_failover"]
               and kill["recovered_frac"] > 0.3
               and straggler["async_beats_serial"]
               and handoff["handoff_moved_blocks"]
+              and placement["placement_found_prefix"]
+              and placement["hot_chain_replicated"]
               and kill["obs"]["reconcile"]["reconciled"]
               and stitch["frac"] == 1.0
               and stitch["exactly_once"])
@@ -665,6 +766,7 @@ def main():
         "kill_one_of_n": kill,
         "async_vs_serial": straggler,
         "kv_handoff": handoff,
+        "kv_prefix_placement": placement,
         "fleet_ok": ok,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
@@ -686,6 +788,10 @@ def main():
             "async_beats_serial": straggler["async_beats_serial"],
             "ttft_win_s": handoff["ttft_win_s"],
             "handoff_moved_blocks": handoff["handoff_moved_blocks"],
+            "placement_ttft_win_s": placement["ttft_win_s"],
+            "placement_found_prefix":
+                placement["placement_found_prefix"],
+            "hot_chain_replicated": placement["hot_chain_replicated"],
             "contended": summary["contention"]["contended"],
             "tokens_reconciled": kill["obs"]["reconcile"]["reconciled"],
             "trace_stitch_frac": stitch["frac"],

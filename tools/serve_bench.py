@@ -23,6 +23,13 @@ of record are the committed ``SERVE_r08.json``):
    tokens/s at equal live slots, single-chunk ticks vs the fused
    ``lax.while_loop``, plus draft/verify acceptance on repetitive
    prompts with the bitwise-Generator-parity bit reported.
+5. **KV gen-2** (``SERVE_r17.json``) — multi-tenant radix reuse
+   (fleet-common base, per-tenant divergence, full-block random tails:
+   the shape where a gen-1 whole-prefix cache scores zero) with the
+   counterfactual hit fraction and the TTFT the skipped prefill buys,
+   plus the offload drill: spill cold blocks to host under pool
+   pressure, restore on re-reference, tokens bitwise the unpressured
+   run.
 
 Usage:
   python tools/serve_bench.py            # full run, pretty JSON to stdout
@@ -75,6 +82,17 @@ AB_TAILS = (4, 8)
 AB_MAX_NEW = 32
 AB_BUCKETS = BucketSpec.of(128)
 AB_MAX_LEN = SHARED_LEN + max(AB_TAILS) + AB_MAX_NEW    # 152
+# Multi-tenant radix workload (SERVE_r17): every tenant's preamble
+# starts with one fleet-common base (8 full blocks) then diverges into
+# a per-tenant segment (4 blocks); request tails are >= 1 block so the
+# full prompt-block chain is NEVER entirely cached — a gen-1
+# whole-prefix cache (exact full-chain match) scores zero here, while
+# the radix tree still reuses the base + tenant blocks of every
+# admission after the first per tenant.
+MT_BASE_LEN = 64
+MT_TENANT_LEN = 32
+MT_TENANTS = 3
+MT_TAILS = (8, 16)
 
 
 def host_contention():
@@ -227,6 +245,125 @@ def kv_ab_steady_state(model, params, slots, chunk, seed, *, ticks=8,
             out[name]["pool_blocks"] = pb
     out["prefix_hit_rate"] = round(hits / max(hits + miss, 1), 4)
     return out, pool_blocks
+
+
+def make_multi_tenant_prompts(n, rng, base, tenant_segs):
+    out = []
+    for i in range(n):
+        seg = tenant_segs[i % len(tenant_segs)]
+        tail = rng.randint(1, CFG.vocab,
+                           size=int(rng.choice(MT_TAILS))).tolist()
+        out.append(base + seg + tail)
+    return out
+
+
+def multi_tenant_radix(model, params, slots, chunk, seed, *, n_requests):
+    """Gen-2 headline: block-level radix reuse on a multi-tenant
+    workload vs the gen-1 whole-prefix counterfactual, plus the TTFT it
+    buys. Every request shares the fleet base; tenants diverge after it;
+    tails are full random blocks — so no request's full block chain is
+    ever cached and a whole-prefix cache reuses NOTHING, while the radix
+    tree reuses 12 of ~13 blocks per warm admission. The same prompts
+    run again with the prefix cache off to price the reuse in TTFT
+    (prefill past cached blocks is skipped, so first tokens come back
+    from one chunk instead of a 128-wide bucket sweep)."""
+    from pipe_tpu.obs.telemetry import get_registry
+    reg = get_registry()
+    rng = np.random.RandomState(seed)
+    base = rng.randint(1, CFG.vocab, size=MT_BASE_LEN).tolist()
+    segs = [rng.randint(1, CFG.vocab, size=MT_TENANT_LEN).tolist()
+            for _ in range(MT_TENANTS)]
+    prompts = make_multi_tenant_prompts(n_requests, rng, base, segs)
+    gen_cfg = GenerationConfig(max_new_tokens=AB_MAX_NEW, temperature=0.0)
+
+    keys = ("prefix_hits", "prefix_misses", "prefix_whole_hits")
+
+    def run(prefix_cache):
+        cfg = (gen_cfg if prefix_cache
+               else GenerationConfig(max_new_tokens=AB_MAX_NEW,
+                                     temperature=0.0, prefix_cache=False))
+        backend = SingleDeviceSlotBackend(
+            model, params, num_slots=slots, max_len=AB_MAX_LEN, gen=cfg,
+            buckets=AB_BUCKETS, decode_chunk=chunk,
+            **_backend_kv_kwargs("paged"))
+        eng = ServeEngine(backend,
+                          RequestQueue(capacity=n_requests + 2 * slots))
+        # compile every program (prefill chunks, decode, COW fork)
+        # outside the TTFT window; the warm chain is invalidated so the
+        # measured run starts from a cold cache either way
+        warm = rng.randint(1, CFG.vocab, size=MT_BASE_LEN).tolist()
+        eng.serve([warm + [5], warm], seeds=[seed, seed])
+        pool = eng.backend.pool
+        pool.invalidate(pool.prefix_hashes(warm))
+        c0 = {k: reg.counter(f"serve.kv.{k}").value for k in keys}
+        resps = eng.serve(prompts, seeds=[seed] * len(prompts))
+        return resps, {k: reg.counter(f"serve.kv.{k}").value - c0[k]
+                       for k in keys}
+
+    radix_resps, d = run(True)
+    radix_ttfts = sorted(r.ttft for r in radix_resps)
+    off_ttfts = sorted(r.ttft for r in run(False)[0])
+    looked_up = max(d["prefix_hits"] + d["prefix_misses"], 1)
+    return {
+        "workload": {"base_blocks": MT_BASE_LEN // KV_BLOCK,
+                     "tenant_blocks": MT_TENANT_LEN // KV_BLOCK,
+                     "tenants": MT_TENANTS, "tails": list(MT_TAILS),
+                     "requests": n_requests},
+        "radix_hit_block_fraction": round(d["prefix_hits"] / looked_up, 4),
+        "whole_prefix_hit_fraction": round(
+            d["prefix_whole_hits"] / looked_up, 4),
+        "radix_ttft_p50_s": round(
+            percentile_exact(radix_ttfts, 0.50), 4),
+        "prefix_off_ttft_p50_s": round(
+            percentile_exact(off_ttfts, 0.50), 4),
+        "ttft_speedup_radix_vs_off": round(
+            percentile_exact(off_ttfts, 0.50)
+            / max(percentile_exact(radix_ttfts, 0.50), 1e-9), 3),
+    }
+
+
+def kv_offload_drill(model, params, seed):
+    """Pressure drill: a pool too small for the working set spills cold
+    blocks to host and restores them on re-reference — and the tokens
+    must be BITWISE what a roomy pool produces (offload payloads are raw
+    storage bytes, never requantized). Serial submissions force the
+    evict-then-restore sequence deterministically."""
+    from pipe_tpu.obs.telemetry import get_registry
+    reg = get_registry()
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(1, CFG.vocab, size=4 * KV_BLOCK).tolist()
+    fillers = [rng.randint(1, CFG.vocab, size=6 * KV_BLOCK).tolist()
+               for _ in range(2)]
+    prompts = [shared + [3, 5], fillers[0], shared + [7, 9],
+               fillers[1], shared + [11]]
+    gen_cfg = GenerationConfig(max_new_tokens=16, temperature=0.0)
+
+    def run(pool_blocks, offload):
+        backend = SingleDeviceSlotBackend(
+            model, params, num_slots=2, max_len=80, gen=gen_cfg,
+            kv_block_size=KV_BLOCK, kv_pool_blocks=pool_blocks,
+            prefill_chunk=16, kv_offload=offload)
+        eng = ServeEngine(backend)
+        toks = []
+        for p in prompts:
+            rid = eng.submit(p, seed=seed).id
+            eng.run_until_idle()
+            toks.append(np.asarray(eng.response(rid).tokens))
+        return toks
+
+    want = run(64, False)                 # roomy: nothing ever spills
+    keys = ("offload_out", "offload_restores", "offload_bytes",
+            "evictions")
+    c0 = {k: reg.counter(f"serve.kv.{k}").value for k in keys}
+    got = run(11, True)                   # tight: spill + restore
+    d = {k: reg.counter(f"serve.kv.{k}").value - c0[k] for k in keys}
+    bitwise = all(np.array_equal(a, b) for a, b in zip(got, want))
+    return {"pool_blocks": 11, "requests": len(prompts),
+            "blocks_offloaded": d["offload_out"],
+            "blocks_restored": d["offload_restores"],
+            "offload_bytes": d["offload_bytes"],
+            "evictions": d["evictions"],
+            "bitwise_equal_to_unpressured": bool(bitwise)}
 
 
 RES_HORIZON = 8
@@ -491,6 +628,25 @@ def main():
         f"{kv_paged_2x['tokens_s']:.1f} tok/s @ {2 * slots} slots on the "
         f"same memory (hit rate {ab['prefix_hit_rate']:.3f})")
 
+    # Gen-2 radix headline: multi-tenant reuse a whole-prefix cache
+    # can't see, and the TTFT the skipped prefill buys.
+    log("kv radix: multi-tenant workload vs whole-prefix "
+        "counterfactual...")
+    radix = multi_tenant_radix(model, params, slots, chunk,
+                               args.seed + 6,
+                               n_requests=12 if args.quick else 36)
+    log(f"  radix hit fraction {radix['radix_hit_block_fraction']:.3f} "
+        f"vs whole-prefix {radix['whole_prefix_hit_fraction']:.3f}; "
+        f"ttft p50 {radix['radix_ttft_p50_s']:.4f}s vs "
+        f"{radix['prefix_off_ttft_p50_s']:.4f}s cache-off "
+        f"({radix['ttft_speedup_radix_vs_off']:.2f}x)")
+
+    log("kv offload: evict-to-host + restore drill...")
+    offload = kv_offload_drill(model, params, args.seed + 7)
+    log(f"  spilled {offload['blocks_offloaded']} restored "
+        f"{offload['blocks_restored']} blocks, bitwise="
+        f"{offload['bitwise_equal_to_unpressured']}")
+
     # Resident loop A/B at equal live slots and equal token volume:
     # host-overhead-per-token is the number the fused loop exists to
     # shrink; tokens/s is the no-regression bar. Forced on explicitly —
@@ -533,6 +689,8 @@ def main():
         "steady_state_tokens_s": round(serve_tps, 1),
         "serve_vs_fixed_batch": round(ratio, 4),
         "kv_ab": kv_ab,
+        "kv_radix_multi_tenant": radix,
+        "kv_offload_drill": offload,
         "resident_ab": res_ab,
         "poisson_0p7": moderate,
     }
@@ -548,6 +706,15 @@ def main():
             "kv_paged_2x_vs_slab": kv_ab["paged_2x_vs_slab"],
             "kv_live_slot_gain": kv_ab["live_slot_gain_same_memory"],
             "kv_prefix_hit_rate": kv_ab["prefix_hit_rate"],
+            "kv_radix_hit_block_fraction":
+                radix["radix_hit_block_fraction"],
+            "kv_whole_prefix_hit_fraction":
+                radix["whole_prefix_hit_fraction"],
+            "kv_ttft_speedup_radix_vs_off":
+                radix["ttft_speedup_radix_vs_off"],
+            "kv_offload_bitwise":
+                offload["bitwise_equal_to_unpressured"],
+            "kv_offload_restores": offload["blocks_restored"],
             "resident_vs_nonresident_tokens_s":
                 res_ab["resident_vs_nonresident_tokens_s"],
             "host_overhead_reduction":
