@@ -512,6 +512,35 @@ def main():
             "resident serve loop slower than single-chunk ticks at "
             "equal live slots: "
             f"{serve_summary['resident_vs_nonresident_tokens_s']}x")
+        # Gen-2 speculative lane: every draft source must stay bitwise
+        # the Generator; the truncated-pipeline draft must clear the
+        # n-gram baseline decisively on aperiodic prompts (the reason
+        # model-based drafts exist); and whenever measured acceptance
+        # clears the breakeven the planner computes from this host's
+        # OWN measured chunk-cost ratio, spec must not lose tokens/s to
+        # the non-spec resident loop at equal live slots.
+        assert serve_summary["spec_bitwise"], (
+            "a speculative draft source changed tokens vs the "
+            "Generator")
+        assert (serve_summary["spec_acceptance_truncated"] >= 0.3
+                and serve_summary["spec_acceptance_truncated"]
+                > serve_summary["spec_acceptance_ngram"]), (
+            "truncated-pipeline draft acceptance "
+            f"{serve_summary['spec_acceptance_truncated']} did not "
+            "clear the n-gram baseline "
+            f"{serve_summary['spec_acceptance_ngram']}")
+        if (serve_summary["spec_acceptance_truncated"]
+                > serve_summary["spec_breakeven_acceptance"]):
+            assert serve_summary["spec_vs_nonspec_tokens_s"] >= 1.0, (
+                "acceptance cleared the measured breakeven "
+                f"({serve_summary['spec_acceptance_truncated']} > "
+                f"{serve_summary['spec_breakeven_acceptance']}) but "
+                "spec decode lost to the non-spec loop: "
+                f"{serve_summary['spec_vs_nonspec_tokens_s']}x")
+        assert serve_summary["spec_steady_new_traces"] == 0, (
+            "the spec resident program retraced inside the measured "
+            f"window ({serve_summary['spec_steady_new_traces']} new "
+            "traces) — steady state must not recompile")
 
     # Chaos probe: one injected fault per layer (train NaN, transport
     # drop, serve backend raise, data raise) through the recovery

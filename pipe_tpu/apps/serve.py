@@ -47,6 +47,8 @@ Usage:
         [--slo-goodput-min F] [--slo-deadline-miss-max F]
         [--slo-shed-max F]
         [--resident auto|on|off] [--resident-chunks R] [--spec-tokens K]
+        [--draft ngram|truncated|tree] [--draft-stages N]
+        [--spec-branches B] [--spec-adaptive]
         [--cpu N]
 """
 
@@ -162,7 +164,24 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--spec-tokens", type=int, default=None,
                    help="speculative decode: K-token draft/verify per "
                         "resident round (needs --resident on/auto-on; "
-                        "single-device backend only)")
+                        "works on both backends)")
+    p.add_argument("--draft", choices=["ngram", "truncated", "tree"],
+                   default="ngram",
+                   help="draft source for --spec-tokens: prompt-history "
+                        "n-gram lookup (free), truncated-pipeline "
+                        "(first --draft-stages stages + tied embedding "
+                        "head), or multi-branch tree (single-device "
+                        "backend only)")
+    p.add_argument("--draft-stages", type=int, default=1,
+                   help="stage depth of the truncated/tree draft — a "
+                        "STRICT prefix of the model (with --stages 1 "
+                        "the model is partitioned into draft-stages+1 "
+                        "logical stages to carve one)")
+    p.add_argument("--spec-branches", type=int, default=None,
+                   help="tree draft: parallel branches per round (>= 2)")
+    p.add_argument("--spec-adaptive", action="store_true",
+                   help="per-slot acceptance-EWMA adaptive K over a "
+                        "pre-traced ladder (single-device backend only)")
     p.add_argument("--events", default=None,
                    help="write the request-span EventLog here (.jsonl)")
     p.add_argument("--metrics-port", type=int, default=None,
@@ -249,8 +268,20 @@ def main(argv=None) -> int:
     if args.tiny:
         model_cfg = model_cfg.tiny()
     n_stages = max(args.stages, 1)
-    if model_cfg.n_layers % n_stages:
-        print(f"--stages {n_stages} must divide the model's "
+    # Pipeline-prefix drafts run "the first stage(s)", so the model must
+    # be partitioned with a strict prefix to carve. The ring already is;
+    # --stages 1 serves an unpartitioned model, so split it into
+    # draft-stages+1 logical stages (same weights, nested differently —
+    # the single-device backend flattens the stage list anyway).
+    n_model_stages = n_stages
+    if args.draft != "ngram" and n_stages == 1:
+        n_model_stages = max(args.draft_stages, 1) + 1
+    if model_cfg.n_layers % n_model_stages:
+        what = (f"--stages {n_stages}" if n_model_stages == n_stages
+                else f"--draft {args.draft} with --stages 1 partitions "
+                     f"the model into --draft-stages + 1 = "
+                     f"{n_model_stages} logical stages, which")
+        print(f"{what} must divide the model's "
               f"{model_cfg.n_layers} layers", file=sys.stderr)
         return 2
     replicas = max(args.replicas, 1)
@@ -284,10 +315,10 @@ def main(argv=None) -> int:
         prompts = [rng.randint(1, model_cfg.vocab, size=int(n)).tolist()
                    for n in lens]
 
-    model = _Model(model_cfg, n_stages)
+    model = _Model(model_cfg, n_model_stages)
     try:
-        params = load_params(args.resume, model_cfg, _Model, n_stages,
-                             args.seed)
+        params = load_params(args.resume, model_cfg, _Model,
+                             n_model_stages, args.seed)
     except DriverError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -304,9 +335,12 @@ def main(argv=None) -> int:
     from ..serve import BucketSpec, QueueFull, RequestQueue, ServeEngine
     buckets = BucketSpec.pow2(min_len=8,
                               max_len=max(len(p) for p in prompts))
-    # spec lane: K-1 rows of verify-write slack on top of the request cap
+    # spec lane: verify-write slack on top of the request cap — the
+    # chunk writes branches x (K-1) rows past the accepted frontier
+    # (tree chunks carry every branch; linear drafts have one)
     max_len = buckets.max_len + args.max_new + (
-        args.spec_tokens - 1 if args.spec_tokens else 0)
+        (args.spec_branches or 1) * (args.spec_tokens - 1)
+        if args.spec_tokens else 0)
     if (args.kv_offload or args.kv_hot_refs is not None
             or args.placement == "prefix") and args.kv != "paged":
         print("--kv-offload/--kv-hot-refs/--placement prefix need "
@@ -319,28 +353,36 @@ def main(argv=None) -> int:
         "kv_offload": args.kv_offload,
         "kv_offload_blocks": args.kv_offload_blocks}
     resident = {"auto": "auto", "on": True, "off": False}[args.resident]
-    if args.spec_tokens is not None and n_stages > 1:
-        print("--spec-tokens requires --stages 1 (the ring's sampled "
-              "key chain is not the Generator chain the speculative "
-              "lane replays)", file=sys.stderr)
+    spec_kwargs = dict(spec_tokens=args.spec_tokens, draft=args.draft,
+                       draft_stages=args.draft_stages,
+                       spec_branches=args.spec_branches,
+                       spec_adaptive=args.spec_adaptive)
+    # invalid spec combos (tree on the ring, draft flags without
+    # --spec-tokens, out-of-range draft depth, ...) are rejected by the
+    # backend/drafter ctors — surface the message, don't trace back
+    try:
+        if n_stages > 1:
+            from ..parallel.mesh import make_mesh
+            from ..parallel.spmd import stack_stage_params
+            from ..serve import RingSlotBackend
+            sp, pre, post = params
+            backend = RingSlotBackend(
+                make_mesh(n_stages, 1), model, stack_stage_params(sp),
+                pre, post, max_len=max_len, gen=gen_cfg, buckets=buckets,
+                revolutions=args.decode_chunk, resident=resident,
+                resident_revolutions=args.resident_chunks,
+                **spec_kwargs, **kv_kwargs)
+        else:
+            from ..serve import SingleDeviceSlotBackend
+            backend = SingleDeviceSlotBackend(
+                model, params, num_slots=args.slots, max_len=max_len,
+                gen=gen_cfg, buckets=buckets,
+                decode_chunk=args.decode_chunk, resident=resident,
+                resident_chunks=args.resident_chunks,
+                **spec_kwargs, **kv_kwargs)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
         return 2
-    if n_stages > 1:
-        from ..parallel.mesh import make_mesh
-        from ..parallel.spmd import stack_stage_params
-        from ..serve import RingSlotBackend
-        sp, pre, post = params
-        backend = RingSlotBackend(
-            make_mesh(n_stages, 1), model, stack_stage_params(sp), pre,
-            post, max_len=max_len, gen=gen_cfg, buckets=buckets,
-            revolutions=args.decode_chunk, resident=resident,
-            resident_revolutions=args.resident_chunks, **kv_kwargs)
-    else:
-        from ..serve import SingleDeviceSlotBackend
-        backend = SingleDeviceSlotBackend(
-            model, params, num_slots=args.slots, max_len=max_len,
-            gen=gen_cfg, buckets=buckets, decode_chunk=args.decode_chunk,
-            resident=resident, resident_chunks=args.resident_chunks,
-            spec_tokens=args.spec_tokens, **kv_kwargs)
 
     trace_buf = None
     if args.events:
@@ -412,7 +454,7 @@ def main(argv=None) -> int:
                 gen=gen_cfg, buckets=buckets,
                 decode_chunk=args.decode_chunk, resident=resident,
                 resident_chunks=args.resident_chunks,
-                spec_tokens=args.spec_tokens, **kv_kwargs)
+                **spec_kwargs, **kv_kwargs)
             for _ in range(replicas - 1)]
         engines = [ServeEngine(b,
                                RequestQueue(capacity=args.queue_capacity),

@@ -64,7 +64,8 @@ from .schedule import (BWD, FWD, WGRAD, Schedule, compile_phases,
 
 __all__ = ["CostProfile", "Plan", "CalibrationError", "MAX_REL_RESIDUAL",
            "profile_model", "profile_from_calibration", "uniform_profile",
-           "predict_wall", "search", "auto_plan"]
+           "predict_wall", "search", "auto_plan", "spec_speedup",
+           "spec_breakeven_acceptance"]
 
 # Refuse to rank on a calibration whose relative fit residual exceeds
 # this: a quarter of the signal unexplained means the linear cost model
@@ -578,3 +579,63 @@ def auto_plan(module, params, sample, *, n_devices: int,
             "the planner found no feasible plan: every candidate failed "
             "table verification, phase compilation, or the memory cap")
     return plans[0]
+
+
+# ---------------------------------------------------------------------------
+# speculative-decode cost model: acceptance x draft cost as a plan input
+# ---------------------------------------------------------------------------
+#
+# The serving profile's analog of predict_wall: should a deployment turn
+# the spec lane on, and at which draft? Inputs are the two numbers the
+# obs plane ships per deployment — ``serve.spec.acceptance_rate`` (the
+# measured per-drafted-position acceptance) and
+# ``serve.spec.draft_cost_frac`` (the drafter's work-unit share of a
+# round, ``inference/draft.py:DraftSource.draft_cost_frac``) — plus one
+# machine fact: how much a K-row teacher-forced verify chunk costs
+# relative to a 1-row decode step (``chunk_cost_ratio``, ~1 on
+# overhead/memory-bound decode, -> K on pure-FLOP-bound decode; the
+# serve bench measures it as spec-off s_per_tok vs the chunk wall).
+
+
+def spec_speedup(acceptance: float, draft_cost_frac: float, K: int,
+                 chunk_cost_ratio: float = 1.0) -> float:
+    """Predicted spec-on tokens/s over spec-off tokens/s.
+
+    Per round the lane emits ``1 + acceptance*(K-1)`` tokens (the
+    accepted draft prefix plus the correction) and pays
+    ``chunk_cost_ratio`` single-step walls of verify plus the draft
+    overhead — ``draft_cost_frac = d/(d+v)`` gives the draft/verify
+    wall ratio ``f/(1-f)``, so a round costs ``chunk_cost_ratio/(1-f)``
+    single steps. The spec-off baseline is 1 token per single step."""
+    if K < 2:
+        raise ValueError(f"spec needs K >= 2, got {K}")
+    if not 0.0 <= acceptance <= 1.0:
+        raise ValueError(f"acceptance must be in [0, 1], got {acceptance}")
+    if not 0.0 <= draft_cost_frac < 1.0:
+        raise ValueError(
+            f"draft_cost_frac must be in [0, 1), got {draft_cost_frac}")
+    if chunk_cost_ratio <= 0.0:
+        raise ValueError(
+            f"chunk_cost_ratio must be > 0, got {chunk_cost_ratio}")
+    emitted = 1.0 + acceptance * (K - 1)
+    round_cost = chunk_cost_ratio / (1.0 - draft_cost_frac)
+    return emitted / round_cost
+
+
+def spec_breakeven_acceptance(draft_cost_frac: float, K: int,
+                              chunk_cost_ratio: float = 1.0) -> float:
+    """The acceptance rate at which :func:`spec_speedup` crosses 1.0 —
+    below it the lane is a slowdown and the plan should keep spec off.
+    Returns a value clipped to [0, 1]; 1.0 means the draft can never
+    pay for itself at this K (e.g. FLOP-bound verify with an expensive
+    draft), 0.0 means any acceptance wins (free draft, free chunk)."""
+    if K < 2:
+        raise ValueError(f"spec needs K >= 2, got {K}")
+    if not 0.0 <= draft_cost_frac < 1.0:
+        raise ValueError(
+            f"draft_cost_frac must be in [0, 1), got {draft_cost_frac}")
+    if chunk_cost_ratio <= 0.0:
+        raise ValueError(
+            f"chunk_cost_ratio must be > 0, got {chunk_cost_ratio}")
+    a = (chunk_cost_ratio / (1.0 - draft_cost_frac) - 1.0) / (K - 1)
+    return float(min(max(a, 0.0), 1.0))

@@ -113,23 +113,33 @@ class GenerationConfig:
                 "speculative form (num_beams must be 1)")
 
     def check_kv_headroom(self, bucket_max_len: int,
-                          block_size: Optional[int] = None) -> None:
+                          block_size: Optional[int] = None,
+                          spec_overshoot: int = 0) -> None:
         """Paged serving with length buckets: reject a block size that
         does not divide the per-slot KV span ``bucket_max_len +
-        max_new_tokens`` cleanly — the last block would round up and
-        silently waste its tail rows on EVERY slot. Called by the slot
+        max_new_tokens (+ speculative headroom)`` cleanly — the last
+        block would round up and silently waste its tail rows on EVERY
+        slot. With ``spec_tokens=K`` the verify chunk writes past the
+        last emitted row, so the slot really holds ``max_new_tokens +
+        spec_overshoot`` generated rows (the same headroom
+        ``validate()`` charges) — the stranded-row check must use the
+        spec-padded span, not the nominal one. Called by the slot
         backends at construction (the span is only known once buckets
         are chosen, so the check cannot live in ``__post_init__``)."""
         bs = block_size if block_size is not None else self.kv_block_size
         if bs is None:
             return
-        span = int(bucket_max_len) + self.max_new_tokens
+        span = int(bucket_max_len) + self.max_new_tokens + spec_overshoot
         waste = -span % bs
         if waste:
+            spec = (f" + speculative headroom {spec_overshoot}"
+                    if spec_overshoot else "")
             raise ValueError(
                 f"kv_block_size={bs} does not divide the KV headroom "
-                f"bucket_max_len + max_new_tokens = {bucket_max_len} + "
-                f"{self.max_new_tokens} = {span}: every slot's last "
+                f"bucket_max_len + max_new_tokens{spec} = "
+                f"{bucket_max_len} + {self.max_new_tokens}"
+                f"{' + ' + str(spec_overshoot) if spec_overshoot else ''}"
+                f" = {span}: every slot's last "
                 f"block would waste {waste} of {bs} rows "
                 f"({waste / bs:.0%} of a block) as unwritable padding; "
                 f"pick a block size dividing {span} or adjust "

@@ -135,6 +135,16 @@ class PipelinedLM(PipelinedTransformer):
             self.posenc.pe, pos, tokens.shape[-1], axis=0)
         return (h + pe).astype(self.cfg.compute_dtype)
 
+    def embed_tree(self, pre_params, tokens, pos, depths):
+        """Embed draft-TREE chunk rows: row r of ``tokens [b, Q]`` is a
+        tree node at logical position ``pos + depths[r]`` (the root sits
+        at ``pos``; same-depth nodes on different branches share a
+        position). :meth:`embed_at` with a per-row position gather
+        instead of a contiguous slice."""
+        h = self.embed.apply(pre_params["embed"], tokens)
+        pe = jnp.take(self.posenc.pe, pos + depths, axis=0)
+        return (h + pe).astype(self.cfg.compute_dtype)
+
     def max_position(self) -> int:
         """Positional capacity (sinusoid table rows) — inference guard."""
         return int(self.posenc.pe.shape[0])

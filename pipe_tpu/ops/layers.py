@@ -318,7 +318,7 @@ class MultiHeadAttention(Module):
         dt = dtype if dtype is not None else self.dtype
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
-    def decode(self, params, x, cache, pos):
+    def decode(self, params, x, cache, pos, tree=None):
         """Incremental self-attention with a KV cache (inference only).
 
         ``x``: the new tokens' hidden states ``[b, q, d]`` occupying
@@ -328,6 +328,13 @@ class MultiHeadAttention(Module):
         cache positions ``<= its own`` — exactly :meth:`apply`'s causal
         mask restricted to the live prefix, so teacher-forced cached logits
         match the full forward. Returns ``(out [b, q, d], new_cache)``.
+
+        ``tree`` (optional ``[q, q]`` bool, static): speculative tree
+        verification. The q chunk rows are draft-TREE nodes, not a
+        contiguous run — K/V still land at cache rows ``[pos, pos+q)``,
+        but query row j attends cache rows strictly before ``pos`` plus
+        the within-chunk rows where ``tree[j, r]`` (its ancestors-or-
+        self). ``tree=None`` keeps the linear causal mask unchanged.
         """
         if not self.causal:
             raise ValueError("KV-cache decode requires causal attention")
@@ -348,8 +355,17 @@ class MultiHeadAttention(Module):
         logits = jnp.einsum("bqhd,bkhd->bhqk", qh, ck).astype(jnp.float32)
         logits = logits / math.sqrt(hd)
         kpos = jnp.arange(ck.shape[1])[None, None, None, :]
-        qpos = pos + jnp.arange(q)[None, None, :, None]
-        logits = jnp.where(kpos <= qpos, logits,
+        if tree is None:
+            qpos = pos + jnp.arange(q)[None, None, :, None]
+            allowed = kpos <= qpos
+        else:
+            rel = jnp.arange(ck.shape[1]) - pos            # [K_cache]
+            in_chunk = (rel >= 0) & (rel < q)
+            within = jnp.asarray(tree)[
+                :, jnp.clip(rel, 0, q - 1)]                # [q, K_cache]
+            allowed = ((rel < 0) | (in_chunk & within))[
+                None, None, :, :]
+        logits = jnp.where(allowed, logits,
                            jnp.asarray(-1e30, logits.dtype))
         weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
         o = jnp.einsum("bhqk,bkhd->bqhd", weights, cv).reshape(
@@ -441,10 +457,11 @@ class TransformerEncoderLayer(_TransformerBlockBase):
         h = self.drop.apply({}, h, ctx=ctx.fold(3))
         return self.ln2.apply(params["ln2"], x + h, ctx=ctx)
 
-    def decode(self, params, x, cache, pos):
+    def decode(self, params, x, cache, pos, tree=None):
         """Incremental :meth:`apply` (inference: no dropout) — same math on
         the new positions with attention served from the KV cache."""
-        a, cache = self.attn.decode(params["attn"], x, cache, pos)
+        a, cache = self.attn.decode(params["attn"], x, cache, pos,
+                                    tree=tree)
         x = self.ln1.apply(params["ln1"], x + a)
         h = self.act(self.ff1.apply(params["ff1"], x))
         h = self.ff2.apply(params["ff2"], h)
@@ -473,12 +490,12 @@ class PreLNBlock(_TransformerBlockBase):
         h = self.ff2.apply(params["ff2"], h, ctx=ctx)
         return x + self.drop.apply({}, h, ctx=ctx.fold(2))
 
-    def decode(self, params, x, cache, pos):
+    def decode(self, params, x, cache, pos, tree=None):
         """Incremental :meth:`apply` (inference: no dropout) — same math on
         the new positions with attention served from the KV cache."""
         a, cache = self.attn.decode(params["attn"],
                                     self.ln1.apply(params["ln1"], x),
-                                    cache, pos)
+                                    cache, pos, tree=tree)
         x = x + a
         h = self.act(self.ff1.apply(params["ff1"],
                                     self.ln2.apply(params["ln2"], x)))

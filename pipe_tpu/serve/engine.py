@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..inference.draft import DraftSource, resolve_draft, tree_layout
 from ..inference.generate import (GenerationConfig, head_logits,
                                   sample_logits)
 from ..inference.quant import QuantLeaf, dequant_tree
@@ -102,7 +103,10 @@ class SingleDeviceSlotBackend:
                  kv_offload: bool = False,
                  kv_offload_blocks: Optional[int] = None,
                  resident="auto", resident_chunks: int = 8,
-                 spec_tokens: Optional[int] = None):
+                 spec_tokens: Optional[int] = None,
+                 draft="ngram", draft_stages: int = 1,
+                 spec_branches: Optional[int] = None,
+                 spec_adaptive: bool = False):
         if not hasattr(model, "embed_at"):
             raise TypeError(
                 f"{type(model).__name__} has no embed_at; KV-cache "
@@ -148,13 +152,9 @@ class SingleDeviceSlotBackend:
                 "round IS the resident chunk body); pass resident=True")
         self.spec_tokens = spec
         # tokens per resident iteration: the readout stride of the token
-        # buffer the resident program returns
+        # buffer the resident program returns. Spec mode re-sets this
+        # per launch to the adaptive ladder rung that ran.
         self.decode_width = spec if spec is not None else decode_chunk
-        # spec verify writes K rows per round starting at most at
-        # pos = plen + max_new - 2; headroom keeps the K-row
-        # dynamic_update_slice inside the slab/view so its start is
-        # never clamped (a clamped start misaligns EVERY row written)
-        self._spec_overshoot = (spec - 1) if spec is not None else 0
 
         stage_params, pre_params, post_params = params
         cd = model.cfg.compute_dtype
@@ -165,10 +165,49 @@ class SingleDeviceSlotBackend:
                       bp, is_leaf=lambda x: isinstance(x, QuantLeaf))
                   for bp in flat]
         self._n_layers = len(blocks)
+        self._n_stages = len(stage_params)
+        self._layers_per_stage = len(stage_params[0])
         self._block_stack = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *blocks)
         self._pre = pre_params
         self._post = post_params
+
+        if spec is not None:
+            self._drafter = draft if isinstance(draft, DraftSource) \
+                else resolve_draft(
+                    draft, n_stages=self._n_stages,
+                    layers_per_stage=self._layers_per_stage,
+                    draft_stages=draft_stages,
+                    spec_branches=spec_branches)
+            if self._drafter.branches > 1 and \
+                    not hasattr(model, "embed_tree"):
+                raise TypeError(
+                    f"{type(model).__name__} has no embed_tree; tree "
+                    "verification needs per-node position embedding")
+            # spec verify writes Q = 1 + branches*(K-1) rows per round
+            # starting at most at pos = plen + max_new - 2; headroom
+            # keeps the Q-row dynamic_update_slice inside the slab/view
+            # so its start is never clamped (a clamped start misaligns
+            # EVERY row written)
+            self._spec_overshoot = self._drafter.branches * (spec - 1)
+            # adaptive-K: a small pre-traced ladder of round depths; the
+            # host picks a rung per launch from the per-slot accepted-
+            # length EWMA. Non-adaptive = one rung = PR 11 behavior.
+            self._spec_ladder = (
+                sorted({2, (spec + 2) // 2, spec}) if spec_adaptive
+                else [spec])
+            self._spec_ewma = np.full((num_slots,), float(spec))
+            self._spec_acc_total = 0
+            self._spec_draft_total = 0
+        else:
+            if not (draft == "ngram" and draft_stages == 1
+                    and spec_branches is None and not spec_adaptive):
+                raise ValueError(
+                    "draft/draft_stages/spec_branches/spec_adaptive "
+                    "configure the speculative lane; set spec_tokens")
+            self._drafter = None
+            self._spec_overshoot = 0
+            self._spec_ladder = []
 
         kbs = kv_block_size if kv_block_size is not None \
             else gen.kv_block_size
@@ -187,7 +226,8 @@ class SingleDeviceSlotBackend:
             nb = kv_pool_blocks if kv_pool_blocks is not None \
                 else num_slots * mb + 1
             if buckets is not None:
-                gen.check_kv_headroom(buckets.max_len, kbs)
+                gen.check_kv_headroom(buckets.max_len, kbs,
+                                      self._spec_overshoot)
             self.pool = KvPool(
                 num_blocks=nb, block_size=kbs, num_slots=num_slots,
                 max_len=max_len, prefix_cache=gen.prefix_cache,
@@ -258,16 +298,27 @@ class SingleDeviceSlotBackend:
                     self._resident_jit = jax.jit(
                         self._resident_paged_fn, donate_argnums=(3, 8))
                 else:
-                    self._resident_jit = jax.jit(
-                        self._resident_spec_paged_fn,
-                        donate_argnums=(3, 8, 10))
+                    # one jit per ladder rung: K is closure-bound so the
+                    # donated positions line up with the un-curried
+                    # signature; every rung traces once, then the steady
+                    # state is rung selection over compiled programs
+                    self._resident_spec_jits = {
+                        k: jax.jit(
+                            (lambda *a, _k=k:
+                             self._resident_spec_paged_fn(_k, *a)),
+                            donate_argnums=(3, 8, 10))
+                        for k in self._spec_ladder}
             else:
                 if self.spec_tokens is None:
                     self._resident_jit = jax.jit(
                         self._resident_fn, donate_argnums=(3,))
                 else:
-                    self._resident_jit = jax.jit(
-                        self._resident_spec_fn, donate_argnums=(3, 7))
+                    self._resident_spec_jits = {
+                        k: jax.jit(
+                            (lambda *a, _k=k:
+                             self._resident_spec_fn(_k, *a)),
+                            donate_argnums=(3, 7))
+                        for k in self._spec_ladder}
             if self.spec_tokens is not None:
                 # device-side token history, the n-gram draft source:
                 # hist[s, p] = the token EMBEDDED at position p of slot
@@ -782,33 +833,50 @@ class SingleDeviceSlotBackend:
     # consumes exactly n_emit splits, so accepted tokens are bitwise
     # the sequential Generator chain.
 
-    def _spec_round(self, block_stack, pre, post, carry, paged):
+    def _spec_round(self, K, block_stack, pre, post, carry, paged):
         """One draft/verify round (shared by the slab/paged spec
-        bodies). Carry: (caches-or-views, tok, pos, key_data, hist,
-        done, budget); returns the updated carry plus the round's
-        ``[S, K]`` token row and ``[S]`` accepted counts."""
+        bodies) at ladder depth ``K``. Carry: (caches-or-views, tok,
+        pos, key_data, hist, done, budget); returns the updated carry
+        plus the round's ``[S, K]`` token row and ``[S]`` accepted
+        counts.
+
+        With a multi-branch drafter the verify chunk is the flattened
+        draft tree — ``Q = 1 + B*(K-1)`` rows under the causal tree
+        mask (:func:`~..inference.draft.tree_layout`), same-depth nodes
+        sharing one sample key so whichever branch lies on the true
+        sequential path replays the exact Generator chain. The longest
+        matching root-to-leaf path wins; its KV rows are relocated to
+        the canonical positions before the round returns, so the next
+        round's chunk reads them like any linear prefix."""
         m, gen = self.model, self.gen
         cd = m.cfg.compute_dtype
         eos = gen.eos_token_id
-        K = self.spec_tokens
         caches, tok, pos, key_data, hist, done, budget = carry
-        H = hist.shape[1]
-        idx = jnp.arange(H, dtype=jnp.int32)
+        S = tok.shape[0]
+        B = self._drafter.branches
+        Q = 1 + B * (K - 1) if B > 1 else K
         ar = jnp.arange(K, dtype=jnp.int32)
 
-        # 1) draft: tokens after the latest earlier occurrence of tok
-        def draft_one(hrow, t, p):
-            mask = (hrow == t) & (idx < p)
-            j = jnp.max(jnp.where(mask, idx, jnp.int32(-1)))
-            start = jnp.maximum(j + 1, 0)
-            return jax.lax.dynamic_slice(hrow, (start,), (K - 1,))
+        # 1) draft: [S, B, K-1] candidate continuations of tok
+        drafts, caches = self._drafter.propose(
+            m, gen, pre, block_stack, caches, tok, pos, hist, K, paged)
 
-        drafts = jax.vmap(draft_one)(hist, tok, pos)       # [S, K-1]
-        x = jnp.concatenate([tok[:, None], drafts], axis=1)  # [S, K]
-
-        # 2) verify: one q=K teacher-forced decode at offset pos
-        h = jax.vmap(
-            lambda xs, p: m.embed_at(pre, xs[None], p)[0])(x, pos)
+        # 2) verify: ONE fixed-shape q=Q teacher-forced decode. Linear
+        # (B=1) keeps the PR 11 chunk byte-for-byte; tree embeds each
+        # node at pos+depth and masks to ancestors-or-self.
+        x = jnp.concatenate(
+            [tok[:, None], drafts.reshape(S, B * (K - 1))], axis=1)
+        if B == 1:
+            anc = None
+            h = jax.vmap(
+                lambda xs, p: m.embed_at(pre, xs[None], p)[0])(x, pos)
+        else:
+            depths_np, anc_np = tree_layout(K, B)
+            depths = jnp.asarray(depths_np)
+            anc = jnp.asarray(anc_np)
+            h = jax.vmap(
+                lambda xs, p: m.embed_tree(pre, xs[None], p, depths)[0])(
+                    x, pos)
 
         def layer(h, inp):
             bp, cache = inp
@@ -818,24 +886,28 @@ class SingleDeviceSlotBackend:
                 def one(hh, cache_l, pp):
                     cache = {name: cache_l[name][None]
                              for name in ("k", "v")}
-                    out, c2 = m.block.decode(bpd, hh[None], cache, pp)
+                    out, c2 = m.block.decode(bpd, hh[None], cache, pp,
+                                             tree=anc)
                     return out[0], {name: c2[name][0]
                                     for name in ("k", "v")}
             else:
                 def one(hh, cc, pp):
                     out, cc2 = m.block.decode(
                         bpd, hh[None],
-                        jax.tree_util.tree_map(lambda a: a[None], cc), pp)
+                        jax.tree_util.tree_map(lambda a: a[None], cc),
+                        pp, tree=anc)
                     return out[0], jax.tree_util.tree_map(
                         lambda a: a[0], cc2)
 
             return jax.vmap(one)(h, cache, pos)
 
         h, caches = jax.lax.scan(layer, h, (block_stack, caches))
-        logits = head_logits(m, post, h)                   # [S, K, V]
+        logits = head_logits(m, post, h)                   # [S, Q, V]
 
         # 3) the sequential key chain, unrolled K deep: carries[i] is
-        # the slot key AFTER i+1 splits, subs[i] the i-th sample key
+        # the slot key AFTER i+1 splits, subs[i] the i-th sample key.
+        # Tree nodes index subs by DEPTH: the sample at depth d is the
+        # d-th sequential draw whichever branch it sits on.
         def chain(kd0):
             def sp(c, _):
                 k2, sub = jax.random.split(jax.random.wrap_key_data(c))
@@ -845,23 +917,63 @@ class SingleDeviceSlotBackend:
             return carries, subs
 
         carries, subs = jax.vmap(chain)(key_data)
+        node_subs = subs if B == 1 else subs[:, depths_np]
         t = jax.vmap(jax.vmap(
             lambda lg, sd: sample_logits(
                 lg[None], jax.random.wrap_key_data(sd), gen)[0]))(
-                    logits, subs)                          # [S, K]
+                    logits, node_subs)                     # [S, Q]
 
-        # 4) accept the leading matched prefix + 1 correction token
-        match = (drafts == t[:, :K - 1])
-        lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
-        n_emit = jnp.int32(1) + jnp.sum(lead, axis=1)
+        # 4) accept the longest matching root-to-leaf path + 1
+        # correction token. Any branch whose first L levels match
+        # carries exactly the sequential chain's tokens, so ties agree
+        # on every emitted token and argmax's first-max pick is safe.
+        if B == 1:
+            t_lin = t
+            match = (drafts[:, 0, :] == t[:, :K - 1])
+            lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
+            n_emit = jnp.int32(1) + jnp.sum(lead, axis=1)
+        else:
+            tb = t[:, 1:].reshape(S, B, K - 1)
+            prev = jnp.concatenate(
+                [jnp.broadcast_to(t[:, :1, None], (S, B, 1)),
+                 tb[:, :, :-1]], axis=2)
+            lead_b = jnp.cumprod(
+                (drafts == prev).astype(jnp.int32), axis=2)
+            len_b = jnp.sum(lead_b, axis=2)                # [S, B]
+            bsel = jnp.argmax(len_b, axis=1).astype(jnp.int32)
+            n_emit = jnp.int32(1) + jnp.take_along_axis(
+                len_b, bsel[:, None], axis=1)[:, 0]
+            t_lin = jnp.concatenate(
+                [t[:, :1],
+                 jnp.take_along_axis(
+                     tb, bsel[:, None, None], axis=1)[:, 0]], axis=1)
         n_emit = jnp.where(done, jnp.int32(0), n_emit)
         emit_mask = ar[None, :] < n_emit[:, None]
-        toks_out = jnp.where(emit_mask, t,
+        toks_out = jnp.where(emit_mask, t_lin,
                              jnp.int32(gen.pad_token_id))
+
+        if B > 1:
+            # relocate the winning branch's K-1 chunk rows to the
+            # canonical rows [pos+1, pos+K): rows at or beyond the
+            # advanced pos' are junk-allowed (causally masked, and the
+            # next round's Q-row write covers them), so the whole
+            # branch copies unconditionally.
+            arr = jnp.arange(K - 1, dtype=jnp.int32)
+
+            def rl(a):          # [L, S, rows, ...] (slab slab-rows or
+                def ps(al, p, sb):              # paged view-rows alike)
+                    src = p + 1 + sb * (K - 1) + arr
+                    rows = jnp.take(al, src, axis=1)
+                    return jax.lax.dynamic_update_slice(
+                        al, rows, (0, p + 1) + (0,) * (al.ndim - 2))
+                return jax.vmap(ps, in_axes=(1, 0, 0),
+                                out_axes=1)(a, pos, bsel)
+
+            caches = jax.tree_util.tree_map(rl, caches)
 
         # 5) advance — done slots frozen (pos/key/hist/budget untouched)
         last = jnp.take_along_axis(
-            t, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+            t_lin, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
         tok = jnp.where(done, tok, last)
 
         def hupd(hrow, p, trow, n):
@@ -869,7 +981,7 @@ class SingleDeviceSlotBackend:
             upd = jnp.where(ar < n, trow, cur)
             return jax.lax.dynamic_update_slice(hrow, upd, (p + 1,))
 
-        hist = jax.vmap(hupd)(hist, pos, t, n_emit)
+        hist = jax.vmap(hupd)(hist, pos, t_lin, n_emit)
         sel = jnp.concatenate([key_data[:, None], carries], axis=1)
         key_data = jax.vmap(lambda s, n: s[n])(sel, n_emit)
         pos = pos + n_emit
@@ -877,16 +989,15 @@ class SingleDeviceSlotBackend:
         done = done | (budget <= 0)
         if eos is not None:
             done = done | jnp.any(
-                (t == jnp.int32(eos)) & emit_mask, axis=1)
+                (t_lin == jnp.int32(eos)) & emit_mask, axis=1)
         return (caches, tok, pos, key_data, hist, done, budget,
                 toks_out, n_emit)
 
-    def _resident_spec_fn(self, block_stack, pre, post, caches, tok,
+    def _resident_spec_fn(self, K, block_stack, pre, post, caches, tok,
                           pos, key_data, hist, live, budget, r_max):
         """Slab resident loop with the speculative lane: each iteration
         is one draft/verify round emitting 1..K tokens per live slot."""
         get_registry().counter("serve.engine.resident_traces").inc()
-        K = self.spec_tokens
         R = self.resident_chunks
         S = tok.shape[0]
 
@@ -895,7 +1006,7 @@ class SingleDeviceSlotBackend:
                 buf, nacc, k = state
             (caches, tok, pos, key_data, hist, done, budget, toks,
              n_emit) = self._spec_round(
-                block_stack, pre, post,
+                K, block_stack, pre, post,
                 (caches, tok, pos, key_data, hist, done, budget), False)
             buf = jax.lax.dynamic_update_slice(buf, toks, (0, k * K))
             nacc = jax.lax.dynamic_update_slice(
@@ -916,18 +1027,21 @@ class SingleDeviceSlotBackend:
             jax.lax.while_loop(cond, body, state)
         return caches, tok, pos, key_data, hist, buf, nacc, k
 
-    def _resident_spec_paged_fn(self, block_stack, pre, post, pool_kv,
-                                tables, tok, pos, key_data, views,
-                                regather, hist, live, budget, r_max):
+    def _resident_spec_paged_fn(self, K, block_stack, pre, post,
+                                pool_kv, tables, tok, pos, key_data,
+                                views, regather, hist, live, budget,
+                                r_max):
         """Paged resident loop with the speculative lane: the verify
-        runs against the carried views, each round's K rows scatter
-        back through the full-width tables (rejected/dead rows route
-        to the sacrificial block exactly like dead-slot decode)."""
+        runs against the carried views, each round's Q chunk rows
+        scatter back through the full-width tables (rejected/dead rows
+        route to the sacrificial block exactly like dead-slot
+        decode)."""
         m = self.model
         cd = m.cfg.compute_dtype
         get_registry().counter("serve.engine.resident_traces").inc()
         bs = self.pool.block_size
-        K = self.spec_tokens
+        B = self._drafter.branches
+        Q = 1 + B * (K - 1) if B > 1 else K
         R = self.resident_chunks
         S = tok.shape[0]
         view_t = tables[:, :self.pool.max_blocks + 1]
@@ -947,18 +1061,18 @@ class SingleDeviceSlotBackend:
             pos0 = pos
             (views, tok, pos, key_data, hist, done, budget, toks,
              n_emit) = self._spec_round(
-                block_stack, pre, post,
+                K, block_stack, pre, post,
                 (views, tok, pos, key_data, hist, done, budget), True)
             ridx = jax.vmap(lambda tr, p0: flat_row_index(
-                tr, p0 + jnp.arange(K, dtype=jnp.int32), bs))(tables, pos0)
+                tr, p0 + jnp.arange(Q, dtype=jnp.int32), bs))(tables, pos0)
 
             def scat_layer(_, inp):
                 pool_l, view_l = inp
                 rows = {name: jax.vmap(
                     lambda v, p0: jax.lax.dynamic_slice(
                         v, (p0,) + (0,) * (v.ndim - 1),
-                        (K,) + v.shape[1:]))(view_l[name], pos0).reshape(
-                            (S * K,) + view_l[name].shape[2:])
+                        (Q,) + v.shape[1:]))(view_l[name], pos0).reshape(
+                            (S * Q,) + view_l[name].shape[2:])
                     for name in ("k", "v")}
                 return 0, scatter_block_rows(pool_l, ridx.reshape(-1), rows)
 
@@ -1048,6 +1162,9 @@ class SingleDeviceSlotBackend:
         row[:len(prompt)] = np.asarray(list(prompt), np.int32)
         row[len(prompt)] = tok0
         self._hist = self._hist.at[slot].set(jnp.asarray(row))
+        # adaptive-K starts each request optimistic: full draft depth
+        # until its own acceptance says otherwise
+        self._spec_ewma[slot] = float(self.spec_tokens)
 
     def _prefill_paged(self, slot: int, prompt: Sequence[int], seed: int,
                        max_new_tokens: int) -> int:
@@ -1155,11 +1272,13 @@ class SingleDeviceSlotBackend:
         rm = R if r_max is None else max(1, min(int(r_max), R))
         live_d = jnp.asarray(np.asarray(live, bool))
         budget = jnp.asarray(np.asarray(budgets, np.int32))
+        if self.spec_tokens is not None:
+            self.decode_width = self._pick_spec_k(live)
         if self.paged:
             tables = jnp.asarray(self.pool.table)
             if self.spec_tokens is not None:
                 (pool_kv, tok, pos, kd, views, regather, hist, buf,
-                 counts, k) = self._resident_jit(
+                 counts, k) = self._resident_spec_jits[self.decode_width](
                     self._block_stack, self._pre, self._post,
                     self._pool_kv, tables, self._tok, self._pos,
                     self._key_data, self._views, self._regather,
@@ -1179,7 +1298,7 @@ class SingleDeviceSlotBackend:
         else:
             if self.spec_tokens is not None:
                 caches, tok, pos, kd, hist, buf, counts, k = \
-                    self._resident_jit(
+                    self._resident_spec_jits[self.decode_width](
                         self._block_stack, self._pre, self._post,
                         self._caches, self._tok, self._pos,
                         self._key_data, self._hist, live_d, budget,
@@ -1202,11 +1321,55 @@ class SingleDeviceSlotBackend:
         valid = (np.arange(W)[None, None, :]
                  < counts[:, :, None]).reshape(self.num_slots, k * W)
         if self.spec_tokens is not None:
-            lc = counts[np.asarray(live, bool)]
-            reg.counter("serve.engine.spec_rounds").inc(
-                int((lc > 0).sum()))
-            reg.counter("serve.engine.spec_emitted").inc(int(lc.sum()))
+            lmask = np.asarray(live, bool)
+            lc = counts[lmask]
+            rounds = int((lc > 0).sum())
+            emitted = int(lc.sum())
+            reg.counter("serve.engine.spec_rounds").inc(rounds)
+            reg.counter("serve.engine.spec_emitted").inc(emitted)
+            # spec telemetry: acceptance = accepted draft tokens over
+            # drafted positions (K-1 per round), cumulative; per-round
+            # accepted-length histogram (log2 buckets downstream);
+            # draft cost as the drafter's work-unit prediction at the
+            # rung that ran
+            self._spec_acc_total += max(emitted - rounds, 0)
+            self._spec_draft_total += rounds * (W - 1)
+            if self._spec_draft_total:
+                reg.gauge("serve.spec.acceptance_rate").set(
+                    self._spec_acc_total / self._spec_draft_total)
+            reg.gauge("serve.spec.draft_cost_frac").set(
+                self._drafter.draft_cost_frac(W, self._n_layers))
+            hist_m = reg.histogram("serve.spec.accept_len")
+            for v in lc[lc > 0]:
+                hist_m.observe(float(v))
+            # adaptive-K: per-slot EWMA of accepted length feeds the
+            # next launch's rung pick (shrink when drafts miss, grow
+            # back when they land)
+            if len(self._spec_ladder) > 1:
+                rc = np.maximum((counts > 0).sum(axis=1), 1)
+                mean_acc = counts.sum(axis=1) / rc
+                upd = lmask & (counts.sum(axis=1) > 0)
+                self._spec_ewma[upd] = (0.7 * self._spec_ewma[upd]
+                                        + 0.3 * mean_acc[upd])
         return toks, valid
+
+    def _pick_spec_k(self, live: np.ndarray) -> int:
+        """Smallest pre-traced ladder rung covering the live slots'
+        accepted-length EWMA (plus one probe token so acceptance can
+        grow back). Single-rung ladders — the non-adaptive default —
+        short-circuit to ``spec_tokens``."""
+        ladder = self._spec_ladder
+        if len(ladder) == 1:
+            return ladder[0]
+        lmask = np.asarray(live, bool)
+        if not lmask.any():
+            return ladder[0]
+        need = int(np.ceil(self._spec_ewma[lmask].max())) + 1
+        need = max(2, min(need, self.spec_tokens))
+        for k in ladder:
+            if k >= need:
+                return k
+        return ladder[-1]
 
     def can_admit(self, prompt_len: int, max_new_tokens: int,
                   prompt: Optional[Sequence[int]] = None) -> bool:

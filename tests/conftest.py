@@ -375,6 +375,42 @@ _SLOW = {
     "test_norm.py::test_table_executor_bn_matches_emulator"
     "[except_last-gpipe]",
     "test_norm.py::test_table_executor_bn_matches_emulator[never-gpipe]",
+    # ------------------------------------------------------------------
+    # Gen-2 speculative decode (PR 18): the heavy runtime drills
+    # (5-10s each, ~55s total) all ride the slow tier — a clean
+    # tier-1 run already sits within ~40s of the 870s budget BEFORE
+    # this family, so there is no room for even one rep.
+    # tests/test_draft.py keeps the fast gen-2 unit pins (tree
+    # geometry, draft resolution, cost model, planner
+    # self-consistency) in tier 1; every parity/trace contract below
+    # runs in the full suite.
+    "test_resident.py::test_draft_sources_match_generator"
+    "[truncated-slab-greedy]",
+    "test_resident.py::test_draft_sources_match_generator"
+    "[truncated-paged-sampled]",
+    "test_resident.py::test_draft_sources_match_generator"
+    "[tree2-slab-sampled]",
+    "test_resident.py::test_draft_sources_match_generator"
+    "[tree3-paged-greedy]",
+    "test_resident.py::test_ring_speculative_matches_generator"
+    "[ngram-slab-greedy]",
+    "test_resident.py::test_ring_speculative_matches_generator"
+    "[ngram-paged-sampled]",
+    "test_resident.py::test_ring_speculative_matches_generator"
+    "[truncated-slab-sampled]",
+    "test_resident.py::test_ring_speculative_matches_generator"
+    "[truncated-paged-greedy]",
+    "test_resident.py::test_adaptive_k_shrink_grow_parity",
+    "test_resident.py::test_spec_empty_history_slots[single]",
+    "test_resident.py::test_spec_empty_history_slots[ring]",
+    "test_resident.py::test_spec_eos_mid_accepted_run[single]",
+    "test_resident.py::test_spec_eos_mid_accepted_run[ring]",
+    # PR 11 ngram-spec crossing made redundant by the gen-2 family:
+    # the slab-greedy twin stays tier-1, the paged resident program is
+    # pinned by test_resident_matches_single_chunk_tick
+    # [single-paged-greedy]
+    "test_resident.py::test_speculative_decode_matches_generator"
+    "[paged-sampled]",
 }
 
 

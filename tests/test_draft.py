@@ -1,0 +1,130 @@
+"""Draft-source unit contracts (PR 18): the interface the spec lane
+trusts without tracing a model.
+
+* ``tree_layout`` — the static flattened-tree geometry the causal tree
+  mask is built from: depths, disjoint branches, ancestor-or-self
+  closure.
+* ``resolve_draft`` — flag-level construction rejects impossible combos
+  loudly (never a silent fallback); ngram short-circuits the prefix
+  constraint entirely (it has no model half).
+* ``draft_cost_frac`` — the (rows x layers) cost model feeding the
+  planner's breakeven: zero for ngram, the strict-prefix ratio for
+  truncated/tree, always in [0, 1).
+* planner ``spec_speedup`` / ``spec_breakeven_acceptance`` — the
+  analytic model is self-consistent: speedup AT the breakeven
+  acceptance is exactly 1.0, and the knobs move it the right way.
+"""
+
+import numpy as np
+import pytest
+
+from pipe_tpu.core.planner import spec_breakeven_acceptance, spec_speedup
+from pipe_tpu.inference.draft import (NgramDraft, TreeDraft,
+                                      TruncatedDraft, resolve_draft,
+                                      tree_layout)
+
+
+def test_tree_layout_geometry():
+    K, B = 4, 3
+    depths, anc = tree_layout(K, B)
+    Q = 1 + B * (K - 1)
+    assert depths.shape == (Q,) and anc.shape == (Q, Q)
+    assert depths[0] == 0
+    # branch b occupies rows [1 + b*(K-1), 1 + (b+1)*(K-1)) at depths
+    # 1..K-1; every row sees the root and its own prefix, nothing else
+    for b in range(B):
+        base = 1 + b * (K - 1)
+        np.testing.assert_array_equal(depths[base:base + K - 1],
+                                      np.arange(1, K))
+        for i in range(K - 1):
+            r = base + i
+            expect = {0, *range(base, base + i + 1)}
+            assert set(np.nonzero(anc[r])[0]) == expect
+    # ancestor-or-self is reflexive and respects depth ordering
+    assert all(anc[j, j] for j in range(Q))
+    assert all(depths[r] <= depths[j]
+               for j in range(Q) for r in np.nonzero(anc[j])[0])
+
+
+def test_resolve_draft_combos():
+    # ngram has no model half: the prefix constraint never applies
+    assert isinstance(
+        resolve_draft("ngram", n_stages=1, layers_per_stage=4,
+                      draft_stages=99), NgramDraft)
+    d = resolve_draft("truncated", n_stages=4, layers_per_stage=2,
+                      draft_stages=3)
+    assert isinstance(d, TruncatedDraft) and d.draft_layers == 6
+    t = resolve_draft("tree", n_stages=2, layers_per_stage=2,
+                      spec_branches=3)
+    assert isinstance(t, TreeDraft)
+    assert t.branches == 3 and t.draft_layers == 2
+
+    with pytest.raises(ValueError, match="STRICT prefix"):
+        resolve_draft("truncated", n_stages=2, layers_per_stage=2,
+                      draft_stages=2)
+    with pytest.raises(ValueError, match="STRICT prefix"):
+        resolve_draft("truncated", n_stages=1, layers_per_stage=4)
+    with pytest.raises(ValueError, match="STRICT prefix"):
+        resolve_draft("tree", n_stages=2, layers_per_stage=2,
+                      draft_stages=0, spec_branches=2)
+    with pytest.raises(ValueError, match="spec_branches"):
+        resolve_draft("tree", n_stages=2, layers_per_stage=2)
+    with pytest.raises(ValueError, match="spec_branches"):
+        resolve_draft("tree", n_stages=2, layers_per_stage=2,
+                      spec_branches=1)
+    with pytest.raises(ValueError, match="unknown draft source"):
+        resolve_draft("medusa", n_stages=2, layers_per_stage=2)
+    with pytest.raises(ValueError, match="branches"):
+        TreeDraft(1, 2)
+    with pytest.raises(ValueError, match="draft layer"):
+        TruncatedDraft(0)
+
+
+def test_draft_cost_model():
+    assert NgramDraft().draft_cost_frac(4, 16) == 0.0
+    # truncated: (K-1)*Ld draft row-layers vs K*L verify row-layers
+    K, L = 3, 4
+    assert TruncatedDraft(1).draft_cost_frac(K, L) == \
+        pytest.approx(2 / (2 + 12))
+    # deeper prefix costs more, never reaching 1
+    fracs = [TruncatedDraft(ld).draft_cost_frac(4, 16)
+             for ld in (1, 4, 8, 15)]
+    assert fracs == sorted(fracs) and all(0 < f < 1 for f in fracs)
+    # tree: 1 shared root step + B*(K-2) branch steps of Ld layers,
+    # verified in a Q-row chunk
+    B, Ld = 2, 2
+    steps, Q = 1 + B * (K - 2), 1 + B * (K - 1)
+    assert TreeDraft(B, Ld).draft_cost_frac(K, L) == \
+        pytest.approx(steps * Ld / (steps * Ld + Q * L))
+    # K=2 tree: the shared root step is the whole draft
+    assert TreeDraft(3, 2).draft_cost_frac(2, 4) == \
+        pytest.approx(2 / (2 + 4 * 4))
+
+
+def test_spec_model_self_consistent():
+    for f, K, r in [(0.0, 2, 1.0), (0.25, 3, 1.0), (0.25, 4, 1.6),
+                    (0.6, 8, 2.5)]:
+        a_star = spec_breakeven_acceptance(f, K, r)
+        if 0.0 < a_star < 1.0:
+            assert spec_speedup(a_star, f, K, r) == pytest.approx(1.0)
+        # speedup is monotone in acceptance
+        assert spec_speedup(1.0, f, K, r) >= spec_speedup(0.0, f, K, r)
+    # free draft, memory-bound chunk (ratio 1): any acceptance >= 0 wins
+    assert spec_breakeven_acceptance(0.0, 4, 1.0) == 0.0
+    assert spec_speedup(0.0, 0.0, 4, 1.0) == pytest.approx(1.0)
+    # an expensive draft under a FLOP-bound chunk can never pay off
+    assert spec_breakeven_acceptance(0.9, 2, 2.0) == 1.0
+    # knob directions: deeper K needs less acceptance per token won;
+    # a costlier draft needs more
+    assert spec_breakeven_acceptance(0.25, 8) < \
+        spec_breakeven_acceptance(0.25, 2)
+    assert spec_breakeven_acceptance(0.5, 4) > \
+        spec_breakeven_acceptance(0.1, 4)
+    with pytest.raises(ValueError, match="K >= 2"):
+        spec_speedup(0.5, 0.1, 1)
+    with pytest.raises(ValueError, match="acceptance"):
+        spec_speedup(1.5, 0.1, 4)
+    with pytest.raises(ValueError, match="draft_cost_frac"):
+        spec_breakeven_acceptance(1.0, 4)
+    with pytest.raises(ValueError, match="chunk_cost_ratio"):
+        spec_breakeven_acceptance(0.1, 4, 0.0)

@@ -271,23 +271,27 @@ def test_paged_sampled_parity_single(model_and_params):
 
 
 def test_paged_sampled_parity_ring_matches_slab_ring(model_and_params):
-    """The ring's sampled convention is its own fold_in chain, so the
-    pin is paged-ring == slab-ring, token-for-token."""
+    """The ring threads the Generator's split key chain through the
+    revolutions (it used to speak its own fold_in chain), so the pin is
+    three-way: paged-ring == slab-ring == the one-shot Generator,
+    token-for-token."""
     model, params = model_and_params
     sp, pre, post = params
     gen_cfg = GenerationConfig(max_new_tokens=5, temperature=1.0,
                                top_k=8)
     prompts = _mixed_prompts((3, 6, 4), seed=3)
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=3)
     mesh = make_mesh(2, 1)
     slab = RingSlotBackend(mesh, model, stack_stage_params(sp), pre,
                            post, max_len=16, gen=gen_cfg)
     want = ServeEngine(slab).serve(prompts, seeds=[3] * len(prompts))
     paged = _paged_backend("ring", model, params, gen_cfg)
     got = ServeEngine(paged).serve(prompts, seeds=[3] * len(prompts))
-    for a, b in zip(got, want):
+    for a, b, ref in zip(got, want, refs):
         assert a.status == "ok"
         np.testing.assert_array_equal(np.asarray(a.tokens),
                                       np.asarray(b.tokens))
+        np.testing.assert_array_equal(np.asarray(a.tokens), ref)
 
 
 @pytest.mark.parametrize("kind", ["single", "ring"])

@@ -19,11 +19,17 @@ Gold contract, layered on the serve suite's pins:
 * **Early exit.** The device loop exits before ``r_max`` when any live
   slot finishes (``serve.engine.device_exits``), so a freed slot waits
   at most one chunk, not a full horizon.
-* **Speculative decode.** The n-gram draft/verify lane emits bitwise
-  the per-prompt ``Generator`` tokens (draft rejection rolls back to
-  exact greedy/sampled behaviour) while emitting MORE than one token
-  per verify round on draftable text (``serve.engine.spec_emitted`` >
-  ``serve.engine.spec_rounds``).
+* **Speculative decode.** Every draft source — prompt-history n-gram,
+  truncated-pipeline (first stage(s) + tied embedding head), and the
+  multi-branch tree — emits bitwise the per-prompt ``Generator``
+  tokens (draft rejection rolls back to exact greedy/sampled
+  behaviour) while emitting MORE than one token per verify round on
+  draftable text (``serve.engine.spec_emitted`` >
+  ``serve.engine.spec_rounds``). The ring backend speaks the same
+  contract: the Generator split key chain threads through the
+  revolutions, so ring spec output is Generator-bitwise too, greedy
+  AND sampled. Adaptive-K rung switches, one-token prompts (no draft
+  history) and EOS landing mid-accepted-run all preserve the pin.
 """
 
 import jax
@@ -279,6 +285,168 @@ def test_speculative_decode_matches_generator(layout, temp,
     assert rounds > 0 and emitted > rounds   # acceptance rate > 0
 
 
+DRAFT_CASES = [
+    ("truncated", None, "slab", 0.0), ("truncated", None, "paged", 0.8),
+    ("tree", 2, "slab", 0.8), ("tree", 3, "paged", 0.0),
+]
+DRAFT_IDS = [f"{d}{b or ''}-{l}-{'greedy' if t == 0.0 else 'sampled'}"
+             for d, b, l, t in DRAFT_CASES]
+
+
+@pytest.mark.parametrize("draft,branches,layout,temp", DRAFT_CASES,
+                         ids=DRAFT_IDS)
+def test_draft_sources_match_generator(draft, branches, layout, temp,
+                                       model_and_params):
+    """Model-based drafts: the truncated pipeline (stage 0 + tied
+    embedding head) and the B-branch tree verified in ONE fixed-shape
+    chunk under the causal tree mask both stay bitwise the Generator —
+    acceptance changes throughput, never tokens."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=temp,
+                               top_k=12 if temp else None)
+    prompts = [[5, 6, 5, 6, 5, 6], [3, 3, 3, 3]]
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=11)
+
+    backend = _make_backend("single", model, params, gen_cfg, layout,
+                            max_len=24, resident=True,
+                            resident_chunks=4, spec_tokens=3,
+                            draft=draft, spec_branches=branches)
+    reg = get_registry()
+    rounds0 = reg.counter("serve.engine.spec_rounds").value
+    emitted0 = reg.counter("serve.engine.spec_emitted").value
+    got = _drive_staggered(backend, prompts, seed=11)
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(np.asarray(g), r)
+    rounds = reg.counter("serve.engine.spec_rounds").value - rounds0
+    emitted = reg.counter("serve.engine.spec_emitted").value - emitted0
+    assert rounds > 0 and emitted >= rounds
+    if temp == 0.0:
+        # greedy verify matches greedy draft often enough to accept; a
+        # sampled verify on random weights legitimately accepts ~nothing
+        assert emitted > rounds
+    assert reg.gauge("serve.spec.draft_cost_frac").value > 0.0
+
+
+RING_SPEC_CASES = [
+    ("ngram", "slab", 0.0), ("ngram", "paged", 0.8),
+    ("truncated", "slab", 0.8), ("truncated", "paged", 0.0),
+]
+RING_SPEC_IDS = [f"{d}-{l}-{'greedy' if t == 0.0 else 'sampled'}"
+                 for d, l, t in RING_SPEC_CASES]
+
+
+@pytest.mark.parametrize("draft,layout,temp", RING_SPEC_CASES,
+                         ids=RING_SPEC_IDS)
+def test_ring_speculative_matches_generator(draft, layout, temp,
+                                            model_and_params):
+    """Ring spec: the K-row wavefront chunk rides the ppermute message
+    ring while stage n-1 verifies against the Generator split key chain
+    — staggered arrivals (stale in-flight rounds discarded by the
+    admission inequalities) still emit bitwise Generator tokens, greedy
+    AND sampled."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=temp,
+                               top_k=12 if temp else None)
+    prompts = [[5, 6, 5, 6, 5, 6], [3, 3, 3, 3], [7, 8, 7, 8, 7]]
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=11)
+
+    backend = _make_backend("ring", model, params, gen_cfg, layout,
+                            max_len=24, resident=True,
+                            resident_chunks=4, spec_tokens=3,
+                            draft=draft)
+    reg = get_registry()
+    rounds0 = reg.counter("serve.engine.spec_rounds").value
+    emitted0 = reg.counter("serve.engine.spec_emitted").value
+    got = _drive_staggered(backend, prompts, seed=11)
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(np.asarray(g), r)
+    rounds = reg.counter("serve.engine.spec_rounds").value - rounds0
+    emitted = reg.counter("serve.engine.spec_emitted").value - emitted0
+    assert rounds > 0 and emitted >= rounds
+    if temp == 0.0:
+        assert emitted > rounds   # acceptance rate > 0 under greedy
+
+
+def test_adaptive_k_shrink_grow_parity(model_and_params):
+    """Per-slot acceptance-EWMA adaptive K: a draftable slot next to an
+    adversarial one forces rung switches mid-stream; the rollback
+    overwrite under a shrunk-then-regrown K stays bitwise the
+    Generator, every rung comes from the pre-traced ladder (traces <=
+    ladder rungs), and a second identical drive retraces NOTHING."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=10, temperature=0.8,
+                               top_k=12)
+    prompts = [[5, 6, 5, 6, 5, 6, 5, 6],        # draftable
+               _mixed_prompts((7,), seed=3)[0]]  # adversarial
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=13)
+
+    backend = _make_backend("single", model, params, gen_cfg,
+                            max_len=32, resident=True,
+                            resident_chunks=4, spec_tokens=4,
+                            spec_adaptive=True)
+    reg = get_registry()
+    traces0 = reg.counter("serve.engine.resident_traces").value
+    got = _drive_staggered(backend, prompts, seed=13)
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(np.asarray(g), r)
+    traced = reg.counter("serve.engine.resident_traces").value - traces0
+    assert 1 <= traced <= len(backend._spec_ladder)
+    # misses on the adversarial slot shrank its EWMA below the optimism
+    # every request starts at
+    assert backend._spec_ewma.min() < float(backend.spec_tokens)
+    # warm steady state: the same traffic again traces zero new programs
+    got2 = _drive_staggered(backend, prompts, seed=13)
+    assert got2 == got
+    assert reg.counter("serve.engine.resident_traces").value \
+        - traces0 == traced
+
+
+@pytest.mark.parametrize("kind", ["single", "ring"])
+def test_spec_empty_history_slots(kind, model_and_params):
+    """One-token prompts: the n-gram drafter has NO history to match
+    and the truncated drafter extends a length-1 prefix — junk drafts
+    must be rejected back to exact Generator output, never crash or
+    corrupt the rollback."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    prompts = [[7], [3]]
+    refs = _one_shot_refs(model, params, prompts, gen_cfg, seed=5)
+    for draft in ("ngram", "truncated"):
+        backend = _make_backend(kind, model, params, gen_cfg,
+                                max_len=24, resident=True,
+                                resident_chunks=4, spec_tokens=3,
+                                draft=draft)
+        got = _drive_staggered(backend, prompts, seed=5)
+        for g, r in zip(got, refs):
+            np.testing.assert_array_equal(np.asarray(g), r)
+
+
+@pytest.mark.parametrize("kind", ["single", "ring"])
+def test_spec_eos_mid_accepted_run(kind, model_and_params):
+    """EOS emitted in the MIDDLE of an accepted draft run: the response
+    truncates exactly at EOS (tokens past it in the same round are
+    dropped) and retires early, matching the Generator's own EOS
+    masking."""
+    model, params = model_and_params
+    probe = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    prompts = [[5, 6, 5, 6, 5, 6], [3, 3, 3, 3]]
+    free = _one_shot_refs(model, params, prompts, probe, seed=11)
+    eos = int(free[0][3])   # a token greedy decoding actually emits
+
+    gen_cfg = GenerationConfig(max_new_tokens=8, temperature=0.0,
+                               eos_token_id=eos)
+    refs = [r.tolist() for r in
+            _one_shot_refs(model, params, prompts, gen_cfg, seed=11)]
+    # the Generator pads past EOS; responses stop AT it
+    refs = [r[:r.index(eos) + 1] if eos in r else r for r in refs]
+    backend = _make_backend(kind, model, params, gen_cfg,
+                            max_len=24, resident=True,
+                            resident_chunks=4, spec_tokens=3)
+    got = _drive_staggered(backend, prompts, seed=11)
+    assert got == refs
+    assert any(t and t[-1] == eos and len(t) < 8 for t in got)
+
+
 # ---------------------------------------------------------------------------
 # knob validation: loud rejections, not silent fallbacks
 
@@ -298,13 +466,32 @@ def test_resident_knob_validation(model_and_params):
     with pytest.raises(ValueError, match="resident"):
         _make_backend("single", model, params, gen_cfg,
                       resident=False, spec_tokens=3)
-    # the ring's sampled key chain is not the Generator chain the spec
-    # lane replays — single-device only, rejected loudly
-    with pytest.raises(NotImplementedError, match="single-device"):
-        _make_backend("ring", model, params,
-                      GenerationConfig(max_new_tokens=4,
-                                       temperature=0.0, spec_tokens=3),
-                      resident=True)
+    # draft knobs configure the spec lane — meaningless without it
+    with pytest.raises(ValueError, match="speculative lane"):
+        _make_backend("single", model, params, gen_cfg,
+                      resident=True, draft="truncated")
+    # the tree draft needs branches to fan out
+    with pytest.raises(ValueError, match="spec_branches"):
+        _make_backend("single", model, params, gen_cfg,
+                      resident=True, spec_tokens=3, draft="tree")
+    # the ring wavefront carries ONE linear K-row chunk per slot, so
+    # the tree's branch fan-out and the adaptive ladder's shape switch
+    # stay single-device; a ring draft deeper than stage 0 would need
+    # layers that are not resident where the draft runs
+    with pytest.raises(ValueError, match="single-device"):
+        _make_backend("ring", model, params, gen_cfg, resident=True,
+                      spec_tokens=3, draft="tree", spec_branches=2)
+    with pytest.raises(ValueError, match="single-device"):
+        _make_backend("ring", model, params, gen_cfg, resident=True,
+                      spec_tokens=3, spec_adaptive=True)
+    with pytest.raises(ValueError, match="STRICT prefix"):
+        _make_backend("ring", model, params, gen_cfg, resident=True,
+                      spec_tokens=3, draft="truncated", draft_stages=2)
+    # ring spec decode is resident-only: budgets must ride the launch
+    spec_ring = _make_backend("ring", model, params, gen_cfg,
+                              resident=True, spec_tokens=3)
+    with pytest.raises(ValueError, match="resident-only"):
+        spec_ring.decode(np.array([True, False]))
 
 
 def test_spec_headroom_tightens_validate(model_and_params):

@@ -30,6 +30,16 @@ of record are the committed ``SERVE_r08.json``):
    plus the offload drill: spill cold blocks to host under pool
    pressure, restore on re-reference, tokens bitwise the unpressured
    run.
+6. **Speculative gen-2** (``SERVE_r18.json``) — real draft sources on
+   the tied-head bench weights (see :func:`_spec_bench_params`):
+   n-gram vs truncated-pipeline vs tree acceptance on APERIODIC
+   prompts (the history lookup's worst case, the model drafts' home
+   turf), every source bitwise the Generator; then the
+   measured-breakeven closed loop — spec vs non-spec resident tokens/s
+   at equal live slots, with the verify-chunk cost ratio measured from
+   the two engines' own step/round rates and fed back through the
+   planner's :func:`~pipe_tpu.core.planner.spec_breakeven_acceptance`
+   so the artifact records predicted AND measured speedup.
 
 Usage:
   python tools/serve_bench.py            # full run, pretty JSON to stdout
@@ -484,6 +494,309 @@ def spec_acceptance(model, params, seed, *, n_prompts=4, max_new=32,
     }
 
 
+SPEC_K = 4          # draft depth: 1 committed + K-1 offered per round
+SPEC_STAGES = 4     # logical stages of the spec bench model (1-layer draft prefix)
+SPEC_MAX_NEW = 32
+_SPEC_EPS = 0.01
+
+
+def _spec_bench_params(params, eps=_SPEC_EPS):
+    """Derived weights for the gen-2 spec section. Two surgeries, both
+    argmax-preserving for the FULL model:
+
+    * the decoder is tied to the embedding table (``w = table.T``,
+      ``b = 0``) — the same matrix the truncated/tree draft head
+      scores tokens with;
+    * every block's residual branch (attention out-projection, ffn
+      second matmul) is scaled by ``eps`` — each post-LN block then
+      nearly rescales its (already layer-normed) input instead of
+      rotating it, so the hidden the stage-0 draft head reads already
+      points at the argmax the full-depth verify head picks.
+
+    Acceptance becomes a property of the DRAFT SOURCE rather than of
+    prompt repetition: the model-based drafts track verify
+    near-perfectly, while the next-token map stays position-driven
+    (embedding + positional code) — an n-gram history lookup only
+    scores where the emitted stream happens to revisit old contexts,
+    a fraction of what the model drafts accept.
+    """
+    stages, pre, post = params
+    out_stages = []
+    for stage in stages:
+        out_stage = []
+        for bp in stage:
+            bp = {k: dict(v) for k, v in bp.items()}
+            bp["attn"]["wo"] = bp["attn"]["wo"] * eps
+            bp["attn"]["bo"] = bp["attn"]["bo"] * eps
+            bp["ff2"]["w"] = bp["ff2"]["w"] * eps
+            bp["ff2"]["b"] = bp["ff2"]["b"] * eps
+            out_stage.append(bp)
+        out_stages.append(out_stage)
+    table = pre["embed"]["table"]
+    post = {"decoder": {
+        "w": table.T.astype(post["decoder"]["w"].dtype),
+        "b": jnp.zeros_like(post["decoder"]["b"])}}
+    return out_stages, pre, post
+
+
+def _spec_drive(model, params, prompts, seed, *, draft, branches=None):
+    """Serve ``prompts`` through one draft source; acceptance from the
+    engine's round/emission counters, parity vs the per-prompt
+    Generator."""
+    from pipe_tpu.obs.telemetry import get_registry
+    reg = get_registry()
+    gen_cfg = GenerationConfig(max_new_tokens=SPEC_MAX_NEW,
+                               temperature=0.0)
+    g = Generator(model, gen_cfg)
+    refs = [np.asarray(g.generate(
+        params, jnp.asarray(p, jnp.int32)[None],
+        jax.random.key(seed + i)))[0] for i, p in enumerate(prompts)]
+    pad = (branches or 1) * (SPEC_K - 1)    # rollback overwrite room
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=2, max_len=MAX_LEN + pad, gen=gen_cfg,
+        buckets=BUCKETS, resident=True, resident_chunks=RES_HORIZON,
+        spec_tokens=SPEC_K, draft=draft, spec_branches=branches)
+    r0 = reg.counter("serve.engine.spec_rounds").value
+    e0 = reg.counter("serve.engine.spec_emitted").value
+    eng = ServeEngine(backend)
+    resps = eng.serve(prompts,
+                      seeds=[seed + i for i in range(len(prompts))])
+    equal = all(np.array_equal(np.asarray(r.tokens), ref)
+                for r, ref in zip(resps, refs))
+    rounds = reg.counter("serve.engine.spec_rounds").value - r0
+    emitted = reg.counter("serve.engine.spec_emitted").value - e0
+    out = {"bitwise_equal_to_generator": bool(equal),
+           "verify_rounds": int(rounds),
+           "tokens_per_round": round(emitted / max(rounds, 1), 3),
+           "acceptance_rate": round(
+               (emitted - rounds) / max(rounds * (SPEC_K - 1), 1), 4),
+           "draft_cost_frac": round(float(
+               reg.gauge("serve.spec.draft_cost_frac").value), 4)}
+    if branches:
+        out["branches"] = branches
+    return out
+
+
+def _spec_steady(model, params, slots, seed, *, spec_kw, max_len,
+                 ticks, reps):
+    """Steady-state (tokens/s, spec-rounds/s) for one resident engine —
+    ``resident_steady_state``'s measurement loop with the spec lane's
+    knobs threaded through and the round counter sampled alongside the
+    token counter (the round rate is what prices the verify chunk)."""
+    from pipe_tpu.obs.telemetry import get_registry
+    reg = get_registry()
+    tok_c = reg.counter("serve.engine.tokens")
+    rnd_c = reg.counter("serve.engine.spec_rounds")
+    gen_cfg = GenerationConfig(max_new_tokens=MAX_NEW, temperature=0.0)
+    backend = SingleDeviceSlotBackend(
+        model, params, num_slots=slots, max_len=max_len, gen=gen_cfg,
+        buckets=BUCKETS, decode_chunk=1, resident=True,
+        resident_chunks=RES_HORIZON, **spec_kw)
+    k = spec_kw.get("spec_tokens") or 1
+    per_slot = (3 + reps * ticks) * RES_HORIZON * k
+    n_req = slots * (4 + 2 * per_slot // MAX_NEW)
+    rng = np.random.RandomState(seed)
+    eng = ServeEngine(backend, RequestQueue(capacity=n_req + slots))
+    for p in make_prompts(n_req, rng):
+        eng.submit(p)
+    for _ in range(3):
+        eng.tick()
+    trc_c = reg.counter("serve.engine.resident_traces")
+    trc0 = trc_c.value                      # warm compiled everything
+    best_tps, best_rps = 0.0, 0.0
+    for _ in range(reps):
+        n0, r0 = tok_c.value, rnd_c.value
+        t0 = time.monotonic()
+        for _ in range(ticks):
+            eng.tick()
+        dt = time.monotonic() - t0
+        # With acceptance ~1 a request retires every SECOND launch, so
+        # unlike the nonspec sections a window end can land on the
+        # retire tick itself (live drops until the next tick's
+        # admission). The occupancy invariant that matters for the A/B
+        # is that admission always had work waiting: the queue never
+        # ran dry inside the window.
+        assert len(eng.queue) > 0
+        best_tps = max(best_tps, (tok_c.value - n0) / dt)
+        best_rps = max(best_rps, (rnd_c.value - r0) / dt)
+    return best_tps, best_rps, trc_c.value - trc0
+
+
+# The ring needs >= 2 devices and this process already initialized the
+# single-device backend, so the drill re-inits jax on the 2-virtual-chip
+# CPU platform in a child interpreter (the conftest trick).
+_RING_DRILL_SRC = r"""
+import json, os, sys
+
+sys.path.insert(0, os.environ["PIPE_TPU_ROOT"])
+sys.path.insert(0, os.path.join(os.environ["PIPE_TPU_ROOT"], "tools"))
+from pipe_tpu.utils.platform import force_cpu_platform
+force_cpu_platform(num_devices=2)   # before backend init
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import serve_bench as sb
+from pipe_tpu.inference import GenerationConfig, Generator
+from pipe_tpu.obs.telemetry import get_registry
+from pipe_tpu.parallel.mesh import make_mesh
+from pipe_tpu.parallel.spmd import stack_stage_params
+from pipe_tpu.serve import RingSlotBackend, ServeEngine
+
+seed = int(sys.argv[1])
+model = sb.PipelinedLM(sb.CFG, 2)          # one stage per ring chip
+sp, pre, post = sb._spec_bench_params(model.init(jax.random.key(1)))
+stacked = stack_stage_params(sp)
+rng = np.random.RandomState(seed)
+prompts = sb.make_prompts(3, rng)
+reg = get_registry()
+
+
+def drive(backend):
+    # staggered arrivals: slot churn exercises relaunches + the
+    # stale-round discard, not one clean batch
+    eng = ServeEngine(backend)
+    ids = [eng.submit(prompts[0], seed=seed).id]
+    eng.tick()
+    ids += [eng.submit(p, seed=seed).id for p in prompts[1:]]
+    eng.run_until_idle()
+    return [list(eng.response(i).tokens) for i in ids]
+
+
+out = {"spec_tokens": sb.SPEC_K, "draft": "truncated",
+       "prompts": len(prompts)}
+for name, temp in (("greedy", 0.0), ("sampled", 0.8)):
+    gen_cfg = GenerationConfig(max_new_tokens=16, temperature=temp,
+                               top_k=12 if temp else None)
+    g = Generator(model, gen_cfg)
+    refs = [np.asarray(g.generate((sp, pre, post),
+                                  jnp.asarray(p, jnp.int32)[None],
+                                  jax.random.key(seed)))[0]
+            for p in prompts]
+    backend = RingSlotBackend(
+        make_mesh(2, 1), model, stacked, pre, post,
+        max_len=96 + sb.SPEC_K, gen=gen_cfg, buckets=sb.BUCKETS,
+        resident=True, resident_revolutions=4,
+        spec_tokens=sb.SPEC_K, draft="truncated")
+    t0 = reg.counter("serve.ring.resident_traces").value
+    r0 = reg.counter("serve.engine.spec_rounds").value
+    e0 = reg.counter("serve.engine.spec_emitted").value
+    got = drive(backend)
+    warm = reg.counter("serve.ring.resident_traces").value - t0
+    rounds = reg.counter("serve.engine.spec_rounds").value - r0
+    emitted = reg.counter("serve.engine.spec_emitted").value - e0
+    got2 = drive(backend)      # warm steady state: same traffic again
+    out[name] = {
+        "bitwise_equal_to_generator": bool(
+            all(np.array_equal(np.asarray(a), r)
+                for a, r in zip(got, refs)) and got2 == got),
+        "verify_rounds": int(rounds),
+        "acceptance_rate": round(
+            (emitted - rounds) / max(rounds * (sb.SPEC_K - 1), 1), 4),
+        "warm_traces": int(warm),
+        "steady_state_new_traces": int(
+            reg.counter("serve.ring.resident_traces").value - t0 - warm),
+    }
+print("RING_DRILL " + json.dumps(out))
+"""
+
+
+def _ring_spec_drill(seed):
+    """Ring-backend spec on the same tied-head weights: truncated
+    drafts ride the split-key chain through the revolutions, greedy AND
+    sampled output stays bitwise the Generator, and re-serving the same
+    traffic shape traces zero new ring programs."""
+    import subprocess
+    env = dict(os.environ,
+               PIPE_TPU_ROOT=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", _RING_DRILL_SRC,
+                           str(seed)], capture_output=True, text=True,
+                          timeout=1800, env=env)
+    for line in proc.stdout.splitlines():
+        if line.startswith("RING_DRILL "):
+            return json.loads(line[len("RING_DRILL "):])
+    raise RuntimeError(f"ring spec drill produced no result "
+                       f"(rc={proc.returncode}):\n"
+                       f"{proc.stdout[-2000:]}{proc.stderr[-2000:]}")
+
+
+def spec_gen2(slots, seed, *, quick):
+    """Gen-2 speculative section: draft-source acceptance shoot-out +
+    the measured-breakeven closed loop, on the tied-head bench weights
+    (aperiodic prompts, greedy — every number is also a parity pin)."""
+    from pipe_tpu.core.planner import (spec_breakeven_acceptance,
+                                       spec_speedup)
+    model = PipelinedLM(CFG, SPEC_STAGES)
+    params = _spec_bench_params(model.init(jax.random.key(1)))
+    rng = np.random.RandomState(seed)
+    prompts = make_prompts(3 if quick else 4, rng)
+    sources = [("ngram", None), ("truncated", None)]
+    if not quick:
+        sources.append(("tree", 3))
+    per_source = {}
+    for draft, branches in sources:
+        log(f"  draft={draft}...")
+        per_source[draft] = _spec_drive(model, params, prompts,
+                                        seed, draft=draft,
+                                        branches=branches)
+
+    # Spec vs non-spec resident loop at EQUAL live slots, same
+    # weights, same prompt mix. The verify-chunk cost ratio is
+    # MEASURED, not assumed: non-spec emits one token per chunk step
+    # (R1 = tokens/s), spec runs one K-row verify chunk per round
+    # (R2 = rounds/s), and the round buys its draft on top — so
+    # r = (R1/R2) * (1 - f). Feeding r back through the planner
+    # closes the loop: the artifact records the breakeven acceptance
+    # this host actually imposes next to the acceptance and speedup
+    # it actually measured.
+    ticks = 3 if quick else 8
+    reps = 2 if quick else 3
+    non_tps, _, _ = _spec_steady(model, params, slots, seed + 1,
+                                 spec_kw={}, max_len=MAX_LEN,
+                                 ticks=ticks, reps=reps)
+    spec_tps, spec_rps, spec_traces = _spec_steady(
+        model, params, slots, seed + 1,
+        spec_kw=dict(spec_tokens=SPEC_K, draft="truncated"),
+        max_len=MAX_LEN + SPEC_K - 1, ticks=ticks, reps=reps)
+    f = per_source["truncated"]["draft_cost_frac"]
+    a = per_source["truncated"]["acceptance_rate"]
+    r = (non_tps / max(spec_rps, 1e-9)) * (1.0 - f)
+    out_ring = None
+    if not quick:
+        log("  ring spec drill (subprocess, 2 virtual chips)...")
+        out_ring = _ring_spec_drill(seed + 2)
+    return {
+        "spec_tokens": SPEC_K,
+        "model_stages": SPEC_STAGES,
+        "draft_stages": 1,
+        "max_new_tokens": SPEC_MAX_NEW,
+        "prompts": len(prompts),
+        "draft_sources": per_source,
+        "throughput": {
+            "live_slots": slots,
+            "nonspec_tokens_s": round(non_tps, 1),
+            "spec_tokens_s": round(spec_tps, 1),
+            "spec_vs_nonspec_tokens_s": round(
+                spec_tps / max(non_tps, 1e-9), 4),
+            "spec_rounds_s": round(spec_rps, 1),
+            "acceptance": a,
+            "draft_cost_frac": f,
+            "chunk_cost_ratio_measured": round(r, 4),
+            "breakeven_acceptance": round(
+                spec_breakeven_acceptance(f, SPEC_K, r), 4),
+            "predicted_speedup": round(
+                spec_speedup(a, f, SPEC_K, r), 4),
+            # measured-window recompiles of the spec resident program
+            # (fixed K, no adaptive ladder in play -> must be zero)
+            "steady_state_new_traces": int(spec_traces),
+        },
+        **({"ring": out_ring} if out_ring else {}),
+    }
+
+
 def drive_poisson(eng, prompts, arrivals, *, max_new, deadline_s):
     """Feed the engine a precomputed arrival schedule against the wall
     clock; tick until drained. Returns (responses, elapsed, rejected)."""
@@ -663,6 +976,20 @@ def main():
         f"us/tok ({res_ab['host_overhead_reduction']:.1f}x less host, "
         f"{res_ab['resident_vs_nonresident_tokens_s']:.3f}x tokens/s)")
 
+    # Gen-2 speculative: draft-source shoot-out + measured breakeven
+    # on the tied-head weights (both modes — bench.py gates the quick
+    # fields; the full run is the SERVE_r18 record).
+    log("spec gen-2: draft sources on tied-head weights...")
+    spec2 = spec_gen2(slots, args.seed + 8, quick=args.quick)
+    sp_src, sp_thr = spec2["draft_sources"], spec2["throughput"]
+    log(f"  acceptance ngram {sp_src['ngram']['acceptance_rate']:.3f} "
+        f"vs truncated {sp_src['truncated']['acceptance_rate']:.3f}"
+        + (f" vs tree {sp_src['tree']['acceptance_rate']:.3f}"
+           if "tree" in sp_src else "")
+        + f"; spec {sp_thr['spec_vs_nonspec_tokens_s']:.3f}x non-spec "
+        f"(breakeven a*={sp_thr['breakeven_acceptance']:.3f}, "
+        f"predicted {sp_thr['predicted_speedup']:.3f}x)")
+
     # capacity in requests/s at the bench's request size
     max_new = MAX_NEW
     cap_req_s = serve_tps / max_new
@@ -692,6 +1019,7 @@ def main():
         "kv_radix_multi_tenant": radix,
         "kv_offload_drill": offload,
         "resident_ab": res_ab,
+        "speculative_gen2": spec2,
         "poisson_0p7": moderate,
     }
     if args.quick:
@@ -719,6 +1047,18 @@ def main():
                 res_ab["resident_vs_nonresident_tokens_s"],
             "host_overhead_reduction":
                 res_ab["host_overhead_reduction"],
+            "spec_bitwise": all(
+                s["bitwise_equal_to_generator"]
+                for s in sp_src.values()),
+            "spec_acceptance_ngram": sp_src["ngram"]["acceptance_rate"],
+            "spec_acceptance_truncated":
+                sp_src["truncated"]["acceptance_rate"],
+            "spec_vs_nonspec_tokens_s":
+                sp_thr["spec_vs_nonspec_tokens_s"],
+            "spec_breakeven_acceptance":
+                sp_thr["breakeven_acceptance"],
+            "spec_steady_new_traces":
+                sp_thr["steady_state_new_traces"],
             "contended": host["contended"],
         }))
         return
