@@ -566,9 +566,12 @@ def main():
     # kill-one-of-3 failover proof over REAL child processes (SIGKILL
     # a replica process mid-stream: recovery + exactly-once ledger),
     # the async-tick straggler win, the session-remap KV handoff
-    # TTFT, and the observability plane over the SIGKILL drill
-    # (delivered-token reconciliation + trace stitching) — fleet_ok
-    # must stay true every round (quick mode of
+    # TTFT, the disagg-vs-mixed SLO goodput drill plus the
+    # phase-specialized SIGKILL drills (kill one prefill child, then
+    # one decode child — exactly-once across the KV handoff), the
+    # saturation sweep, and the observability plane over the SIGKILL
+    # drill (delivered-token reconciliation + trace stitching) —
+    # fleet_ok must stay true every round (quick mode of
     # tools/fleet_bench.py --fleet proc; FLEET_r{N}.json is the full
     # record).
     fleet_summary = None
@@ -579,7 +582,7 @@ def main():
             [sys.executable, os.path.join(here, "tools",
                                           "fleet_bench.py"), "--quick",
              "--fleet", "proc",
-             "--out", os.path.join(here, "FLEET_r16.json")],
+             "--out", os.path.join(here, "FLEET_r19.json")],
             capture_output=True, text=True, timeout=900, env=env)
         if out.returncode == 0:
             fleet_summary = json.loads(out.stdout.strip().splitlines()[-1])
@@ -609,6 +612,27 @@ def main():
         assert fleet_summary["tokens_reconciled"], (
             "per-replica delivered-token counters no longer sum to "
             "the parent ledger's delivered total")
+        # Disaggregation's entry fee: shipping a cached prefix must
+        # beat recomputing it, every round — otherwise the
+        # prefill→decode handoff is pure overhead.
+        assert fleet_summary["handoff_beats_reprefill"], (
+            "KV handoff TTFT no longer beats re-prefill TTFT: "
+            f"win={fleet_summary['ttft_win_s']}s")
+        # And the split must pay at equal chips: the phase-specialized
+        # pair's SLO goodput (decode cadence protected from prefill
+        # burst interference) must not lose to 2 mixed replicas under
+        # the prefill-heavy two-class workload.
+        assert fleet_summary["disagg_beats_mixed"], (
+            "disagg fleet lost SLO goodput to mixed at equal chips: "
+            f"{fleet_summary['disagg_goodput_tokens_s']} < "
+            f"{fleet_summary['mixed_goodput_tokens_s']} tokens/s")
+        # Phase-specialized SIGKILL drills: killing a prefill child or
+        # a decode child mid-handoff must still deliver every id
+        # exactly once.
+        assert fleet_summary["disagg_kill_prefill_exactly_once"], (
+            "ids lost or duplicated after SIGKILL of a prefill replica")
+        assert fleet_summary["disagg_kill_decode_exactly_once"], (
+            "ids lost or duplicated after SIGKILL of a decode replica")
 
     # Elastic probe: kill 1 of 4 stages mid-run -> heartbeat detection,
     # re-plan to 3, buddy restore, and the bitwise pin against the

@@ -237,6 +237,14 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="fleet: replicate prefixes shared by at least "
                         "N live slots to a sibling proactively "
                         "(requires --kv paged; >= 2)")
+    p.add_argument("--roles", default=None,
+                   help="disaggregated fleet: comma-separated per-"
+                        "replica roles (prefill|decode|mixed), length "
+                        "== --replicas, e.g. 'prefill,decode,decode'. "
+                        "Requests then flow prefill -> KV handoff -> "
+                        "decode; 'auto' sizes the split with "
+                        "suggest_roles. Pair with --kv paged so decode "
+                        "replicas resume from shipped blocks")
     p.add_argument("--int8", action="store_true",
                    help="int8 weight-only quantized block weights")
     p.add_argument("--family", choices=["lm", "gpt2"], default="lm")
@@ -347,6 +355,27 @@ def main(argv=None) -> int:
               "--kv paged (the slab has no blocks to spill, share, or "
               "advertise)", file=sys.stderr)
         return 2
+    roles = None
+    if args.roles:
+        replicas_n = max(args.replicas, 1)
+        if args.roles == "auto":
+            from ..fleet import suggest_roles
+            roles = suggest_roles(
+                replicas_n,
+                prompt_len=max(len(p) for p in prompts),
+                max_new_tokens=args.max_new).roles
+        else:
+            roles = [r.strip() for r in args.roles.split(",")]
+        bad = [r for r in roles if r not in ("prefill", "decode", "mixed")]
+        if bad or len(roles) != replicas_n:
+            print(f"--roles must name one of prefill|decode|mixed per "
+                  f"replica ({replicas_n} expected, got {roles})",
+                  file=sys.stderr)
+            return 2
+        if replicas_n < 2:
+            print("--roles needs --replicas >= 2 (one replica cannot "
+                  "be split by phase)", file=sys.stderr)
+            return 2
     kv_kwargs = {} if args.kv == "slab" else {
         "kv_block_size": args.kv_block_size,
         "kv_pool_blocks": args.kv_pool_blocks,
@@ -413,8 +442,11 @@ def main(argv=None) -> int:
                   "--spec-tokens (children rebuild the model from the "
                   "spec + seed)", file=sys.stderr)
             return 2
-        from ..fleet import (FleetController, ProcessReplicaTransport,
-                             ReplicaSpec, RouterPolicy)
+        import dataclasses as _dc
+
+        from ..fleet import (DisaggController, FleetController,
+                             ProcessReplicaTransport, ReplicaSpec,
+                             RouterPolicy)
         spec = ReplicaSpec(
             lm_cfg={f: getattr(model_cfg, f)
                     for f in ("vocab", "d_model", "nhead", "d_ff",
@@ -432,11 +464,17 @@ def main(argv=None) -> int:
                 "kv_offload_blocks": args.kv_offload_blocks,
                 "kv_hot_refs": args.kv_hot_refs}
                if args.kv == "paged" else {}))
-        transports = [ProcessReplicaTransport(spec)
-                      for _ in range(replicas)]
+        if roles is not None:
+            transports = [
+                ProcessReplicaTransport(_dc.replace(spec, role=role))
+                for role in roles]
+        else:
+            transports = [ProcessReplicaTransport(spec)
+                          for _ in range(replicas)]
         queue = RequestQueue(capacity=args.queue_capacity,
                              policy=args.policy)
-        eng = FleetController(
+        ctl_cls = DisaggController if roles is not None else FleetController
+        eng = ctl_cls(
             transports, queue,
             policy=RouterPolicy(placement=args.placement,
                                 kv_hot_refs=args.kv_hot_refs),
@@ -459,15 +497,27 @@ def main(argv=None) -> int:
         engines = [ServeEngine(b,
                                RequestQueue(capacity=args.queue_capacity),
                                event_log=events,
-                               watchdog=_make_watchdog())
-                   for b in backends]
+                               watchdog=_make_watchdog(),
+                               phase=(roles[i] if roles is not None
+                                      else "mixed"))
+                   for i, b in enumerate(backends)]
         queue = RequestQueue(capacity=args.queue_capacity,
                              policy=args.policy)
         from ..serve import RouterPolicy
-        eng = Router(engines, queue, event_log=events,
-                     policy=RouterPolicy(placement=args.placement,
-                                         kv_hot_refs=args.kv_hot_refs),
-                     async_tick=(args.fleet == "thread"))
+        if roles is not None:
+            from ..fleet import DisaggController, InProcessTransport
+            eng = DisaggController(
+                [InProcessTransport(e,
+                                    async_tick=(args.fleet == "thread"))
+                 for e in engines],
+                queue, event_log=events,
+                policy=RouterPolicy(placement=args.placement,
+                                    kv_hot_refs=args.kv_hot_refs))
+        else:
+            eng = Router(engines, queue, event_log=events,
+                         policy=RouterPolicy(placement=args.placement,
+                                             kv_hot_refs=args.kv_hot_refs),
+                         async_tick=(args.fleet == "thread"))
     else:
         queue = RequestQueue(capacity=args.queue_capacity,
                              policy=args.policy)

@@ -29,13 +29,16 @@ from .control import (DRAINING, HEALTHY, RETIRED, SUSPECT, WEDGED,
                       FleetController, InProcessTransport, Replica,
                       ReplicaHealth, ReplicaTransport, RouterPolicy,
                       TransportError)
+from .disagg import DisaggController, RoleSuggestion, suggest_roles
 from .proc import (FleetSpawnError, ProcessReplicaTransport, ReplicaSpec,
                    check_spawn_capability)
-from .topology import carve_replica_meshes, replica_device_plan
+from .topology import (carve_replica_meshes, carve_role_meshes,
+                       replica_device_plan, role_device_plan)
 
-__all__ = ["FleetController", "ReplicaTransport", "InProcessTransport",
-           "Replica", "ReplicaHealth", "RouterPolicy", "TransportError",
+__all__ = ["FleetController", "DisaggController", "ReplicaTransport",
+           "InProcessTransport", "Replica", "ReplicaHealth", "RouterPolicy",
+           "TransportError", "RoleSuggestion", "suggest_roles",
            "ProcessReplicaTransport", "ReplicaSpec", "FleetSpawnError",
            "check_spawn_capability", "carve_replica_meshes",
-           "replica_device_plan",
+           "carve_role_meshes", "replica_device_plan", "role_device_plan",
            "HEALTHY", "SUSPECT", "WEDGED", "DRAINING", "RETIRED"]

@@ -371,8 +371,14 @@ class InProcessTransport(ReplicaTransport):
     """
 
     def __init__(self, engine: ServeEngine, *, async_tick: bool = False,
-                 tick_interval_s: float = 0.0):
+                 tick_interval_s: float = 0.0,
+                 role: Optional[str] = None):
         self.engine = engine
+        # phase role for disaggregated placement (fleet/disagg.py):
+        # defaults to the engine's own operating phase so an engine
+        # built prefill-only/decode-only advertises itself correctly
+        self.role = role if role is not None \
+            else getattr(engine, "phase", "mixed")
         self.async_tick = bool(async_tick)
         self._lock = threading.Lock()
         self._buffer: "deque[Response]" = deque()
@@ -497,8 +503,13 @@ class InProcessTransport(ReplicaTransport):
         exp = getattr(self.engine.backend, "export_prefix_payload", None)
         if exp is None:
             return None
-        # in-process: exact bytes (codec="raw"), no lossy serialization
-        return exp(prompt, codec="raw")
+        # in-process: exact bytes (codec="raw"), no lossy serialization.
+        # Must hold the tick lock: the async tick thread's decode step
+        # DONATES the pool buffers, and an export racing it reads a
+        # deleted buffer (the disagg controller exports from replicas
+        # that are still actively prefilling).
+        with self._lock:
+            return exp(prompt, codec="raw")
 
     def import_prefix(self, payload: dict) -> int:
         imp = getattr(self.engine.backend, "import_prefix_payload", None)
@@ -518,7 +529,8 @@ class InProcessTransport(ReplicaTransport):
         pool = getattr(self.engine.backend, "pool", None)
         if pool is None:
             return 0
-        return pool.cached_prefix_blocks(prompt)
+        with self._lock:
+            return pool.cached_prefix_blocks(prompt)
 
     def prefix_directory(self) -> Optional[dict]:
         pool = getattr(self.engine.backend, "pool", None)
@@ -557,6 +569,13 @@ class Replica:
     @property
     def engine(self):
         return getattr(self.transport, "engine", None)
+
+    @property
+    def role(self) -> str:
+        """The replica's phase role (``prefill``/``decode``/``mixed``),
+        as advertised by its transport. A transport that predates roles
+        reads as ``mixed`` — the serve-both-phases default."""
+        return getattr(self.transport, "role", "mixed")
 
     @property
     def load(self) -> int:
@@ -740,7 +759,15 @@ class FleetController:
 
     # -- delivery (the exactly-once ledger) --------------------------------
 
-    def _deliver(self, resp: Response) -> Response:
+    def _deliver(self, resp: Response) -> Optional[Response]:
+        """Record a terminal response in the exactly-once ledger and
+        return it. Subclasses may return None to CONSUME a response
+        instead of delivering it (the disaggregated controller swallows
+        the prefill phase's one-token terminal and re-enters the
+        request for its decode phase) — every caller that surfaces
+        responses must tolerate None. The base implementation never
+        returns None, and never intercepts the ``_finish_unplaced``
+        records (their status is never ``ok``)."""
         if resp.request_id in self._responses:
             raise RuntimeError(
                 f"duplicate terminal response for request "
@@ -840,6 +867,25 @@ class FleetController:
         return [r for r in self.replicas
                 if r.state == HEALTHY
                 and r.transport.queue_depth < r.transport.queue_capacity]
+
+    def _role_filter(self, req: Request,
+                     candidates: List[Replica]) -> List[Replica]:
+        """Restrict placement candidates by the request's phase.
+
+        A phase-tagged request (``req.phase`` set by the disaggregated
+        controller) wants its role pool — ``prefill`` requests go to
+        prefill replicas, ``decode`` requests to decode replicas — and
+        falls back to mixed replicas when the wanted pool is empty or
+        entirely sick. A phase-less request only ever lands on mixed
+        replicas: a prefill-only engine would reject its
+        ``max_new_tokens`` and a decode-only engine would refuse to
+        prefill it. In an all-mixed fleet (every deployment before
+        disaggregation) this is the identity filter."""
+        want = getattr(req, "phase", None)
+        if want in ("prefill", "decode"):
+            pool = [r for r in candidates if r.role == want]
+            return pool or [r for r in candidates if r.role == "mixed"]
+        return [r for r in candidates if r.role == "mixed"]
 
     def _choose(self, req: Request, candidates: List[Replica]) -> Replica:
         if self.policy.placement == "prefix":
@@ -1016,7 +1062,7 @@ class FleetController:
                         to_replica=sibling.index, bytes=nbytes)
 
     def _try_place(self, req: Request, now: float) -> bool:
-        candidates = self._placeable()
+        candidates = self._role_filter(req, self._placeable())
         if not candidates:
             return False
         rep = self._choose(req, candidates)
@@ -1071,7 +1117,9 @@ class FleetController:
         except Exception:
             salvaged = []
         for resp in salvaged:
-            self._pending_out.append(self._deliver(resp))
+            out = self._deliver(resp)
+            if out is not None:
+                self._pending_out.append(out)
         if salvaged:
             reg.counter("serve.fleet.salvaged").inc(len(salvaged))
         inflight = self._inflight_on(rep)
@@ -1323,7 +1371,9 @@ class FleetController:
                     self._placed_on.pop(req.id, None)
                     delivered.extend(self.reclaim([req], now))
                     continue
-                delivered.append(self._deliver(resp))
+                out = self._deliver(resp)
+                if out is not None:
+                    delivered.append(out)
 
         # 5) fleet gauges
         counts = self.counts()

@@ -103,6 +103,14 @@ class ReplicaSpec:
     gen: Dict[str, Any] = dataclasses.field(default_factory=dict)
     buckets: Optional[List[int]] = None
     decode_chunk: int = 1
+    # Disaggregated serving (fleet/disagg.py): the replica's phase
+    # role. "mixed" (default) serves whole requests — the PR 13
+    # behavior, byte-identical. "prefill" runs only the chunked-prefill
+    # program (requests arrive clamped to max_new_tokens=1 and retire
+    # at the first token); "decode" runs only the resident decode loop
+    # over prefixes seated by import_prefix — a decode-only engine
+    # refuses prompts with no cached prefix instead of re-prefilling.
+    role: str = "mixed"
     kv_block_size: Optional[int] = None
     kv_pool_blocks: Optional[int] = None
     kv_dtype: Optional[str] = None
@@ -315,9 +323,12 @@ class ProcessReplicaTransport(ReplicaTransport):
                  connect_timeout_s: float = 120.0,
                  rpc_timeout_s: float = 120.0,
                  reconnect_timeout_s: float = 5.0,
-                 executable: Optional[str] = None):
+                 executable: Optional[str] = None,
+                 bind_host: Optional[str] = None,
+                 advertise_host: Optional[str] = None):
         check_spawn_capability(executable)
         self.spec = spec
+        self.role = spec.role
         self.clock = clock or time.monotonic
         self._rpc_timeout_s = rpc_timeout_s
         self._reconnect_timeout_s = reconnect_timeout_s
@@ -349,16 +360,35 @@ class ProcessReplicaTransport(ReplicaTransport):
         self._obs_events: "deque[dict]" = deque(maxlen=50_000)
         self._frame_census: Dict[str, int] = {}
 
+        # The wire binds a real host/port: bind_host is the interface
+        # the parent listens on (default loopback — byte-identical to
+        # the PR 13 wire), advertise_host the address the child dials
+        # back to (defaults to bind_host, or loopback for the wildcard
+        # "0.0.0.0"/"::" binds, which are not dialable addresses). The
+        # reconnect/replay and heartbeat machinery is address-agnostic:
+        # the child re-dials whatever it was told.
+        self._bind_host = bind_host or "127.0.0.1"
+        if advertise_host is None:
+            advertise_host = ("127.0.0.1"
+                              if self._bind_host in ("0.0.0.0", "::")
+                              else self._bind_host)
+        self._advertise_host = advertise_host
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("127.0.0.1", 0))
+        try:
+            self._listener.bind((self._bind_host, 0))
+        except OSError as e:
+            self._listener.close()
+            raise FleetSpawnError(
+                f"cannot bind the fleet wire on {self._bind_host!r}: {e}")
         self._listener.listen(1)
         port = self._listener.getsockname()[1]
         self._token = base64.b64encode(os.urandom(12)).decode()
         exe = executable if executable is not None else sys.executable
         self._proc = subprocess.Popen(
             [exe, "-m", "pipe_tpu.fleet.proc",
-             "--port", str(port), "--token", self._token],
+             "--port", str(port), "--token", self._token,
+             "--host", self._advertise_host],
             env=_spawn_env(jax_platform=spec.jax_platform),
             stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
         try:
@@ -815,7 +845,8 @@ def _build_engine(spec: ReplicaSpec, event_log=None):
     wd = TickWatchdog() if spec.watchdog else None
     return ServeEngine(backend,
                        RequestQueue(capacity=spec.queue_capacity),
-                       watchdog=wd, event_log=event_log)
+                       watchdog=wd, event_log=event_log,
+                       phase=spec.role)
 
 
 def _child_op(engine, msg: dict, now: float):
@@ -888,15 +919,17 @@ def _heartbeat(engine, kv_hot_refs: Optional[int] = None) -> dict:
     return hb
 
 
-def worker(port: int, token: str) -> None:
+def worker(port: int, token: str, host: str = "127.0.0.1") -> None:
     """The replica process: connect back to the parent, build the
     engine from the spec frame, then self-tick — serve ops between
     ticks, stream terminal responses, heartbeat on an interval, and
-    re-dial the listener if the connection drops."""
+    re-dial the listener if the connection drops. ``host`` is the
+    parent's advertised address (loopback by default; a real interface
+    address for cross-host fleets)."""
     import selectors
 
     def dial() -> socket.socket:
-        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+        s = socket.create_connection((host, port), timeout=30)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_frame(s, {"op": "hello", "token": token})
         return s
@@ -1061,8 +1094,10 @@ def _main(argv: Optional[List[str]] = None) -> int:
                     "ProcessReplicaTransport; not a user entry point)")
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--token", required=True)
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="parent listener address to dial back to")
     args = ap.parse_args(argv)
-    worker(args.port, args.token)
+    worker(args.port, args.token, args.host)
     return 0
 
 

@@ -30,7 +30,10 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Sequence
 
-__all__ = ["ReplicaDevices", "replica_device_plan", "carve_replica_meshes"]
+__all__ = ["ReplicaDevices", "RoleReplicaDevices", "replica_device_plan",
+           "role_device_plan", "carve_replica_meshes", "carve_role_meshes"]
+
+_ROLES = ("prefill", "decode", "mixed")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,6 +97,109 @@ def replica_device_plan(n_replicas: int, n_stages: int,
     return [ReplicaDevices(index=i, start=i * per, stop=(i + 1) * per,
                            n_stages=n_stages, n_data=data)
             for i in range(n_replicas)]
+
+
+@dataclasses.dataclass(frozen=True)
+class RoleReplicaDevices(ReplicaDevices):
+    """One role-specialized replica's slice of the grid. Unlike the
+    symmetric plan, role plans are asymmetric by design: a prefill
+    replica typically takes a wide data axis (large-batch chunked
+    prefill is throughput-bound), a decode replica a deep slot count on
+    fewer chips (the resident ``while_loop`` is latency-bound), so
+    shares differ per replica."""
+
+    role: str = "mixed"
+
+
+def role_device_plan(specs: Sequence, *,
+                     n_devices: Optional[int] = None,
+                     devices_per_process: Optional[int] = None
+                     ) -> List[RoleReplicaDevices]:
+    """Carve the device grid into role-asymmetric contiguous sub-meshes.
+
+    ``specs`` is one entry per replica: ``(role, n_stages, n_data)``
+    tuples or ``{"role", "n_stages", "n_data"}`` dicts, in placement
+    order. Each replica owns exactly ``n_stages * n_data`` devices —
+    shares may differ between replicas (that is the point) — and the
+    plan must consume the grid exactly: ``sum(shares) == n_devices``.
+
+    Process alignment is the same discipline as the symmetric plan but
+    checked per-slice, because unequal shares can misalign even when
+    every share individually divides the process size: a replica either
+    fits inside one process (its slice does not cross a process
+    boundary) or owns whole processes (starts on a boundary and spans a
+    multiple of ``devices_per_process``).
+    """
+    if not specs:
+        raise ValueError("role_device_plan needs at least one replica spec")
+    norm: List[tuple] = []
+    for i, spec in enumerate(specs):
+        if isinstance(spec, dict):
+            role = spec.get("role", "mixed")
+            ns, nd = spec.get("n_stages", 1), spec.get("n_data", 1)
+        else:
+            role, ns, nd = spec
+        if role not in _ROLES:
+            raise ValueError(
+                f"replica {i}: role must be one of {_ROLES}, got {role!r}")
+        ns, nd = int(ns), int(nd)
+        if ns < 1 or nd < 1:
+            raise ValueError(
+                f"replica {i}: mesh shape {ns}x{nd} is not positive")
+        norm.append((str(role), ns, nd))
+    need = sum(ns * nd for _, ns, nd in norm)
+    if n_devices is None:
+        import jax
+        n_devices = len(jax.devices())
+    if need != n_devices:
+        raise ValueError(
+            f"role plan wants {need} devices (sum of n_stages*n_data "
+            f"over {len(norm)} replicas: "
+            f"{[ns * nd for _, ns, nd in norm]}) but the grid has "
+            f"{n_devices}")
+    plan: List[RoleReplicaDevices] = []
+    start = 0
+    dpp = devices_per_process
+    for i, (role, ns, nd) in enumerate(norm):
+        per = ns * nd
+        if dpp is not None and dpp > 0:
+            if per >= dpp:
+                if per % dpp or start % dpp:
+                    raise ValueError(
+                        f"replica {i} ({role}) owns {per} devices from "
+                        f"index {start}: a multi-process replica must "
+                        f"start on a process boundary and span whole "
+                        f"processes ({dpp} devices/process)")
+            elif start // dpp != (start + per - 1) // dpp:
+                raise ValueError(
+                    f"replica {i} ({role}) owns devices [{start}, "
+                    f"{start + per}) which straddle the process boundary "
+                    f"at {((start // dpp) + 1) * dpp} ({dpp} "
+                    f"devices/process): a sub-process replica must fit "
+                    f"inside one process")
+        plan.append(RoleReplicaDevices(index=i, start=start,
+                                       stop=start + per, n_stages=ns,
+                                       n_data=nd, role=role))
+        start += per
+    return plan
+
+
+def carve_role_meshes(specs: Sequence, *,
+                      devices: Optional[Sequence] = None,
+                      stage_across: bool = False) -> list:
+    """One ``(stage, data)`` mesh per role-specialized replica, carved
+    contiguously per :func:`role_device_plan` — index-aligned with the
+    plan, same axis discipline as :func:`carve_replica_meshes`."""
+    import jax
+
+    from ..runtime.distributed import global_pipeline_mesh
+    devices = list(devices if devices is not None else jax.devices())
+    plan = role_device_plan(specs, n_devices=len(devices))
+    return [global_pipeline_mesh(
+                rd.n_stages, rd.n_data,
+                devices=devices[rd.start:rd.stop],
+                stage_across=stage_across)
+            for rd in plan]
 
 
 def carve_replica_meshes(n_replicas: int, n_stages: int,

@@ -145,6 +145,34 @@ class GenerationConfig:
                 f"pick a block size dividing {span} or adjust "
                 f"max_new_tokens by {waste}")
 
+    def check_decode_headroom(self, prefix_len: int, max_new_tokens: int,
+                              bucket_max_len: int,
+                              spec_overshoot: int = 0) -> None:
+        """Decode-only serving (fleet/disagg.py): a decode replica
+        never prefills from scratch — its slot span was sized for
+        ``bucket_max_len + max_new_tokens (+ speculative headroom)``
+        at construction, so an imported prefix longer than the bucket
+        cap plus the request's ``max_new_tokens`` would run decode past
+        the last KV row. Reject it HERE, naming the overflow, instead
+        of letting the decode step silently clamp (the same
+        named-headroom discipline as :meth:`check_kv_headroom`)."""
+        span = int(bucket_max_len) + self.max_new_tokens + spec_overshoot
+        need = int(prefix_len) + int(max_new_tokens) + spec_overshoot
+        if need > span:
+            spec = (f" + speculative headroom {spec_overshoot}"
+                    if spec_overshoot else "")
+            raise ValueError(
+                f"decode-only: imported prefix {prefix_len} + "
+                f"max_new_tokens {max_new_tokens}{spec} = {need} rows "
+                f"exceeds the decode slot span bucket_max_len + "
+                f"max_new_tokens{spec} = {bucket_max_len} + "
+                f"{self.max_new_tokens}"
+                f"{' + ' + str(spec_overshoot) if spec_overshoot else ''}"
+                f" = {span} by {need - span} rows; shorten the prefix, "
+                f"lower the request's max_new_tokens, or size the "
+                f"decode replica's buckets for the prefill fleet's "
+                f"output lengths")
+
 
 def check_positions(model, prompt_len: int, max_new_tokens: int) -> None:
     """Fail loudly when decode would run past the positional table —

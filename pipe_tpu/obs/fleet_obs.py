@@ -54,7 +54,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .events import EventLog
 from .telemetry import Counter, EwmaTimer, Gauge, Histogram, \
-    MetricsRegistry, get_registry
+    MetricsRegistry, get_registry, labelled
 
 __all__ = ["TraceBuffer", "FleetObserver", "SloTargets", "SloMonitor",
            "prometheus_text", "STAGE_RANK"]
@@ -219,6 +219,7 @@ class FleetObserver:
             tr = rep.transport
             view: Dict[str, Any] = {
                 "state": rep.state,
+                "role": getattr(rep, "role", "mixed"),
                 "queue_depth": self._safe(lambda t=tr: t.queue_depth, 0),
                 "live_slots": self._safe(lambda t=tr: t.live_slots, 0),
                 "tokens_out": int(getattr(tr, "obs_tokens_out", 0)),
@@ -288,20 +289,72 @@ class FleetObserver:
                     shipped[0].snapshot(mergeable=True, base={}))
         return out
 
+    def role_rollup(self) -> Dict[str, Dict[str, Any]]:
+        """Per-role aggregation of the per-replica views — the number
+        disaggregation is judged by: the prefill pool's TTFT and the
+        decode pool's token output split out instead of averaged into
+        one fleet-wide blur. Per role: replica/HEALTHY counts, summed
+        load and output counters, bucket-merged ``ttft_sec`` /
+        ``token_sec`` means where a replica's metrics view carries them
+        (shipped replicas always do; in-process replicas share one
+        unlabelled registry, so their phase histograms can't be split
+        and read as None), plus the parent-side
+        ``serve.fleet.handoff_requests{role=...}`` counter."""
+        per = self.per_replica()
+        out: Dict[str, Dict[str, Any]] = {}
+        for rep in self.controller.replicas:
+            view = per[rep.index]
+            agg = out.setdefault(view["role"], {
+                "replicas": 0, "healthy": 0, "tokens_out": 0,
+                "responses_out": 0, "queue_depth": 0, "live_slots": 0,
+                "_ttft": None, "_token_sec": None})
+            agg["replicas"] += 1
+            if view["state"] == "healthy":
+                agg["healthy"] += 1
+            for k in ("tokens_out", "responses_out", "queue_depth",
+                      "live_slots"):
+                agg[k] += int(view[k] or 0)
+            m = view.get("metrics") or {}
+            for key, slot in (("serve.engine.ttft_sec", "_ttft"),
+                              ("serve.engine.token_sec", "_token_sec")):
+                s = m.get(key)
+                if isinstance(s, dict) and s.get("count"):
+                    cur = agg[slot]
+                    if cur is None:
+                        agg[slot] = {"count": int(s["count"]),
+                                     "sum": float(s.get("sum", 0.0))}
+                    else:
+                        cur["count"] += int(s["count"])
+                        cur["sum"] += float(s.get("sum", 0.0))
+        snap = get_registry().snapshot()
+        for role, agg in out.items():
+            for slot, name in (("_ttft", "ttft_mean_s"),
+                               ("_token_sec", "token_mean_s")):
+                s = agg.pop(slot)
+                agg[name] = (s["sum"] / s["count"]) if s else None
+            agg["handoff_requests"] = int(snap.get(
+                labelled("serve.fleet.handoff_requests", role=role), 0))
+        return out
+
     def reconcile(self) -> Dict[str, Any]:
         """The delivered-token reconciliation the drill asserts: the
         per-replica ``tokens_out`` counters (bumped at the instant each
         terminal response crossed into the control plane) must sum to
         the parent-observed delivered total — exactly-once made
-        visible in telemetry."""
+        visible in telemetry. A disaggregated controller additionally
+        reports the shadow tokens it consumed (each prefill phase's
+        one-token terminal, counted by the prefill replica's transport
+        but never client-delivered); they sit on the delivered side of
+        the balance."""
         per = {rep.index: int(getattr(rep.transport, "obs_tokens_out", 0))
                for rep in self.controller.replicas}
         delivered = sum(len(r.tokens)
                         for r in self.controller._responses.values())
+        shadow = int(getattr(self.controller, "obs_shadow_tokens", 0))
         total = sum(per.values())
         return {"per_replica_tokens_out": per, "tokens_out_sum": total,
-                "delivered_tokens": delivered,
-                "reconciled": total == delivered}
+                "delivered_tokens": delivered, "shadow_tokens": shadow,
+                "reconciled": total == delivered + shadow}
 
     # -- trace stitching ---------------------------------------------------
 

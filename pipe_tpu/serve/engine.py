@@ -1530,7 +1530,13 @@ class ServeEngine:
     def __init__(self, backend, queue: Optional[RequestQueue] = None,
                  *, event_log=None,
                  clock: Optional[Callable[[], float]] = None,
-                 watchdog=None, chaos=None, decode_error_limit: int = 3):
+                 watchdog=None, chaos=None, decode_error_limit: int = 3,
+                 phase: str = "mixed"):
+        if phase not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"phase must be 'mixed', 'prefill' or 'decode', got "
+                f"{phase!r}")
+        self.phase = phase
         self.backend = backend
         if queue is None:
             queue = RequestQueue(clock=clock or time.monotonic)
@@ -1573,6 +1579,7 @@ class ServeEngine:
                 "new work is admitted")
         if max_new_tokens is None:
             max_new_tokens = self.backend.gen.max_new_tokens
+        self._check_phase(prompt, max_new_tokens)
         self.backend.validate(len(prompt), max_new_tokens)
         try:
             req = self.queue.submit(prompt, max_new_tokens=max_new_tokens,
@@ -1597,12 +1604,49 @@ class ServeEngine:
             raise EngineDraining(
                 "engine is draining: live requests are finishing and no "
                 "new work is admitted")
+        self._check_phase(req.prompt, req.max_new_tokens)
         self.backend.validate(len(req.prompt), req.max_new_tokens)
         self.queue.requeue(req)
         req.attempts += 1
         reg.counter("serve.engine.placed").inc()
         reg.gauge("serve.engine.queue_depth").set(self.queue.depth)
         return req
+
+    def _check_phase(self, prompt: Sequence[int],
+                     max_new_tokens: int) -> None:
+        """Disaggregated operating modes (fleet/disagg.py). A prefill
+        replica serves ONLY the chunked-prefill program: requests must
+        arrive clamped to ``max_new_tokens=1`` (the first token retires
+        the slot straight off the prefill, leaving the prompt's prefix
+        blocks cached for export). A decode replica never prefills from
+        scratch: a prompt spanning at least one full KV block must have
+        its prefix already seated (``import_prefix_payload``) so the
+        admission prefill merely resumes from the cached frontier, and
+        the imported-prefix length must fit the decode slot span
+        (:meth:`~...inference.generate.GenerationConfig.check_decode_headroom`).
+        Mixed mode (default) changes nothing."""
+        if self.phase == "prefill" and max_new_tokens != 1:
+            raise ValueError(
+                f"prefill-only replica: requests must arrive clamped to "
+                f"max_new_tokens=1, got {max_new_tokens} — route the "
+                f"decode phase to a decode or mixed replica "
+                f"(fleet/disagg.py owns the split)")
+        if self.phase == "decode":
+            pool = getattr(self.backend, "pool", None)
+            if pool is not None:
+                buckets = getattr(self.backend, "buckets", None)
+                if buckets is not None:
+                    self.backend.gen.check_decode_headroom(
+                        len(prompt), max_new_tokens, buckets.max_len,
+                        getattr(self.backend, "_spec_overshoot", 0))
+                if (len(prompt) >= pool.block_size
+                        and pool.cached_prefix_blocks(prompt) == 0):
+                    raise ValueError(
+                        f"decode-only replica: no cached KV prefix for "
+                        f"this {len(prompt)}-token prompt — import the "
+                        f"prefill replica's blocks first "
+                        f"(import_prefix_payload) or route to a mixed "
+                        f"replica; decode replicas never re-prefill")
 
     def cancel(self, request_id: int) -> bool:
         return self.queue.cancel(request_id)

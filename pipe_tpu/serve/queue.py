@@ -77,6 +77,12 @@ class Request:
     cancelled: bool = False
     attempts: int = 0
     trace_id: Optional[str] = None
+    # Disaggregated serving (fleet/disagg.py): which phase this request
+    # currently wants — "prefill" (clamped to one token, routed to the
+    # prefill pool), "decode" (full generation resuming from shipped KV,
+    # routed to the decode pool), or None (whole request on a mixed
+    # replica — every pre-disaggregation deployment).
+    phase: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -517,3 +517,441 @@ def test_sigkilled_child_reports_dead_and_controller_fails_over():
         assert ctl.replicas[0].state == HEALTHY
     finally:
         ctl.close()
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode fleet (fleet/disagg.py)
+
+
+def _disagg_controller(roles, **policy_kw):
+    from pipe_tpu.fleet import DisaggController
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    policy_kw.setdefault("backoff_base_s", 0.0)
+    transports = [
+        InProcessTransport(
+            ServeEngine(FakeBackend(2),
+                        RequestQueue(capacity=32, clock=clock),
+                        watchdog=TickWatchdog(stuck_slack_ticks=None),
+                        phase=role))
+        for role in roles]
+    ctl = DisaggController(transports,
+                           RequestQueue(capacity=32, clock=clock),
+                           policy=RouterPolicy(**policy_kw))
+    return ctl, t
+
+
+def test_transport_role_defaults_from_engine_phase():
+    ctl, _ = _disagg_controller(("prefill", "decode", "mixed"))
+    assert [r.role for r in ctl.replicas] == ["prefill", "decode", "mixed"]
+    ctl.close()
+
+
+def test_disagg_two_phase_flow_delivers_exactly_once():
+    ctl, t = _disagg_controller(("prefill", "decode", "mixed"))
+    ids = [ctl.submit([3, 4, 5], max_new_tokens=4).id for _ in range(6)]
+    out = _run(ctl, t)
+    assert sorted(r.request_id for r in out) == sorted(ids)
+    assert all(r.status == "ok" for r in out)
+    # the client sees the FULL budget — never the one-token shadow
+    assert all(len(r.tokens) == 4 for r in out)
+    pre, dec, mix = (r.transport for r in ctl.replicas)
+    assert pre.obs_responses_out == 6, "every prefill ran on the pool"
+    assert dec.obs_responses_out == 6, "every decode ran on the pool"
+    assert mix.obs_responses_out == 0, "mixed untouched while pools healthy"
+    # shadow terminals were consumed, not delivered (one token each)
+    assert ctl.obs_shadow_tokens == 6
+    ctl.close()
+
+
+def test_disagg_reconciles_tokens_including_shadows():
+    from pipe_tpu.obs.fleet_obs import FleetObserver
+    ctl, t = _disagg_controller(("prefill", "decode"))
+    for _ in range(4):
+        ctl.submit([1, 2], max_new_tokens=3)
+    _run(ctl, t)
+    rec = FleetObserver(ctl).reconcile()
+    assert rec["shadow_tokens"] == 4
+    assert rec["reconciled"], rec
+    ctl.close()
+
+
+def test_disagg_no_decode_replica_serves_on_mixed_and_recovers():
+    from pipe_tpu.fleet import SUSPECT
+    ctl, t = _disagg_controller(("prefill", "decode", "mixed"),
+                                recover_healthy_ticks=10_000)
+    dec = ctl.replicas[1]
+    dec.state = SUSPECT                 # decode pool entirely sick
+    rid = ctl.submit([2, 3], max_new_tokens=4).id
+    out = _run(ctl, t)
+    assert [r.request_id for r in out] == [rid]
+    assert out[0].status == "ok" and len(out[0].tokens) == 4
+    assert dec.transport.obs_responses_out == 0
+    assert ctl.replicas[2].transport.obs_responses_out == 1, \
+        "decode phase fell back to the mixed replica"
+    # pool recovery: the replica returns HEALTHY and takes decode again
+    dec.state = HEALTHY
+    dec.healthy_streak = 0
+    rid2 = ctl.submit([4, 5], max_new_tokens=4).id
+    out2 = _run(ctl, t)
+    assert [r.request_id for r in out2] == [rid2]
+    assert dec.transport.obs_responses_out == 1, \
+        "recovered decode replica rejoined its role pool"
+    ctl.close()
+
+
+def test_disagg_no_role_replicas_at_all_parks_until_recovery():
+    # both role pools sick and no mixed replica: requests wait (parked /
+    # front) instead of dying, then serve when a pool recovers
+    from pipe_tpu.fleet import SUSPECT
+    ctl, t = _disagg_controller(("prefill", "decode"),
+                                recover_healthy_ticks=10_000)
+    ctl.replicas[0].state = SUSPECT
+    rid = ctl.submit([1, 2], max_new_tokens=2).id
+    for _ in range(5):
+        t[0] += 0.01
+        assert ctl.tick() == []
+    assert ctl.response(rid) is None, "request must not fail while sick"
+    ctl.replicas[0].state = HEALTHY
+    out = _run(ctl, t)
+    assert [r.request_id for r in out] == [rid]
+    assert out[0].status == "ok"
+    ctl.close()
+
+
+def test_phase_less_requests_only_land_on_mixed_replicas():
+    # a plain FleetController request (no phase tag) must never reach a
+    # prefill-only or decode-only engine — they would reject it
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    transports = [
+        InProcessTransport(
+            ServeEngine(FakeBackend(2),
+                        RequestQueue(capacity=32, clock=clock),
+                        watchdog=TickWatchdog(stuck_slack_ticks=None),
+                        phase=role))
+        for role in ("prefill", "mixed")]
+    ctl = FleetController(transports,
+                          RequestQueue(capacity=32, clock=clock),
+                          policy=RouterPolicy(backoff_base_s=0.0))
+    ids = [ctl.submit([1, 2], max_new_tokens=4).id for _ in range(4)]
+    out = _run(ctl, t)
+    assert sorted(r.request_id for r in out) == sorted(ids)
+    assert transports[0].obs_responses_out == 0
+    assert transports[1].obs_responses_out == 4
+    ctl.close()
+
+
+def test_prefill_only_engine_rejects_unclamped_requests():
+    eng = ServeEngine(FakeBackend(2), RequestQueue(), phase="prefill")
+    with pytest.raises(ValueError, match="prefill-only"):
+        eng.submit([1, 2, 3], max_new_tokens=4)
+    eng.submit([1, 2, 3], max_new_tokens=1)      # the clamped form
+
+
+def test_engine_rejects_unknown_phase():
+    with pytest.raises(ValueError, match="phase"):
+        ServeEngine(FakeBackend(2), RequestQueue(), phase="verify")
+
+
+def test_decode_headroom_validation_names_the_overflow():
+    from pipe_tpu.inference import GenerationConfig
+    gen = GenerationConfig(max_new_tokens=4)
+    gen.check_decode_headroom(16, 4, bucket_max_len=16)   # fits
+    with pytest.raises(ValueError) as ei:
+        gen.check_decode_headroom(60, 4, bucket_max_len=16)
+    msg = str(ei.value)
+    assert "decode-only" in msg
+    assert "60" in msg and "exceeds" in msg
+    assert "by 44 rows" in msg, "the overflow is named"
+
+
+def test_decode_only_engine_refuses_cold_multi_block_prompt(paged_pair):
+    eng = ServeEngine(paged_pair(), RequestQueue(), phase="decode")
+    prompt = [(i * 7) % 53 + 1 for i in range(16)]   # 2 full blocks
+    with pytest.raises(ValueError, match="decode-only"):
+        eng.submit(list(prompt), max_new_tokens=4)
+
+
+def test_decode_only_engine_serves_after_prefix_import(paged_pair):
+    prompt = [(i * 7) % 53 + 1 for i in range(16)]
+    home, dest = paged_pair(), paged_pair()
+    ref = _serve(home, prompt)
+    payload = home.export_prefix_payload(prompt, codec="raw")
+    assert dest.import_prefix_payload(payload) > 0
+    eng = ServeEngine(dest, RequestQueue(), phase="decode")
+    eng.submit(list(prompt), max_new_tokens=4, seed=0)
+    out = eng.run_until_idle()
+    assert len(out) == 1 and out[0].status == "ok"
+    assert out[0].tokens == ref, "decode from imported KV is bitwise"
+
+
+def _paged_disagg(paged_pair, roles):
+    from pipe_tpu.fleet import DisaggController
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    transports = [
+        _SeveredTransport(InProcessTransport(
+            ServeEngine(paged_pair(),
+                        RequestQueue(capacity=8, clock=clock),
+                        watchdog=TickWatchdog(stuck_slack_ticks=None),
+                        phase=role)))
+        for role in roles]
+    ctl = DisaggController(transports,
+                           RequestQueue(capacity=8, clock=clock),
+                           policy=RouterPolicy(backoff_base_s=0.0))
+    return ctl, t
+
+
+def test_disagg_ships_kv_and_decodes_from_imported_blocks(paged_pair):
+    ctl, t = _paged_disagg(paged_pair, ("prefill", "decode"))
+    prompt = [(i * 7) % 53 + 1 for i in range(16)]   # 2 full blocks
+    rid = ctl.submit(list(prompt), max_new_tokens=4, seed=0).id
+    out = _run(ctl, t)
+    assert [r.request_id for r in out] == [rid]
+    assert out[0].status == "ok" and len(out[0].tokens) == 4
+    dec = ctl.replicas[1].transport
+    assert dec.obs_responses_out == 1
+    assert dec.cached_prefix_blocks(prompt) == 2, \
+        "decode replica resumed from shipped blocks, not a re-prefill"
+    ctl.close()
+
+
+def test_disagg_prefill_death_mid_handoff_replaces_exactly_once(
+        paged_pair):
+    # the handoff race: the prefill replica dies after the prefix is
+    # cached (shadow consumed) but before the decode import completes —
+    # the export comes up dead, the ship is cold, the decode-only
+    # engine refuses, and the request re-places on the mixed replica
+    # for an ordinary prefill. Exactly one client terminal.
+    ctl, t = _paged_disagg(paged_pair, ("prefill", "decode", "mixed"))
+    prompt = [(i * 7) % 53 + 1 for i in range(16)]
+    req = ctl.submit(list(prompt), max_new_tokens=4, seed=0)
+    # run until the shadow is consumed (request flipped to decode)...
+    for _ in range(300):
+        t[0] += 0.01
+        ctl.tick()
+        if req.phase == "decode":
+            break
+    else:
+        raise AssertionError("prefill phase never completed")
+    # ...then kill the prefill replica's wire BEFORE decode placement
+    ctl.replicas[0].transport.severed = True
+    out = _run(ctl, t)
+    assert [r.request_id for r in out] == [req.id], "exactly one terminal"
+    assert out[0].status == "ok" and len(out[0].tokens) == 4
+    assert ctl.replicas[0].state == RETIRED
+    assert ctl.replicas[2].transport.obs_responses_out == 1, \
+        "mixed replica served the decode end-to-end after the cold ship"
+    ctl.close()
+
+
+def test_disagg_cold_ship_without_mixed_reprefills(paged_pair):
+    # a static prefill/decode fleet (no mixed replica anywhere): the
+    # cached prefix vanishes between the shadow and the decode
+    # placement (pool pressure evicted it — the apps/serve --tiny
+    # drive hits this with 12 requests against a 16-block pool). The
+    # cold ship makes the decode-only engine refuse, and with no mixed
+    # replica to re-prefill on, the request must flip BACK to its
+    # prefill phase for a fresh prefix rather than park forever.
+    ctl, t = _paged_disagg(paged_pair, ("prefill", "decode"))
+    prompt = [(i * 7) % 53 + 1 for i in range(16)]
+    req = ctl.submit(list(prompt), max_new_tokens=4, seed=0)
+    for _ in range(300):
+        t[0] += 0.01
+        ctl.tick()
+        if req.phase == "decode":
+            break
+    else:
+        raise AssertionError("prefill phase never completed")
+    assert ctl.replicas[0].transport.invalidate_prefix(prompt) > 0, \
+        "the prefix must actually be evicted for the drill to bite"
+    out = _run(ctl, t)
+    assert [r.request_id for r in out] == [req.id], "exactly one terminal"
+    assert out[0].status == "ok" and len(out[0].tokens) == 4
+    from pipe_tpu.obs.telemetry import get_registry
+    assert get_registry().snapshot()["serve.fleet.disagg_reprefill"] >= 1
+    # the second pass went through the full pipeline: fresh prefix on
+    # the prefill replica, shipped, decoded on the decode replica
+    assert ctl.replicas[1].transport.obs_responses_out == 1
+    assert ctl.obs_shadow_tokens == 2, "two shadow passes, one delivery"
+    ctl.close()
+
+
+def test_disagg_decode_death_before_import_ack_replaces_exactly_once(
+        paged_pair):
+    # the other half of the race: the DECODE replica dies between the
+    # export and its import ack — the ship degrades to cold, the
+    # controller drops the dead transport on the place attempt, and the
+    # request re-places (still exactly once) on the mixed replica
+    ctl, t = _paged_disagg(paged_pair, ("prefill", "decode", "mixed"))
+    prompt = [(i * 7) % 53 + 1 for i in range(16)]
+    req = ctl.submit(list(prompt), max_new_tokens=4, seed=0)
+    for _ in range(300):
+        t[0] += 0.01
+        ctl.tick()
+        if req.phase == "decode":
+            break
+    else:
+        raise AssertionError("prefill phase never completed")
+    ctl.replicas[1].transport.severed = True
+    out = _run(ctl, t)
+    assert [r.request_id for r in out] == [req.id], "exactly one terminal"
+    assert out[0].status == "ok" and len(out[0].tokens) == 4
+    assert ctl.replicas[1].state == RETIRED
+    assert ctl.replicas[2].transport.obs_responses_out == 1
+    ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# role-asymmetric topology
+
+
+def test_role_device_plan_asymmetric_contiguous():
+    from pipe_tpu.fleet import role_device_plan
+    plan = role_device_plan([("prefill", 1, 4), ("decode", 2, 1),
+                             ("decode", 2, 1)], n_devices=8)
+    assert [(rd.role, rd.start, rd.stop) for rd in plan] == \
+        [("prefill", 0, 4), ("decode", 4, 6), ("decode", 6, 8)]
+    assert plan[0].n_data == 4 and plan[1].n_stages == 2
+
+
+def test_role_device_plan_rejects_bad_inputs():
+    from pipe_tpu.fleet import role_device_plan
+    with pytest.raises(ValueError, match="role must be"):
+        role_device_plan([("verify", 1, 1)], n_devices=1)
+    with pytest.raises(ValueError, match="grid has"):
+        role_device_plan([("prefill", 1, 4), ("decode", 1, 2)],
+                         n_devices=8)
+    # unequal shares can misalign even when each share divides the
+    # process size: replica 1 starts at device 2 and would span [2, 6)
+    with pytest.raises(ValueError, match="process boundary"):
+        role_device_plan([("prefill", 1, 2), ("decode", 1, 4),
+                          ("decode", 1, 2)], n_devices=8,
+                         devices_per_process=4)
+    # aligned version of the same shapes passes
+    role_device_plan([("prefill", 1, 4), ("decode", 1, 2),
+                      ("decode", 1, 2)], n_devices=8,
+                     devices_per_process=4)
+
+
+def test_carve_role_meshes_on_local_devices():
+    import jax
+
+    from pipe_tpu.fleet import carve_role_meshes
+    devices = jax.devices()              # conftest forces 8 CPU devices
+    meshes = carve_role_meshes([("prefill", 1, 4), ("decode", 2, 2)],
+                               devices=devices)
+    assert len(meshes) == 2
+    assert meshes[0].devices.size == 4 and meshes[0].shape["data"] == 4
+    assert meshes[1].shape["stage"] == 2
+    assert set(meshes[0].devices.flatten()) == set(devices[:4])
+
+
+# ---------------------------------------------------------------------------
+# the cost-driven role planner
+
+
+def test_suggest_roles_sizes_split_from_phase_costs():
+    from pipe_tpu.fleet import suggest_roles
+    s = suggest_roles(4, prompt_len=64, max_new_tokens=16,
+                      prefill_token_s=2.0, decode_token_s=1.0)
+    assert s.source == "args"
+    assert s.roles == ["prefill", "prefill", "prefill", "decode"]
+    assert s.n_prefill == 3 and s.n_decode == 1
+    # decode-heavy workload flips the ratio, but neither pool empties
+    s2 = suggest_roles(4, prompt_len=8, max_new_tokens=64,
+                       prefill_token_s=1.0, decode_token_s=1.0)
+    assert s2.roles == ["prefill", "decode", "decode", "decode"]
+    assert 0.0 < s2.prefill_frac < 0.2
+
+
+def test_suggest_roles_single_replica_stays_mixed():
+    from pipe_tpu.fleet import suggest_roles
+    s = suggest_roles(1, prompt_len=32, max_new_tokens=32)
+    assert s.roles == ["mixed"] and s.n_prefill == 0
+
+
+def test_suggest_roles_reads_telemetry_histograms():
+    from pipe_tpu.fleet import suggest_roles
+    from pipe_tpu.obs.telemetry import MetricsRegistry
+    reg = MetricsRegistry()
+    # measured: 64-token prefill in 0.64s (10ms/token), 2ms/decode-token
+    for _ in range(10):
+        reg.histogram("serve.engine.ttft_sec").observe(0.64)
+        reg.histogram("serve.engine.token_sec").observe(0.002)
+    s = suggest_roles(4, prompt_len=64, max_new_tokens=32, registry=reg)
+    assert s.source == "telemetry"
+    assert s.prefill_token_s == pytest.approx(0.01)
+    assert s.n_prefill == 3, s        # prefill dominates 640ms vs 64ms
+    empty = suggest_roles(2, prompt_len=16, max_new_tokens=16,
+                          registry=MetricsRegistry())
+    assert empty.source == "uniform"
+    assert empty.roles == ["prefill", "decode"]
+
+
+# ---------------------------------------------------------------------------
+# TCP wire: replica bound on a real host/port (slow tier)
+
+
+@pytest.mark.slow
+def test_process_replica_on_bound_host_place_poll_reconnect_heartbeat():
+    # the acceptance drill: a replica reached via a bound host/port —
+    # not the loopback default — passes the place/poll, reconnect-with-
+    # RPC-replay and heartbeat contracts unchanged
+    from pipe_tpu.serve.queue import RequestQueue as RQ
+    q = RQ()
+    tr = ProcessReplicaTransport(_proc_spec(), bind_host="0.0.0.0")
+    try:
+        assert tr._bind_host == "0.0.0.0"
+        assert tr._advertise_host == "127.0.0.1"   # wildcard auto-map
+        req = q.submit([5, 6, 7], max_new_tokens=4, seed=0)
+        tr.place(req)
+        got = []
+        assert _wait(lambda: (got.extend(tr.poll()) or got), 120.0)
+        assert got[0].request_id == req.id and got[0].status == "ok"
+        h = tr.health()
+        assert h.alive and h.heartbeat_age_s < 5.0
+        tr.drop_connection()                       # reconnect + replay
+        req2 = q.submit([4, 5, 6], max_new_tokens=3, seed=1)
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                tr.place(req2)
+                break
+            except TransportError:
+                assert time.monotonic() < deadline, "never reconnected"
+                time.sleep(0.1)
+        got = []
+        assert _wait(lambda: (got.extend(tr.poll()) or got), 120.0)
+        assert got[0].request_id == req2.id and got[0].status == "ok"
+    finally:
+        tr.close()
+
+
+@pytest.mark.slow
+def test_process_replica_spec_role_reaches_child_engine():
+    # role ships through the spec handshake: a prefill-only child must
+    # reject an unclamped request over the wire with the engine's error
+    from pipe_tpu.serve.queue import RequestQueue as RQ
+    q = RQ()
+    tr = ProcessReplicaTransport(_proc_spec(role="prefill"))
+    try:
+        assert tr.role == "prefill"
+        bad = q.submit([1, 2, 3], max_new_tokens=4, seed=0)
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                with pytest.raises(ValueError, match="prefill-only"):
+                    tr.place(bad)
+                break
+            except TransportError:
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+        ok = q.submit([1, 2, 3], max_new_tokens=1, seed=0)
+        tr.place(ok)
+        got = []
+        assert _wait(lambda: (got.extend(tr.poll()) or got), 120.0)
+        assert got[0].request_id == ok.id and got[0].status == "ok"
+        assert len(got[0].tokens) == 1
+    finally:
+        tr.close()

@@ -1,7 +1,7 @@
-"""Fleet bench: goodput, kill-one-of-N failover, async ticks, KV handoff.
+"""Fleet bench: goodput, failover, async ticks, KV handoff, disagg.
 
-Four questions, answered with the tiny LM on whatever backend is
-available (the numbers of record are the committed ``FLEET_r16.json``):
+Seven questions, answered with the tiny LM on whatever backend is
+available (the numbers of record are the committed ``FLEET_r19.json``):
 
 1. **Scaling** — saturated fleet goodput (ok tokens/s through the
    controller's exactly-once ledger) at N = 1, 2, 3 replicas, over the
@@ -30,6 +30,32 @@ available (the numbers of record are the committed ``FLEET_r16.json``):
    (:meth:`FleetController._kv_handoff`) or re-prefills from scratch
    (export disabled). Measures TTFT of the first post-remap request
    both ways; the win is the prefill work the shipped blocks saved.
+   The summary's ``handoff_beats_reprefill`` flag IS the disagg
+   pipeline's entry fee: shipping a prefix must be cheaper than
+   recomputing it, every round.
+5. **Disagg vs mixed at equal chips** — 2 phase-specialized replicas
+   (one prefill-only, one decode-only, KV shipped between them by
+   :class:`~pipe_tpu.fleet.disagg.DisaggController`) against 2 mixed
+   replicas, same slots, under a prefill-heavy deadlined workload.
+   The metric is deadline goodput: ok tokens/s where ok means the
+   request finished inside its ``timeout_s``. A mixed replica's tick
+   interleaves multi-chunk host-blocking prefills with its decode
+   chunks, so decode latency inherits the prefill burst variance and
+   deadlines blow; the disagg decode replica's ticks hold only cheap
+   cached-prefix resumes and decode chunks. Both arms run per-replica
+   tick threads (the isolation async_tick exists to provide).
+6. **Disagg SIGKILL drills** — 4 real child processes (2 prefill +
+   2 decode), kill one PREFILL replica mid-stream, then (fresh fleet)
+   one DECODE replica. Either death lands mid-handoff for some
+   requests; the surviving role sibling absorbs the stream through
+   the one park-or-finish reclaim gate and every submitted id still
+   yields exactly one terminal — the exactly-once ledger, across the
+   phase boundary.
+7. **Saturation sweep** — steady-state goodput at N = 1..K replicas
+   over the chosen transport; reports the front-queue bottleneck N
+   (the smallest fleet within 10% of the sweep's best goodput) —
+   past it, added replicas buy nothing because the shared host / the
+   single front queue is the limit, not replica count.
 
 The kill trials also exercise the fleet observability plane
 (docs/observability.md, "Fleet observability"): the controller runs
@@ -47,7 +73,7 @@ on a contended host the absolute numbers are noise — the flag says so
 instead of letting the artifact lie.
 
 Usage:
-  python tools/fleet_bench.py                 # full run -> FLEET_r16.json
+  python tools/fleet_bench.py                 # full run -> FLEET_r19.json
   python tools/fleet_bench.py --quick --fleet proc   # bench.py embed
 Progress goes to stderr; the last stdout line is always the summary
 object, so ``bench.py`` embeds the --quick summary.
@@ -56,6 +82,7 @@ object, so ``bench.py`` embeds the --quick summary.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -67,7 +94,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
-from pipe_tpu.fleet import (FleetController, ProcessReplicaTransport,  # noqa: E402
+from pipe_tpu.fleet import (DisaggController, FleetController,  # noqa: E402
+                            InProcessTransport, ProcessReplicaTransport,
                             ReplicaSpec)
 from pipe_tpu.inference import GenerationConfig  # noqa: E402
 from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM  # noqa: E402
@@ -695,6 +723,326 @@ def prefix_placement_trial(repeats=3):
     }
 
 
+def _steady_state(router, make_req, duration_s, max_outstanding,
+                  pace_s=0.005, on_tick=None):
+    """Feed → measure → drain, with per-request wall latency. Keeps
+    ``max_outstanding`` requests in flight for ``duration_s``, then
+    drains to idle. ``make_req(i) -> (prompt, submit_kwargs)`` so a
+    workload can vary max_new/priority/timeout_s per class;
+    ``on_tick(now_s, router)`` is the chaos hook. Returns (records,
+    submitted, elapsed_s) where records are (request_id, status,
+    n_tokens, latency_s, t_deliver_s) — latency is wall
+    submit→delivery as the CLIENT sees it, which for a disagg fleet
+    spans prefill + KV handoff + decode."""
+    sub_t = {}
+    submitted, records = [], []  # (rid, status, ntok, wall_latency,
+    t0 = time.monotonic()        #  t_deliver, ttft, engine_latency)
+
+    def pump():
+        for r in router.tick():
+            now = time.monotonic() - t0
+            records.append((r.request_id, r.status, len(r.tokens),
+                            now - sub_t[r.request_id], now, r.ttft,
+                            r.latency))
+
+    i = 0
+    while time.monotonic() - t0 < duration_s:
+        while len(submitted) - len(records) < max_outstanding:
+            p, kw = make_req(i)
+            req = router.submit(p, seed=i, **kw)
+            sub_t[req.id] = time.monotonic() - t0
+            submitted.append(req.id)
+            i += 1
+        if on_tick is not None:
+            on_tick(time.monotonic() - t0, router)
+        pump()
+        time.sleep(pace_s)
+    deadline = time.monotonic() + 120.0
+    while not router.idle:
+        pump()
+        time.sleep(pace_s)
+        assert time.monotonic() < deadline, "drain never finished"
+    elapsed = time.monotonic() - t0
+    missing = [x for x in submitted if router.response(x) is None]
+    assert not missing, f"requests with no terminal response: {missing}"
+    return records, submitted, elapsed
+
+
+DOC_LEN, DOC_NEW = 128, 4        # prefill load: 16 chunks in, 4 tokens out
+CHAT_LEN, CHAT_NEW = 16, 32      # decode load: 2 chunks in, 32 tokens out
+DISAGG_SLOTS = 3                 # per-replica slots in the disagg trial
+
+
+def disagg_trial(seed=11, duration_s=4.0, deadline_s=0.28,
+                 max_outstanding=8):
+    """Disagg vs mixed at equal chips, scored as SLO goodput.
+
+    Both arms: 2 replicas x DISAGG_SLOTS slots, per-replica tick
+    threads, paged KV, a priority front queue with tiny engine queues
+    (waiting happens where priority exists), and the same
+    prefill-heavy two-class workload — "doc" requests (128-token
+    prompt, 4 new tokens: pure chunked-prefill load, no SLO)
+    interleaved 2:1 with "chat" requests (16-token prompt, 32 new
+    tokens: decode load, priority, a decode-phase SLO). A chat scores
+    its tokens only if its decode duration — ``Response.latency -
+    Response.ttft``, the time its 16 decode chunks actually took —
+    lands inside ``deadline_s``; chats carry 8x a doc's tokens, so
+    the arm that protects decode cadence wins goodput. This is the
+    DistServe framing: disaggregation trades first-token latency
+    (the handoff hop; docs/fleet.md says so openly) for
+    decode-latency SLO attainment, and the SLO is what this trial
+    scores.
+
+    The structural difference under measurement: a mixed engine's
+    tick is run-to-completion — admissions first, each doc's full
+    16-chunk prefill host-blocking, then ONE decode chunk for the
+    live set — so every one of a chat's decode chunks queues behind
+    whatever doc prefill bursts land that tick, and the chat's decode
+    duration inflates at the MEDIAN, not just the tail. The disagg
+    arm pins doc prefills to the prefill-only replica; the
+    decode-only replica's tick thread issues the chat's chunks
+    (resuming from the shipped prefix blocks) with nothing heavier
+    than another chat in front. Same chips, same work — the decode
+    interference is what the split removes."""
+    hcfg = LMConfig(vocab=67, d_model=64, nhead=2, d_ff=128,
+                    n_layers=4, seq_len=160, dropout=0.0)
+    model = PipelinedLM(hcfg, 1)
+    params = model.init(jax.random.key(8))
+    gen_cfg = GenerationConfig(max_new_tokens=CHAT_NEW, temperature=0.0)
+
+    def engine(phase):
+        be = SingleDeviceSlotBackend(
+            model, params, num_slots=DISAGG_SLOTS, max_len=160,
+            gen=gen_cfg, kv_block_size=8, kv_pool_blocks=256,
+            prefill_chunk=8, decode_chunk=2)
+        # tiny engine queue: waiting happens at the PRIORITY front
+        # queue (chats jump docs) instead of fifo behind a replica —
+        # placement backpressure is what makes priority mean anything
+        return ServeEngine(be, RequestQueue(capacity=2), phase=phase)
+
+    def fleet(roles):
+        trs = [InProcessTransport(engine(r), async_tick=True)
+               for r in roles]
+        cls = DisaggController if set(roles) != {"mixed"} \
+            else FleetController
+        return cls(trs, RequestQueue(capacity=256, policy="priority"),
+                   policy=RouterPolicy(backoff_base_s=0.0))
+
+    out = {}
+    for arm, roles in (("mixed", ("mixed", "mixed")),
+                       ("disagg", ("prefill", "decode"))):
+        rng = np.random.RandomState(seed)
+        docs = [rng.randint(1, hcfg.vocab, size=DOC_LEN).tolist()
+                for _ in range(64)]
+        chats = [rng.randint(1, hcfg.vocab, size=CHAT_LEN).tolist()
+                 for _ in range(64)]
+        kind_of = {}
+
+        def make_req(i, _k=kind_of, _d=docs, _c=chats):
+            # 2 docs : 1 chat — the prefill-heavy skew
+            if i % 3 == 2:
+                _k[i] = "chat"
+                return _c[i // 3 % len(_c)], dict(
+                    max_new_tokens=CHAT_NEW, priority=1)
+            _k[i] = "doc"
+            return _d[i % len(_d)], dict(max_new_tokens=DOC_NEW)
+
+        ctl = fleet(roles)
+        try:
+            # warm through the CONTROLLER so each arm compiles exactly
+            # the programs it will run: the mixed engines both classes'
+            # full prefills + decode chunks, the disagg pair the
+            # clamped prefill AND the destination's cached-prefix
+            # resume. Each class served twice per round so the
+            # resume-from-cache trace compiles too.
+            for wp, mn in ((docs[0], DOC_NEW), (chats[0], CHAT_NEW)):
+                for _ in range(2):
+                    for _ in range(2):
+                        ctl.submit(wp, max_new_tokens=mn, seed=7)
+                    run_to_idle(ctl, pace_s=0.005)
+            records, submitted, elapsed = _steady_state(
+                ctl, make_req, duration_s, max_outstanding)
+        finally:
+            ctl.close()
+        idx_of = {rid: i for i, rid in enumerate(submitted)}
+
+        def decode_s(r):
+            return None if r[5] is None else max(r[6] - r[5], 0.0)
+
+        view = {}
+        ok_toks = 0
+        for kind in ("doc", "chat"):
+            recs = [r for r in records
+                    if kind_of[idx_of[r[0]]] == kind]
+            ok = [r for r in recs if r[1] == "ok"]
+            if kind == "chat":      # SLO-scored: decode cadence held
+                good = [r for r in ok if decode_s(r) is not None
+                        and decode_s(r) <= deadline_s]
+            else:                   # docs carry no SLO
+                good = ok
+            ok_toks += sum(r[2] for r in good)
+            e2e = sorted(r[3] for r in ok)
+            dec = sorted(d for d in (decode_s(r) for r in ok)
+                         if d is not None)
+            view[kind] = {
+                "requests": len(recs),
+                "ok": len(ok),
+                "slo_ok": len(good),
+                "slo_ok_frac": round(len(good) / max(len(recs), 1),
+                                     4),
+                "e2e_p50_s": round(e2e[len(e2e) // 2], 4)
+                if e2e else None,
+                "decode_p50_s": round(dec[len(dec) // 2], 4)
+                if dec else None,
+                "decode_max_s": round(dec[-1], 4) if dec else None,
+            }
+        by_status = {}
+        for r in records:
+            by_status[r[1]] = by_status.get(r[1], 0) + 1
+        out[arm] = {
+            "replicas": len(roles),
+            "roles": list(roles),
+            "slots_total": len(roles) * DISAGG_SLOTS,
+            "requests": len(submitted),
+            "responses_by_status": by_status,
+            "elapsed_s": round(elapsed, 3),
+            "goodput_tokens_s": round(ok_toks / max(elapsed, 1e-9),
+                                      1),
+            "doc": view["doc"],
+            "chat": view["chat"],
+        }
+    out["workload"] = {
+        "doc": {"prompt_len": DOC_LEN, "max_new": DOC_NEW},
+        "chat": {"prompt_len": CHAT_LEN, "max_new": CHAT_NEW,
+                 "decode_slo_s": deadline_s, "priority": 1},
+        "mix": "2 docs : 1 chat", "max_outstanding": max_outstanding,
+        "duration_s": duration_s}
+    out["disagg_beats_mixed"] = bool(
+        out["disagg"]["goodput_tokens_s"]
+        >= out["mixed"]["goodput_tokens_s"])
+    return out
+
+
+def disagg_kill_trial_proc(kill_role, seed, kill_after_s=2.0,
+                           duration_s=6.0, max_outstanding=8):
+    """SIGKILL one phase-specialized child mid-stream. 4 real
+    processes — 2 prefill + 2 decode — under a DisaggController; every
+    request crosses the prefill→handoff→decode boundary, and the kill
+    lands while some are mid-crossing (shadow delivered but decode not
+    yet placed, or decode in flight). The surviving role sibling must
+    absorb the stream through the reclaim gate: all ids delivered
+    exactly once, goodput recovers after the kill, and the
+    shadow-aware token reconciliation still balances."""
+    roles = ("prefill", "prefill", "decode", "decode")
+    kill_idx = 1 if kill_role == "prefill" else 3
+    trace_buf = TraceBuffer(maxlen=200_000)
+    ctl = DisaggController(
+        [ProcessReplicaTransport(dataclasses.replace(proc_spec(),
+                                                     role=r))
+         for r in roles],
+        RequestQueue(capacity=256),
+        policy=RouterPolicy(backoff_base_s=0.0,
+                            heartbeat_timeout_s=5.0),
+        event_log=trace_buf)
+    rng = np.random.RandomState(seed)
+    work = make_workload(4096, rng)
+    kill_t = [None]
+
+    def on_tick(now, router):
+        if kill_t[0] is None and now >= kill_after_s:
+            router.replicas[kill_idx].transport._proc.kill()
+            kill_t[0] = now
+
+    try:
+        warm(ctl, len(roles))
+        records, submitted, elapsed = _steady_state(
+            ctl, lambda i: (work[i % len(work)][0],
+                            {"max_new_tokens": work[i % len(work)][1]}),
+            duration_s, max_outstanding, on_tick=on_tick)
+        states = ctl.counts()
+    finally:
+        ctl.close()
+    obs = obs_report(FleetObserver(ctl, parent_events=trace_buf.drain()),
+                     submitted)
+    assert kill_t[0] is not None, "run too short to reach the kill"
+    kt = kill_t[0]
+
+    def rate(lo, hi):
+        return sum(r[2] for r in records
+                   if r[1] == "ok" and lo <= r[4] < hi) \
+            / max(hi - lo, 1e-9)
+
+    w = min(1.0, kt, (elapsed - kt) / 2)
+    before, during, after = (rate(kt - w, kt), rate(kt, kt + w),
+                             rate(kt + w, elapsed))
+    by_status = {}
+    for r in records:
+        by_status[r[1]] = by_status.get(r[1], 0) + 1
+    return {
+        "roles": list(roles),
+        "killed_replica": kill_idx,
+        "killed_role": kill_role,
+        "kill_mode": "sigkill_process",
+        "kill_at": round(kt, 3),
+        "window": round(w, 3),
+        "rate_unit": "tokens/s",
+        "requests": len(submitted),
+        "elapsed_s": round(elapsed, 3),
+        "rate_before": round(before, 2),
+        "rate_failover": round(during, 2),
+        "rate_after": round(after, 2),
+        "recovered_frac": round(after / max(before, 1e-9), 3),
+        "survived_failover": during > 0.0 or after > 0.0,
+        "responses_by_status": by_status,
+        "exactly_once": len(records) == len(submitted),
+        "replica_states": states,
+        "obs": obs,
+    }
+
+
+def saturation_trial(model, params, fleet, counts, seed,
+                     duration_s=3.0, max_outstanding=12):
+    """Steady-state goodput at N = counts[0]..counts[-1] replicas over
+    the chosen transport, all replicas fed from the ONE front queue.
+    Reports the front-queue bottleneck N: the smallest fleet within
+    10% of the sweep's best goodput — past it, added replicas buy
+    nothing (on this shared-core host the engines contend for the
+    same processor, so the knee lands early; on a pod each replica
+    owns its chips and the knee is where the front queue's
+    single-threaded placement loop saturates)."""
+    rng = np.random.RandomState(seed)
+    work = make_workload(4096, rng)
+    sweep = []
+    for n in counts:
+        router = make_fleet(model, params, n, fleet=fleet)
+        try:
+            warm(router, n)
+            records, submitted, elapsed = _steady_state(
+                router,
+                lambda i: (work[i % len(work)][0],
+                           {"max_new_tokens": work[i % len(work)][1]}),
+                duration_s, max_outstanding)
+        finally:
+            router.close()
+        ok = [r for r in records if r[1] == "ok"]
+        sweep.append({
+            "replicas": n,
+            "slots_total": n * SLOTS,
+            "requests": len(submitted),
+            "ok": len(ok),
+            "elapsed_s": round(elapsed, 3),
+            "goodput_tokens_s": round(
+                sum(r[2] for r in ok) / max(elapsed, 1e-9), 1),
+        })
+    best = max(s["goodput_tokens_s"] for s in sweep)
+    sat = next(s["replicas"] for s in sweep
+               if s["goodput_tokens_s"] >= 0.9 * best)
+    return {"transport": fleet, "rate_unit": "tokens/s",
+            "duration_s_per_point": duration_s,
+            "max_outstanding": max_outstanding, "sweep": sweep,
+            "best_goodput_tokens_s": best, "saturation_n": sat}
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
@@ -742,18 +1090,45 @@ def main():
     placement = prefix_placement_trial(repeats=2 if args.quick else 3)
     log(f"   {placement}")
 
+    log("== disagg vs mixed at equal chips (prefill-heavy, deadlined)")
+    disagg = disagg_trial(seed=args.seed + 3,
+                          duration_s=3.0 if args.quick else 6.0)
+    log(f"   {disagg}")
+
+    log("== disagg SIGKILL drills: one prefill, then one decode (proc)")
+    disagg_kills = {}
+    for role in ("prefill", "decode"):
+        disagg_kills[role] = disagg_kill_trial_proc(role, args.seed + 4)
+        log(f"   kill {role}: {disagg_kills[role]}")
+
+    log(f"== saturation sweep [{args.fleet}]: front-queue bottleneck")
+    saturation = saturation_trial(
+        model, params, args.fleet, (1, 2, 3) if args.quick
+        else (1, 2, 3, 4), args.seed + 5,
+        duration_s=2.5 if args.quick else 4.0)
+    log(f"   {saturation}")
+
     stitch = kill["obs"]["trace_stitch"]
+    handoff_beats_reprefill = bool(
+        handoff["ttft_handoff_s"] < handoff["ttft_reprefill_s"])
+    disagg_kills_ok = all(
+        k["exactly_once"] and k["survived_failover"]
+        and k["obs"]["reconcile"]["reconciled"]
+        for k in disagg_kills.values())
     ok = bool(kill["exactly_once"] and kill["survived_failover"]
               and kill["recovered_frac"] > 0.3
               and straggler["async_beats_serial"]
               and handoff["handoff_moved_blocks"]
+              and handoff_beats_reprefill
               and placement["placement_found_prefix"]
               and placement["hot_chain_replicated"]
+              and disagg["disagg_beats_mixed"]
+              and disagg_kills_ok
               and kill["obs"]["reconcile"]["reconciled"]
               and stitch["frac"] == 1.0
               and stitch["exactly_once"])
     summary = {
-        "bench": "fleet", "rev": "r16",
+        "bench": "fleet", "rev": "r19",
         "quick": bool(args.quick),
         "fleet": args.fleet,
         "platform": jax.default_backend(),
@@ -767,6 +1142,9 @@ def main():
         "async_vs_serial": straggler,
         "kv_handoff": handoff,
         "kv_prefix_placement": placement,
+        "disagg_vs_mixed": disagg,
+        "disagg_kill_drills": disagg_kills,
+        "saturation": saturation,
         "fleet_ok": ok,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
@@ -788,6 +1166,17 @@ def main():
             "async_beats_serial": straggler["async_beats_serial"],
             "ttft_win_s": handoff["ttft_win_s"],
             "handoff_moved_blocks": handoff["handoff_moved_blocks"],
+            "handoff_beats_reprefill": handoff_beats_reprefill,
+            "disagg_goodput_tokens_s":
+                disagg["disagg"]["goodput_tokens_s"],
+            "mixed_goodput_tokens_s":
+                disagg["mixed"]["goodput_tokens_s"],
+            "disagg_beats_mixed": disagg["disagg_beats_mixed"],
+            "disagg_kill_prefill_exactly_once":
+                disagg_kills["prefill"]["exactly_once"],
+            "disagg_kill_decode_exactly_once":
+                disagg_kills["decode"]["exactly_once"],
+            "saturation_n": saturation["saturation_n"],
             "placement_ttft_win_s": placement["ttft_win_s"],
             "placement_found_prefix":
                 placement["placement_found_prefix"],

@@ -29,7 +29,7 @@ import urllib.request
 
 __all__ = ["render", "fetch"]
 
-_COLS = ("replica", "state", "depth", "live", "tokens_out",
+_COLS = ("replica", "role", "state", "depth", "live", "tokens_out",
          "responses", "obs_seq", "stale")
 
 
@@ -56,11 +56,19 @@ def render(fleet, slo, title: str = "fleet_top") -> str:
     ``/slo`` verdict. Pure — no I/O, no clock."""
     rows = []
     tok_sum = resp_sum = 0
+    by_role = {}
     for idx in sorted(fleet, key=lambda k: int(k)):
         v = fleet[idx]
         tok_sum += int(v.get("tokens_out") or 0)
         resp_sum += int(v.get("responses_out") or 0)
-        rows.append((str(idx), str(v.get("state", "?")),
+        role = str(v.get("role", "mixed"))
+        agg = by_role.setdefault(role, {"n": 0, "healthy": 0, "tokens": 0,
+                                        "responses": 0})
+        agg["n"] += 1
+        agg["healthy"] += int(v.get("state") == "healthy")
+        agg["tokens"] += int(v.get("tokens_out") or 0)
+        agg["responses"] += int(v.get("responses_out") or 0)
+        rows.append((str(idx), role, str(v.get("state", "?")),
                      str(v.get("queue_depth", "-")),
                      str(v.get("live_slots", "-")),
                      str(v.get("tokens_out", 0)),
@@ -77,6 +85,14 @@ def render(fleet, slo, title: str = "fleet_top") -> str:
     for r in rows:
         lines.append("  ".join(x.ljust(w) for x, w in zip(r, widths)))
     lines.append(f"fleet: tokens_out={tok_sum} responses={resp_sum}")
+    # role rollup lines only when the fleet is actually disaggregated —
+    # an all-mixed fleet would just repeat the totals
+    if len(by_role) > 1:
+        for role in sorted(by_role):
+            agg = by_role[role]
+            lines.append(
+                f"role {role}: {agg['healthy']}/{agg['n']} healthy  "
+                f"tokens_out={agg['tokens']} responses={agg['responses']}")
     obs = slo.get("observed", {})
     if obs:
         lines.append(
