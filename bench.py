@@ -582,7 +582,7 @@ def main():
             [sys.executable, os.path.join(here, "tools",
                                           "fleet_bench.py"), "--quick",
              "--fleet", "proc",
-             "--out", os.path.join(here, "FLEET_r19.json")],
+             "--out", os.path.join(here, "FLEET_r20.json")],
             capture_output=True, text=True, timeout=900, env=env)
         if out.returncode == 0:
             fleet_summary = json.loads(out.stdout.strip().splitlines()[-1])
@@ -633,6 +633,25 @@ def main():
             "ids lost or duplicated after SIGKILL of a prefill replica")
         assert fleet_summary["disagg_kill_decode_exactly_once"], (
             "ids lost or duplicated after SIGKILL of a decode replica")
+        # Round-20 wire hardening: a timed partition on one replica's
+        # proc wire must heal losslessly (retained-frame replay, seq
+        # dedup), and a corrupt-frame storm must be rejected whole at
+        # the CRC — never half-parsed — with every id still answered
+        # exactly once.
+        assert fleet_summary["partition_heals_exactly_once"], (
+            "ids lost or duplicated across a 2s wire partition")
+        assert fleet_summary["corrupt_storm_ok"], (
+            "wire corruption storm lost ids or never tripped the CRC: "
+            f"rejects={fleet_summary['wire_crc_rejects']}")
+        # Round-20 tentpole: SIGKILL the CONTROLLER mid-stream, rebuild
+        # it from the fsync'd request journal, re-dial the orphaned
+        # children in rejoin mode — exactly one terminal per id across
+        # the two controller lives, mixed and disagg fleets both.
+        assert fleet_summary["ctl_restart_exactly_once"], (
+            "ids lost or duplicated across a controller SIGKILL+restart")
+        assert fleet_summary["ctl_restart_disagg_exactly_once"], (
+            "ids lost or duplicated across a disagg controller "
+            "SIGKILL+restart")
 
     # Elastic probe: kill 1 of 4 stages mid-run -> heartbeat detection,
     # re-plan to 3, buddy restore, and the bitwise pin against the
@@ -688,23 +707,27 @@ def main():
         print(f"plan probe failed: {e}", file=sys.stderr)
 
     # Chaos smoke lane: the pytest-marked elastic drill (kill stage 1/4,
-    # resumed loss trajectory vs the unkilled run) as the repo's own
-    # test suite runs it — the bench proves the committed test passes,
-    # not just the bench-local drill.
+    # resumed loss trajectory vs the unkilled run) plus one wire-chaos
+    # drill (corrupt frame rejected whole at the framing layer) as the
+    # repo's own test suite runs them — the bench proves the committed
+    # tests pass, not just the bench-local drills.
     chaos_smoke = None
     try:
         import subprocess
-        smoke_test = os.path.join(
-            "tests", "test_elastic.py") + \
-            "::test_elastic_drill_loss_trajectory"
+        smoke_tests = [
+            os.path.join("tests", "test_elastic.py")
+            + "::test_elastic_drill_loss_trajectory",
+            os.path.join("tests", "test_fleet_journal.py")
+            + "::test_wire_corrupt_frame_is_rejected_whole_never_half_parsed",
+        ]
         env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
         t0 = time.time()
         out = subprocess.run(
             [sys.executable, "-m", "pytest", "-m", "chaos", "-q",
-             "-p", "no:cacheprovider", smoke_test],
+             "-p", "no:cacheprovider"] + smoke_tests,
             capture_output=True, text=True, timeout=900, env=env,
             cwd=here)
-        chaos_smoke = {"ok": out.returncode == 0, "test": smoke_test,
+        chaos_smoke = {"ok": out.returncode == 0, "tests": smoke_tests,
                        "wall_s": round(time.time() - t0, 1)}
         if out.returncode != 0:
             print(f"chaos smoke rc={out.returncode}: "

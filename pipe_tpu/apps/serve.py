@@ -39,7 +39,7 @@ child events) as JSONL. ``--fleet`` picks the replica transport:
 Usage:
     python -m pipe_tpu.apps.serve [--resume DIR] [--requests N --rate R]
         [--prompts-file F] [--slots S] [--stages N] [--replicas N]
-        [--fleet inproc|thread|proc]
+        [--fleet inproc|thread|proc] [--journal DIR]
         [--eos ID] [--queue-capacity C] [--policy fifo|priority]
         [--timeout-s T] [--decode-chunk K] [--events F.jsonl] [--tiny]
         [--metrics-port P] [--trace-out F.jsonl]
@@ -184,6 +184,11 @@ def build_argparser() -> argparse.ArgumentParser:
                         "pre-traced ladder (single-device backend only)")
     p.add_argument("--events", default=None,
                    help="write the request-span EventLog here (.jsonl)")
+    p.add_argument("--journal", default=None,
+                   help="directory for the durable request journal "
+                        "(fsync'd lifecycle WAL; a crashed controller "
+                        "restarts from it via FleetController."
+                        "from_journal). --fleet proc only")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve the merged fleet registry on "
                         "127.0.0.1:<port>: /metrics (Prometheus text), "
@@ -433,6 +438,16 @@ def main(argv=None) -> int:
         return TickWatchdog(tick_budget_s=args.tick_budget_s,
                             shed_ewma_threshold=args.shed_ewma)
 
+    journal = None
+    if args.journal and not (replicas > 1 and args.fleet == "proc"):
+        # the journal exists to recover a crashed fleet controller; the
+        # in-process engines die WITH their controller, so journaling
+        # them would promise a restart that cannot happen
+        print("--journal requires --fleet proc with --replicas > 1 "
+              "(only the process fleet survives its controller)",
+              file=sys.stderr)
+        return 2
+
     if replicas > 1 and args.fleet == "proc":
         # process fleet: each replica a fresh interpreter built from a
         # plain-data spec — only the deterministic-init lm family can be
@@ -473,12 +488,21 @@ def main(argv=None) -> int:
                           for _ in range(replicas)]
         queue = RequestQueue(capacity=args.queue_capacity,
                              policy=args.policy)
+        if args.journal:
+            from ..fleet import RequestJournal
+            journal = RequestJournal(args.journal)
         ctl_cls = DisaggController if roles is not None else FleetController
         eng = ctl_cls(
             transports, queue,
             policy=RouterPolicy(placement=args.placement,
                                 kv_hot_refs=args.kv_hot_refs),
-            event_log=events)
+            event_log=events, journal=journal)
+        if journal is not None:
+            # journal each child's wire coordinates (and refresh the
+            # fleet.json snapshot) so a restarted controller can
+            # re-dial the RUNNING children instead of spawning
+            for i, tr in enumerate(transports):
+                journal.record_replica(i, **tr.rejoin_info())
     elif replicas > 1:
         # in-process fleet: one front queue, N engines each with its own
         # queue/watchdog, the Router in between. The single-replica path
@@ -646,6 +670,11 @@ def main(argv=None) -> int:
                 events.flush()
                 summary["fleet"]["trace_records"] = \
                     observer.write_stitched(args.trace_out)
+    if journal is not None:
+        # the loop above ran to quiescence (drain included): everything
+        # submitted is terminal, so stamp clean_shutdown — a restart on
+        # this journal skips reconciliation entirely
+        journal.close(clean=True)
     print(json.dumps({"summary": summary}))
     events.close()
     if metrics_server is not None:

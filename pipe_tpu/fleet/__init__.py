@@ -30,6 +30,7 @@ from .control import (DRAINING, HEALTHY, RETIRED, SUSPECT, WEDGED,
                       ReplicaHealth, ReplicaTransport, RouterPolicy,
                       TransportError)
 from .disagg import DisaggController, RoleSuggestion, suggest_roles
+from .journal import JournalState, RequestJournal
 from .proc import (FleetSpawnError, ProcessReplicaTransport, ReplicaSpec,
                    check_spawn_capability)
 from .topology import (carve_replica_meshes, carve_role_meshes,
@@ -38,6 +39,7 @@ from .topology import (carve_replica_meshes, carve_role_meshes,
 __all__ = ["FleetController", "DisaggController", "ReplicaTransport",
            "InProcessTransport", "Replica", "ReplicaHealth", "RouterPolicy",
            "TransportError", "RoleSuggestion", "suggest_roles",
+           "RequestJournal", "JournalState",
            "ProcessReplicaTransport", "ReplicaSpec", "FleetSpawnError",
            "check_spawn_capability", "carve_replica_meshes",
            "carve_role_meshes", "replica_device_plan", "role_device_plan",

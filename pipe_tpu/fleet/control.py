@@ -606,7 +606,8 @@ class FleetController:
                  policy: RouterPolicy = RouterPolicy(),
                  spawn_fn: Optional[Callable[[], ReplicaTransport]] = None,
                  event_log=None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 journal=None):
         transports = list(transports)
         if not transports:
             raise ValueError(
@@ -622,6 +623,7 @@ class FleetController:
         self.clock = queue.clock
         self.policy = policy
         self.spawn_fn = spawn_fn
+        self.journal = journal
         self.events = event_log if event_log is not None else NULL_EVENT_LOG
         self.replicas: List[Replica] = []
         for tr in transports:
@@ -676,6 +678,16 @@ class FleetController:
         except QueueFull:
             reg.counter("serve.fleet.rejected").inc()
             raise
+        if self.journal is not None:
+            # journaled BEFORE the request becomes placeable: a crash
+            # from here on replays it from the WAL
+            self.journal.append(
+                "submit", request=req.id, prompt=list(req.prompt),
+                max_new_tokens=req.max_new_tokens, seed=req.seed,
+                priority=req.priority, trace=req.trace_id,
+                session=None if session is None else str(session),
+                remaining_s=(None if req.deadline is None
+                             else req.deadline - self.clock()))
         self._tracked[req.id] = req
         if session is not None:
             self._session_of[req.id] = str(session)
@@ -738,7 +750,11 @@ class FleetController:
 
     @property
     def idle(self) -> bool:
+        # undrained salvaged responses (_pending_out) are still work:
+        # a caller that gates its tick loop on idle must not conclude
+        # the fleet is done while deliveries sit in the hand-off buffer
         return (self.queue.depth == 0 and not self._parked
+                and not self._pending_out
                 and all(r.state == RETIRED or r.transport.idle
                         for r in self.replicas))
 
@@ -757,6 +773,139 @@ class FleetController:
             except Exception:
                 pass
 
+    # -- crash recovery (restart from the journal) -------------------------
+
+    @classmethod
+    def from_journal(cls, state, transports: Sequence[ReplicaTransport],
+                     queue: Optional[RequestQueue] = None, *,
+                     journal=None, policy: RouterPolicy = RouterPolicy(),
+                     spawn_fn=None, event_log=None,
+                     clock: Optional[Callable[[], float]] = None
+                     ) -> "FleetController":
+        """Rebuild a controller after a crash: ``state`` is the
+        replayed WAL (:meth:`~.journal.RequestJournal.recover`) and
+        ``transports`` the re-dialed surviving children (rejoin-mode
+        :class:`~.proc.ProcessReplicaTransport`, index-aligned with the
+        journal's replica records). The exactly-once ledger, retry
+        parks and phase tags come back from the journal; placements are
+        reconciled against what each child actually still holds —
+        still live there → adopted in place, finished during the outage
+        → its replayed response salvaged and delivered, gone → parked
+        for immediate re-placement. Pass a fresh ``journal`` on the
+        same path to keep the WAL growing through the new life."""
+        ctl = cls(transports, queue, policy=policy, spawn_fn=spawn_fn,
+                  event_log=event_log, clock=clock, journal=journal)
+        ctl._restore(state)
+        return ctl
+
+    def _restore(self, state) -> None:
+        import itertools
+        from ..serve.queue import Request, Response
+        reg = get_registry()
+        now = self.clock()
+        # never reuse a journaled id: the front queue's sequence resumes
+        # past everything the previous life handed out
+        self.queue._seq = itertools.count(state.max_request_id + 1)
+        # terminal stubs: ids the previous life already answered. A
+        # replica replaying one of their responses — or a recovered
+        # placement racing to finish one — must still trip the
+        # duplicate-delivery raise, so the ledger gets a stub per id.
+        for rid, rec in state.terminal.items():
+            self._responses[rid] = Response(
+                request_id=rid, tokens=[], status=rec.get("status", "ok"),
+                finish_reason=rec.get("finish_reason", "eos"),
+                prompt_len=0, ttft=None, latency=0.0)
+        if state.clean:
+            # the log ends with clean_shutdown: nothing was in flight
+            self.events.event("resilience", action="controller_restart",
+                              clean=True, terminal=len(state.terminal))
+            return
+        # what does each surviving child still hold? (rejoin-mode
+        # transports answer over the wire; anything else has no state)
+        live_ids: Dict[int, set] = {}
+        buffered: Dict[int, set] = {}
+        for rep in self.replicas:
+            tr = rep.transport
+            fn = getattr(tr, "remote_request_ids", None)
+            if fn is not None:
+                try:
+                    live_ids[rep.index] = set(fn())
+                except TransportError:
+                    live_ids[rep.index] = set()
+            fn = getattr(tr, "orphan_response_ids", None)
+            if fn is not None:
+                buffered[rep.index] = set(fn())
+        orphans = 0
+        adopted = 0
+        for rid in state.orphans:
+            rec = state.requests[rid]
+            req = Request(
+                id=rid, prompt=list(rec["prompt"]),
+                max_new_tokens=int(rec["max_new_tokens"]),
+                seed=int(rec.get("seed", 0)),
+                priority=int(rec.get("priority", 0)),
+                deadline=(None if rec.get("remaining_s") is None
+                          else now + float(rec["remaining_s"])),
+                submitted_at=now, trace_id=rec.get("trace"))
+            req.attempts = state.attempts.get(rid, 0)
+            orphans += 1
+            self._tracked[rid] = req
+            sess = rec.get("session")
+            if sess is not None:
+                self._session_of[rid] = sess
+            self._restore_phase(req, state)
+            target = state.placed_on.get(rid)
+            if target is not None and target < len(self.replicas) \
+                    and (rid in live_ids.get(target, ())
+                         or rid in buffered.get(target, ())):
+                adopt = getattr(self.replicas[target].transport,
+                                "adopt", None)
+                if adopt is not None:
+                    adopt(req)
+                    self._placed_on[rid] = target
+                    adopted += 1
+                    continue
+            # not live anywhere we can see: park, eligible immediately
+            self._parked.append((now, req))
+        # responses the children replayed for ids that were NOT
+        # adopted: journaled terminals are duplicates (drop), tracked
+        # orphans are work that finished during the outage (salvage)
+        salvaged = 0
+        for rep in self.replicas:
+            seal = getattr(rep.transport, "seal_rejoin", None)
+            if seal is None:
+                continue
+            for resp in seal():
+                if resp.request_id in self._responses:
+                    continue
+                if resp.request_id in self._tracked:
+                    out = self._salvage(rep, resp)
+                    if out is not None:
+                        self._pending_out.append(out)
+                        salvaged += 1
+        self._parked = [(t, r) for t, r in self._parked
+                        if r.id not in self._responses]
+        reg.counter("serve.fleet.recovered_orphans").inc(orphans)
+        reg.counter("serve.fleet.recovered_adopted").inc(adopted)
+        if salvaged:
+            reg.counter("serve.fleet.salvaged").inc(salvaged)
+        self.events.event("resilience", action="controller_restart",
+                          clean=False, terminal=len(state.terminal),
+                          orphans=orphans, adopted=adopted,
+                          salvaged=salvaged, parked=len(self._parked))
+
+    def _restore_phase(self, req: Request, state) -> None:
+        """Phase-tag hook for recovery — the base controller has no
+        phases. :class:`~.disagg.DisaggController` overrides."""
+
+    def _salvage(self, rep: Replica, resp: Response):
+        """One response ``rep``'s child replayed for a tracked orphan:
+        for the base controller every replica is terminal-producing,
+        so it IS the finished work of the outage — deliver it.
+        :class:`~.disagg.DisaggController` overrides to tell replayed
+        shadow frames apart from genuine decode terminals."""
+        return self._deliver(resp)
+
     # -- delivery (the exactly-once ledger) --------------------------------
 
     def _deliver(self, resp: Response) -> Optional[Response]:
@@ -772,6 +921,12 @@ class FleetController:
             raise RuntimeError(
                 f"duplicate terminal response for request "
                 f"{resp.request_id} (exactly-once delivery violated)")
+        if self.journal is not None:
+            # the exactly-once hinge: durable before the ledger record,
+            # so a restart can never answer this id a second time
+            self.journal.append(
+                "deliver", request=resp.request_id, status=resp.status,
+                finish_reason=resp.finish_reason, tokens=len(resp.tokens))
         self._responses[resp.request_id] = resp
         req = self._tracked.pop(resp.request_id, None)
         self._session_of.pop(resp.request_id, None)
@@ -854,6 +1009,9 @@ class FleetController:
         p = self.policy
         delay = min(p.backoff_base_s * (2.0 ** max(req.attempts - 1, 0)),
                     p.backoff_max_s)
+        if self.journal is not None:
+            self.journal.append("park", request=req.id,
+                                attempts=req.attempts, delay_s=delay)
         self._parked.append((now + delay, req))
         get_registry().counter("serve.fleet.retried").inc()
         self.events.event("resilience", action="retry_parked",
@@ -1071,6 +1229,10 @@ class FleetController:
             home = self._session_map.get(sess)
             if home is not None and home != rep.index:
                 self._kv_handoff(req, sess, home, rep)
+        if self.journal is not None:
+            self.journal.append("place", request=req.id,
+                                replica=rep.index,
+                                attempts=req.attempts + 1)
         try:
             rep.transport.place(req)        # increments req.attempts
         except TransportError:
@@ -1381,6 +1543,14 @@ class FleetController:
             reg.gauge(f"serve.fleet.replicas_{state}").set(n)
         reg.gauge("serve.fleet.front_depth").set(self.queue.depth)
         reg.gauge("serve.fleet.parked").set(len(self._parked))
+        if self.journal is not None:
+            reg.gauge("serve.fleet.journal_records").set(
+                self.journal.records_written)
+            reg.gauge("serve.fleet.journal_bytes").set(
+                self.journal.bytes_written)
+            age = self.journal.fsync_age_s
+            if age is not None:
+                reg.gauge("serve.fleet.journal_fsync_age_s").set(age)
         for rep in self.replicas:
             tr = rep.transport
             reg.gauge(labelled("serve.fleet.replica.state",

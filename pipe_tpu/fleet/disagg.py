@@ -135,6 +135,15 @@ class DisaggController(FleetController):
         exactly-once hinge: the request is no longer "in flight" on the
         prefill replica, so a later transport drop there reclaims
         nothing for it."""
+        if self.journal is not None:
+            # durable BEFORE the hinge: a crash after this record knows
+            # the request crossed into its decode phase (and where the
+            # prefix lives), a crash before it replays the prefill
+            self.journal.append(
+                "shadow", request=req.id,
+                src=self._placed_on.get(req.id),
+                max_new_tokens=self._orig_max_new.get(
+                    req.id, req.max_new_tokens))
         src = self._placed_on.pop(req.id, None)
         if src is not None:
             self._prefill_on[req.id] = src
@@ -155,6 +164,45 @@ class DisaggController(FleetController):
                           attempts=req.attempts,
                           tokens=len(resp.tokens))
         return None
+
+    # -- crash recovery ----------------------------------------------------
+
+    def _restore_phase(self, req, state) -> None:
+        """Rebuild the disagg tags for one recovered orphan. The
+        journal's ``submit`` record carries the FULL budget (the base
+        controller journals before this class clamps), so: a ``shadow``
+        record means the request already crossed the hinge — restore
+        the budget and re-enter as its decode phase, remembering the
+        prefix source; no shadow record means the prefill never
+        finished — re-clamp to one token and replay the prefill."""
+        self._orig_max_new[req.id] = req.max_new_tokens
+        rec = state.shadow.get(req.id)
+        if rec is not None:
+            req.phase = "decode"
+            src = rec.get("src")
+            if src is not None:
+                self._prefill_on[req.id] = int(src)
+        else:
+            req.phase = "prefill"
+            req.max_new_tokens = 1
+
+    def _salvage(self, rep, resp):
+        """Replayed responses are phase-ambiguous on a disagg fleet: a
+        prefill child's retained window holds SHADOW frames, and a
+        shadow for a request whose hinge is already journaled is a
+        duplicate — consuming it again would restart the decode phase
+        a decode child may be about to answer. Only a shadow the crash
+        interrupted (the request still tagged ``prefill``) is progress;
+        everything else from a prefill child is dropped, and decode
+        children salvage as usual."""
+        req = self._tracked.get(resp.request_id)
+        if resp.status == "ok" and (req is None or req.phase != "prefill"):
+            if rep.role == "prefill":
+                return None           # prefill children never hold terminals
+            if (rep.role == "mixed" and len(resp.tokens) <= 1
+                    and req is not None and req.max_new_tokens > 1):
+                return None           # a mixed child's replayed shadow
+        return self._deliver(resp)
 
     # -- decode placement (KV ship + fallbacks) ----------------------------
 

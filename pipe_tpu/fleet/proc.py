@@ -13,7 +13,17 @@ Length-prefixed frames over one loopback TCP connection per replica
 (the child connects back to the parent's listener, so the parent never
 needs to guess a child port, and reconnect is child-initiated):
 
-* frame = 4-byte big-endian payload length + payload;
+* frame = 4-byte big-endian length + 4-byte CRC32 + 4-byte sequence
+  number + payload (the length covers crc+seq+payload). The CRC is
+  over seq+payload: a corrupt frame raises :class:`FrameCorrupt` and
+  is treated as a broken CONNECTION — drop, re-dial, replay — never a
+  half-parsed RPC. Sequence numbers are per-direction monotonic
+  (``seq=0`` marks unsequenced control frames: hello/spec/ready/
+  shutdown and the lossy heartbeat stream); a receiver suppresses
+  ``seq <= last_seen``, so frames replayed after a reconnect — the
+  parent's pending-RPC replay, the child's retained-response replay —
+  and chaos-duplicated frames are deduplicated instead of
+  double-delivered;
 * payload = msgpack (JSON + base64 fallback when msgpack is absent)
   of one message dict; numpy arrays ride an explicit
   ``{"__nd__": dtype, shape, data}`` envelope, so KV-handoff payloads
@@ -42,6 +52,29 @@ controller then reclaims the in-flight requests from its OWN ledger
 (the authoritative map; a late response for a reclaimed id is dropped
 here, never delivered twice).
 
+Per-RPC deadlines: inside the total ``rpc_timeout_s`` window, ``_rpc``
+re-sends its frame on an exponential-backoff schedule
+(``rpc_retry_base_s`` doubling up to ``rpc_retry_max_s``, jittered
+deterministically from the rpc id) — a frame lost to a delay spike or
+a partition recovers without waiting out the whole window, and the
+re-sent frame carries the SAME sequence number, so the child either
+suppresses it or re-serves the cached reply.
+
+Adversarial wire chaos: pass a :class:`~..resilience.chaos.ChaosPlan`
+with ``wire_partition``/``wire_delay``/``wire_corrupt``/``wire_dup``
+faults (indexed by OUTGOING parent frame count, replica-addressed via
+``Fault.stage``) and the transport injects them at the framing layer —
+see :func:`apply_wire_chaos`.
+
+Controller restart: ``rejoin={"port", "token", "pid", ...}`` (from
+:meth:`ProcessReplicaTransport.rejoin_info`, journaled at spawn)
+re-binds the SAME listener port with the SAME token and adopts the
+*running* child instead of spawning — the child's ordinary reconnect
+loop re-dials the reborn listener and replays its retained response
+frames. Responses for ids the new parent has not adopted yet are
+buffered (``adopt``/``seal_rejoin``) so the journal's recovery pass
+can salvage work that finished while no controller was alive.
+
 The child ticks ITSELF — the async-tick contract. The controller's
 ``poll()`` just drains what the reader thread buffered.
 """
@@ -58,7 +91,8 @@ import subprocess
 import sys
 import threading
 import time
-from collections import deque
+import zlib
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -75,7 +109,7 @@ except Exception:                                 # pragma: no cover
     HAVE_MSGPACK = False
 
 __all__ = ["ProcessReplicaTransport", "ReplicaSpec", "FleetSpawnError",
-           "check_spawn_capability"]
+           "FrameCorrupt", "apply_wire_chaos", "check_spawn_capability"]
 
 
 class FleetSpawnError(RuntimeError):
@@ -258,10 +292,25 @@ def _unpack(buf: bytes) -> dict:
     return json.loads(buf.decode(), object_hook=hook)
 
 
+class FrameCorrupt(OSError):
+    """A frame failed its CRC32. Raised by :func:`recv_frame` and
+    treated by both wire ends as a broken CONNECTION — the stream is
+    severed and replayed on a fresh dial, so a corrupt frame can never
+    surface as a half-parsed RPC or a mangled response."""
+
+
+def _frame(buf: bytes, seq: int = 0) -> bytes:
+    """Wrap one packed payload: length | crc32(seq+payload) | seq |
+    payload, with the length prefix covering crc+seq+payload."""
+    seq_bytes = struct.pack(">I", seq)
+    crc = zlib.crc32(seq_bytes + buf) & 0xFFFFFFFF
+    return struct.pack(">II", 8 + len(buf), crc) + seq_bytes + buf
+
+
 def send_frame(sock: socket.socket, msg: dict,
-               lock: Optional[threading.Lock] = None) -> bytes:
-    buf = _pack(msg)
-    frame = struct.pack(">I", len(buf)) + buf
+               lock: Optional[threading.Lock] = None, *,
+               seq: int = 0) -> bytes:
+    frame = _frame(_pack(msg), seq)
     if lock is not None:
         with lock:
             sock.sendall(frame)
@@ -272,7 +321,9 @@ def send_frame(sock: socket.socket, msg: dict,
 
 def recv_frame(sock: socket.socket) -> Optional[dict]:
     """One frame, or None on clean EOF. Raises OSError on a broken
-    connection mid-frame."""
+    connection mid-frame and :class:`FrameCorrupt` (an OSError) on a
+    checksum mismatch. A nonzero sequence number is surfaced to the
+    dispatcher as ``msg["_seq"]`` for duplicate suppression."""
     head = _recv_exact(sock, 4)
     if head is None:
         return None
@@ -280,7 +331,16 @@ def recv_frame(sock: socket.socket) -> Optional[dict]:
     body = _recv_exact(sock, n)
     if body is None:
         raise OSError("connection closed mid-frame")
-    return _unpack(body)
+    if n < 8:
+        raise FrameCorrupt(f"frame too short for crc+seq header ({n}B)")
+    (crc,) = struct.unpack(">I", body[:4])
+    if zlib.crc32(body[4:]) & 0xFFFFFFFF != crc:
+        raise FrameCorrupt("frame checksum mismatch")
+    (seq,) = struct.unpack(">I", body[4:8])
+    msg = _unpack(body[8:])
+    if seq and isinstance(msg, dict):
+        msg["_seq"] = seq
+    return msg
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -293,6 +353,47 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
         chunks.append(c)
         got += len(c)
     return b"".join(chunks)
+
+
+# ---------------------------------------------------------------------------
+# adversarial wire chaos (the framing-layer injection point)
+
+
+def apply_wire_chaos(plan, index: int, frame: bytes,
+                     replica: int = 0) -> Tuple[List[bytes], float]:
+    """Transform one OUTGOING frame per the plan's ``wire_*`` faults
+    covering frame ``index`` to ``replica`` (``Fault.stage``). Returns
+    ``(frames, partition_s)``:
+
+    * ``wire_delay``   — sleep ``magnitude`` seconds first (capped 5s);
+    * ``wire_corrupt`` — flip the frame's last byte AFTER the checksum
+      was computed, so the receiver's CRC rejects it;
+    * ``wire_dup``     — the frame twice (the receiver's sequence
+      suppression must collapse them);
+    * ``wire_partition`` — ``([], magnitude)``: the frame is lost with
+      the connection and the caller severs the wire for ``magnitude``
+      seconds (capped 30s) before accepting the re-dial.
+
+    With no covering fault (or no plan) the frame passes untouched —
+    the zero-overhead pledge at this layer is one attribute check.
+    """
+    if plan is None or not plan:
+        return [frame], 0.0
+    wire_fault = getattr(plan, "wire_fault", None)
+    if wire_fault is None:
+        return [frame], 0.0
+    f = wire_fault("wire_partition", index, replica)
+    if f is not None:
+        return [], min(max(float(f.magnitude), 0.0), 30.0)
+    f = wire_fault("wire_delay", index, replica)
+    if f is not None:
+        time.sleep(min(max(float(f.magnitude), 0.0), 5.0))
+    frames = [frame]
+    if wire_fault("wire_corrupt", index, replica) is not None:
+        frames = [frame[:-1] + bytes([frame[-1] ^ 0xFF])]
+    if wire_fault("wire_dup", index, replica) is not None:
+        frames = frames * 2
+    return frames, 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -313,6 +414,50 @@ def _raise_remote(name: str, msg: str):
     raise cls(msg)
 
 
+class _ExternalChild:
+    """Popen-shaped handle over a child THIS parent did not spawn — the
+    controller-restart rejoin adopts a running replica process by pid.
+    ``poll``/``wait``/``kill`` go through ``os.kill`` (signal 0 probes
+    liveness); with no pid recorded the child is assumed alive and only
+    the wire can prove otherwise."""
+
+    def __init__(self, pid: Optional[int]):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self.stderr = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        if self.pid is None:
+            return None
+        try:
+            os.kill(self.pid, 0)
+        except (ProcessLookupError, PermissionError):
+            self.returncode = -1
+            return self.returncode
+        return None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("replica-child",
+                                                timeout or 0)
+            time.sleep(0.02)
+        return self.returncode
+
+    def kill(self) -> None:
+        if self.pid is None:
+            return
+        import signal
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 class ProcessReplicaTransport(ReplicaTransport):
     """One replica behind a real OS process. Spawn-time cost is a full
     interpreter + jit warmup per replica — this transport is for fleets
@@ -323,18 +468,49 @@ class ProcessReplicaTransport(ReplicaTransport):
                  connect_timeout_s: float = 120.0,
                  rpc_timeout_s: float = 120.0,
                  reconnect_timeout_s: float = 5.0,
+                 rpc_retry_base_s: float = 2.0,
+                 rpc_retry_max_s: float = 30.0,
+                 rpc_retry_jitter: float = 0.25,
                  executable: Optional[str] = None,
                  bind_host: Optional[str] = None,
-                 advertise_host: Optional[str] = None):
-        check_spawn_capability(executable)
+                 advertise_host: Optional[str] = None,
+                 chaos=None, chaos_replica: int = 0,
+                 rejoin: Optional[dict] = None):
+        if rejoin is None:
+            check_spawn_capability(executable)
         self.spec = spec
         self.role = spec.role
         self.clock = clock or time.monotonic
         self._rpc_timeout_s = rpc_timeout_s
         self._reconnect_timeout_s = reconnect_timeout_s
+        self._rpc_retry_base_s = rpc_retry_base_s
+        self._rpc_retry_max_s = rpc_retry_max_s
+        self._rpc_retry_jitter = rpc_retry_jitter
         self.rpc_inflight = 0
         self.rpc_retries = 0
         self.handoff_bytes = 0
+        # wire hardening state: per-direction sequence counters, the
+        # chaos injection plan, and the counters the drills gate on
+        self.chaos = chaos
+        self.chaos_replica = int(chaos_replica)
+        self._wire_index = 0          # outgoing frame index (chaos key)
+        self._partition_until = 0.0   # accept-hold horizon (wire_partition)
+        # parent->child seqs fold a random per-incarnation epoch into
+        # the header's high 12 bits: a restarted controller's frames
+        # land under a FRESH epoch, so the child resets its dedup
+        # window and reply cache instead of mistaking the new parent's
+        # rpc ids for the dead parent's (stale cached replies)
+        self._epoch = (int.from_bytes(os.urandom(2), "big") % 4095) + 1
+        self._send_seq = 0            # parent->child sequence counter
+        self._recv_seq_max = 0        # newest child response seq seen
+        self.wire_crc_rejects = 0     # parent-side CRC rejections
+        self.wire_dup_suppressed = 0  # frames dropped by seq dedup
+        self.wire_resends = 0         # per-RPC backoff re-sends
+        # controller-restart rejoin: while the window is open, response
+        # frames for unknown ids are BUFFERED (they may be orphans the
+        # journal recovery will adopt or salvage) instead of dropped
+        self._adopt_window = rejoin is not None
+        self._orphan_buf: Dict[int, dict] = {}
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._pending: Dict[int, list] = {}       # rpc id -> [event, reply]
@@ -375,14 +551,34 @@ class ProcessReplicaTransport(ReplicaTransport):
         self._advertise_host = advertise_host
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        bind_port = 0 if rejoin is None else int(rejoin["port"])
         try:
-            self._listener.bind((self._bind_host, 0))
+            self._listener.bind((self._bind_host, bind_port))
         except OSError as e:
             self._listener.close()
             raise FleetSpawnError(
-                f"cannot bind the fleet wire on {self._bind_host!r}: {e}")
+                f"cannot bind the fleet wire on {self._bind_host!r}"
+                f":{bind_port}: {e}")
         self._listener.listen(1)
         port = self._listener.getsockname()[1]
+        if rejoin is not None:
+            # controller restart: the child is already RUNNING and
+            # re-dialing the port its dead parent listened on — rebind
+            # it with the recorded token, adopt the process by pid, and
+            # learn the engine caps over the wire instead of the
+            # spec/ready handshake (the engine was built long ago)
+            self._token = str(rejoin["token"])
+            self._proc = _ExternalChild(rejoin.get("pid"))
+            self._sock = self._accept(connect_timeout_s)
+            self._reader = threading.Thread(target=self._read_loop,
+                                            name="fleet-proc-reader",
+                                            daemon=True)
+            self._reader.start()
+            st = self._rpc({"op": "status"}, timeout=connect_timeout_s)
+            self.default_max_new_tokens_ = int(st["default_max_new_tokens"])
+            self.queue_capacity_ = int(st["queue_capacity"])
+            self.num_slots = int(st["num_slots"])
+            return
         self._token = base64.b64encode(os.urandom(12)).decode()
         exe = executable if executable is not None else sys.executable
         self._proc = subprocess.Popen(
@@ -417,6 +613,71 @@ class ProcessReplicaTransport(ReplicaTransport):
                                         daemon=True)
         self._reader.start()
 
+    def rejoin_info(self) -> dict:
+        """Everything a future parent needs to re-register this child
+        WITHOUT spawning (journaled at fleet construction): the
+        listener port to rebind, the hello token, the child pid, and
+        the spec to rebuild the transport around."""
+        return {"port": self._listener.getsockname()[1],
+                "token": self._token, "pid": self._proc.pid,
+                "host": self._bind_host, "role": self.role,
+                "spec": dataclasses.asdict(self.spec)}
+
+    # -- controller-restart reconciliation ---------------------------------
+
+    def remote_request_ids(self) -> List[int]:
+        """Ask the child which request ids it currently holds (queued
+        or decoding) — the reconciliation query a rejoined controller
+        runs against the journal's placed-but-unanswered set."""
+        st = self._rpc({"op": "status"}) or {}
+        return sorted({int(i) for i in (st.get("queued") or [])} |
+                      {int(i) for i in (st.get("live") or [])})
+
+    def orphan_response_ids(self) -> List[int]:
+        """Ids whose response frames arrived during the adopt window
+        before any controller claimed them — already finished remotely,
+        salvageable without re-running."""
+        with self._state_lock:
+            return sorted(self._orphan_buf)
+
+    def adopt(self, req: Request) -> bool:
+        """Adopt one orphaned request during rejoin. If its response
+        is already buffered, move it onto the normal poll path (True:
+        the id will deliver without re-placement); otherwise register
+        it in-flight so the child's (re)shipped response frame is
+        accepted instead of discarded."""
+        with self._state_lock:
+            msg = self._orphan_buf.pop(req.id, None)
+            if msg is not None:
+                self._responses.append(self._response_from(msg))
+                self.obs_tokens_out += len(msg["tokens"])
+                self.obs_responses_out += 1
+                return True
+            self._inflight[req.id] = req
+            return False
+
+    def seal_rejoin(self) -> List[Response]:
+        """Close the adopt window: unknown response ids go back to
+        being discarded (the exactly-once drop path). Returns any
+        still-unclaimed buffered responses — journaled-terminal dups
+        the controller must NOT deliver twice, or never-submitted ids
+        from a torn journal tail the caller may surface."""
+        out: List[Response] = []
+        with self._state_lock:
+            self._adopt_window = False
+            for rid in sorted(self._orphan_buf):
+                out.append(self._response_from(self._orphan_buf[rid]))
+            self._orphan_buf.clear()
+        return out
+
+    @property
+    def crc_rejects_total(self) -> int:
+        """Corrupt frames rejected on BOTH ends of this wire (parent
+        reader + the child's count, shipped via heartbeat)."""
+        with self._state_lock:
+            child = int(self._hb.get("crc_rejects", 0))
+        return self.wire_crc_rejects + child
+
     # -- connection management -------------------------------------------
 
     def _accept(self, timeout_s: float) -> socket.socket:
@@ -440,6 +701,14 @@ class ProcessReplicaTransport(ReplicaTransport):
                     raise TransportError(
                         f"replica child did not connect within "
                         f"{timeout_s}s")
+                held = self._partition_until - time.monotonic()
+                if held > 0:
+                    # chaos partition: refuse the re-dial for the hold.
+                    # The child's connect attempts queue in the kernel
+                    # listen backlog and land the instant the hold
+                    # lifts, so the heal is a plain accept
+                    time.sleep(min(held, 0.25, max(remaining, 0.01)))
+                    continue
                 try:
                     self._listener.settimeout(min(0.25, remaining))
                     conn, _ = self._listener.accept()
@@ -449,7 +718,11 @@ class ProcessReplicaTransport(ReplicaTransport):
                     # listener torn down by close() while we waited
                     raise TransportError(f"listener closed: {e}")
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                hello = recv_frame(conn)
+                try:
+                    hello = recv_frame(conn)
+                except OSError:        # corrupt/truncated hello: not ours
+                    conn.close()
+                    continue
                 if hello and hello.get("op") == "hello" \
                         and hello.get("token") == self._token:
                     return conn
@@ -460,12 +733,55 @@ class ProcessReplicaTransport(ReplicaTransport):
             except OSError:
                 pass
 
+    def _chaos_send_locked(self, frame: bytes) -> None:
+        """Send one parent->child frame through the chaos plan's wire
+        faults. MUST be called holding ``_send_lock``. A partition
+        fault drops the frame, severs the live connection and arms
+        ``_partition_until`` so ``_accept`` refuses the re-dial for the
+        hold; the pending-frame replay re-sends the lost RPC when the
+        wire heals."""
+        index = self._wire_index
+        self._wire_index += 1
+        frames, partition_s = apply_wire_chaos(
+            self.chaos, index, frame, self.chaos_replica)
+        if partition_s > 0:
+            self._partition_until = time.monotonic() + partition_s
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise OSError("chaos wire partition")
+        for f in frames:
+            self._sock.sendall(f)
+
     def _read_loop(self) -> None:
         while not self._closed:
             try:
                 msg = recv_frame(self._sock)
                 if msg is None:
                     raise OSError("EOF")
+            except FrameCorrupt as e:
+                # a corrupt frame poisons the stream boundary: the only
+                # safe resync is a fresh connection. Count it, sever,
+                # and fall into the reconnect+replay path — the RPC it
+                # carried (either direction) is replayed, never
+                # half-parsed
+                if self._closed:
+                    return
+                self.wire_crc_rejects += 1
+                get_registry().counter(
+                    "serve.fleet.wire_crc_rejects").inc()
+                try:
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                if not self._reconnect():
+                    if not self._closed:
+                        self._mark_dead(
+                            f"corrupt frame ({e}) and reconnect "
+                            f"window expired")
+                    return
+                continue
             except OSError as e:
                 if self._closed:
                     return
@@ -489,23 +805,37 @@ class ProcessReplicaTransport(ReplicaTransport):
             return False
         with self._send_lock:
             old, self._sock = self._sock, conn
-        try:
-            old.close()
-        except OSError:
-            pass
-        with self._state_lock:
-            frames = list(self._pending_frames.values())
-        for frame in frames:
             try:
-                with self._send_lock:
-                    self._sock.sendall(frame)
-                self.rpc_retries += 1
+                old.close()
             except OSError:
-                return False
+                pass
+            with self._state_lock:
+                frames = list(self._pending_frames.values())
+            for frame in frames:
+                try:
+                    self._chaos_send_locked(frame)
+                    self.rpc_retries += 1
+                except OSError:
+                    # the fresh wire died mid-replay (or a chaos
+                    # partition severed it): report success anyway so
+                    # the read loop's next recv failure routes back
+                    # through _reconnect, whose _accept honors the
+                    # partition hold — only an expired window or a
+                    # dead child ends the recovery
+                    break
         return True
+
+    @staticmethod
+    def _response_from(msg: dict) -> Response:
+        return Response(
+            request_id=msg["id"], tokens=list(msg["tokens"]),
+            status=msg["status"], finish_reason=msg["finish_reason"],
+            prompt_len=msg["prompt_len"],
+            ttft=msg.get("ttft"), latency=msg.get("latency"))
 
     def _dispatch(self, msg: dict) -> None:
         op = msg.get("op")
+        seq = int(msg.pop("_seq", 0))
         self._frame_census[op] = self._frame_census.get(op, 0) + 1
         if op == "reply":
             with self._state_lock:
@@ -514,23 +844,34 @@ class ProcessReplicaTransport(ReplicaTransport):
                 ent[1] = msg
                 ent[0].set()
         elif op == "response":
+            # only response frames carry a child->parent wire sequence;
+            # a chaos wire_dup (or the post-reconnect retained-frame
+            # replay) presents already-taken seqs, suppressed here so
+            # delivery stays exactly-once
+            if seq:
+                with self._state_lock:
+                    if seq <= self._recv_seq_max:
+                        self.wire_dup_suppressed += 1
+                        return
+                    self._recv_seq_max = seq
             rid = msg["id"]
             with self._state_lock:
                 known = rid in self._inflight
                 if known:
                     self._inflight.pop(rid, None)
-                    self._responses.append(Response(
-                        request_id=rid, tokens=list(msg["tokens"]),
-                        status=msg["status"],
-                        finish_reason=msg["finish_reason"],
-                        prompt_len=msg["prompt_len"],
-                        ttft=msg.get("ttft"), latency=msg.get("latency")))
+                    self._responses.append(self._response_from(msg))
                     # delivery-synchronized per-replica accounting: the
                     # tokens rode THIS frame, so the count can never
                     # outrun (or trail) what the parent actually took —
                     # the reconciliation invariant the observer sums
                     self.obs_tokens_out += len(msg["tokens"])
                     self.obs_responses_out += 1
+                elif self._adopt_window:
+                    # controller-restart rejoin: ids the dead parent
+                    # placed are unknown to THIS parent until the
+                    # journal reconciliation adopts them — buffer
+                    # instead of discarding
+                    self._orphan_buf[rid] = dict(msg)
             # unknown id: the controller reclaimed it over a drop — the
             # stale record is discarded HERE so delivery stays exactly-once
         elif op == "hb":
@@ -540,10 +881,16 @@ class ProcessReplicaTransport(ReplicaTransport):
         elif op == "obs":
             events = msg.get("events") or []
             with self._state_lock:
+                new_seq = int(msg.get("seq", self._obs_seq + 1))
+                if new_seq <= self._obs_seq:
+                    # replayed/duplicated obs frame (chaos wire_dup or
+                    # reconnect): already merged, drop it
+                    self.wire_dup_suppressed += 1
+                    return
                 self._obs_registry.merge_snapshot(msg.get("metrics") or {})
                 self._obs_events.extend(events)
                 self._obs_at = time.monotonic()
-                self._obs_seq = int(msg.get("seq", self._obs_seq + 1))
+                self._obs_seq = new_seq
                 new_dropped = int(msg.get("dropped", 0))
                 just_dropped = max(new_dropped - self._obs_dropped, 0)
                 self._obs_dropped = new_dropped
@@ -580,26 +927,63 @@ class ProcessReplicaTransport(ReplicaTransport):
             self._rpc_next += 1
             self._pending[rid] = [ev, None]
         msg = dict(msg, rpc=rid)
-        buf = _pack(msg)
-        frame = struct.pack(">I", len(buf)) + buf
-        with self._state_lock:
-            # register BEFORE sending: if the send races a connection
-            # drop, the reconnect replay finds the frame and re-sends
-            # it — marking the transport dead here would preempt a
-            # recovery the read loop was about to complete
-            self._pending_frames[rid] = frame
-        try:
+        total_s = timeout if timeout is not None else self._rpc_timeout_s
+        deadline = time.monotonic() + total_s
+        # deterministic per-rpc jitter (Knuth hash of the rpc id):
+        # concurrent retries against a struggling child spread out
+        # instead of stampeding in lockstep
+        jitter = 1.0 + self._rpc_retry_jitter * (
+            (rid * 2654435761 & 0xFFFF) / 65535.0)
+        with self._send_lock:
+            # the frame is BUILT once, under the send lock, so its wire
+            # sequence is allocated in send order and every re-send
+            # (retry or reconnect replay) repeats the same seq — the
+            # child's dedup window recognizes it
+            self._send_seq = (self._send_seq + 1) & 0xFFFFF
+            if self._send_seq == 0:
+                # 20-bit counter wrapped: roll the epoch so the child's
+                # window resets rather than treating a million frames
+                # as duplicates
+                self._epoch = (self._epoch % 4095) + 1
+                self._send_seq = 1
+            frame = _frame(_pack(msg), (self._epoch << 20) | self._send_seq)
+            with self._state_lock:
+                # register BEFORE sending: if the send races a
+                # connection drop, the reconnect replay finds the frame
+                # and re-sends it — marking the transport dead here
+                # would preempt a recovery the read loop was about to
+                # complete
+                self._pending_frames[rid] = frame
             try:
-                with self._send_lock:
-                    self._sock.sendall(frame)
+                self._chaos_send_locked(frame)
             except OSError:
                 pass        # reconnect replay (or _mark_dead) resolves it
+        try:
             self.rpc_inflight += 1
-            if not ev.wait(timeout if timeout is not None
-                           else self._rpc_timeout_s):
-                self._mark_dead(f"rpc {msg.get('op')} timed out")
-                raise TransportError(
-                    f"replica transport dead: {self._dead}")
+            attempt = 0
+            while True:
+                wait_s = min(self._rpc_retry_base_s * (2.0 ** attempt),
+                             self._rpc_retry_max_s) * jitter
+                wait_s = min(wait_s, max(deadline - time.monotonic(), 0.0))
+                if ev.wait(wait_s):
+                    break
+                if time.monotonic() >= deadline:
+                    self._mark_dead(
+                        f"rpc {msg.get('op')} timed out after "
+                        f"{total_s}s ({attempt + 1} attempts)")
+                    raise TransportError(
+                        f"replica transport dead: {self._dead}")
+                # attempt deadline passed without a reply: re-send the
+                # SAME frame (same rpc id, same wire seq) and back off
+                # exponentially — a dup the child already answered is
+                # answered again from its reply cache
+                attempt += 1
+                self.wire_resends += 1
+                try:
+                    with self._send_lock:
+                        self._chaos_send_locked(frame)
+                except OSError:
+                    pass    # reconnect replay carries it instead
             with self._state_lock:
                 reply = self._pending[rid][1]
             if reply is None:                     # woken by _mark_dead
@@ -875,6 +1259,15 @@ def _child_op(engine, msg: dict, now: float):
     if op == "drain":
         engine.drain()
         return True
+    if op == "status":
+        # the controller-restart reconciliation query: engine caps (the
+        # rejoin handshake's replacement for the spec/ready exchange)
+        # plus every request id this replica still holds
+        return {"default_max_new_tokens": engine.backend.gen.max_new_tokens,
+                "queue_capacity": engine.queue.capacity,
+                "num_slots": engine.backend.num_slots,
+                "queued": [r.id for r in engine.queue.admission_order()],
+                "live": [s.req.id for s in engine._slots if s is not None]}
     backend = engine.backend
     pool = getattr(backend, "pool", None)
     if op == "export_prefix":
@@ -894,7 +1287,8 @@ def _child_op(engine, msg: dict, now: float):
     raise ValueError(f"unknown fleet op {op!r}")
 
 
-def _heartbeat(engine, kv_hot_refs: Optional[int] = None) -> dict:
+def _heartbeat(engine, kv_hot_refs: Optional[int] = None,
+               crc_rejects: int = 0) -> dict:
     wd = engine.watchdog
     hb = {"op": "hb",
           "slow_streak": wd.slow_streak if wd is not None else 0,
@@ -904,6 +1298,10 @@ def _heartbeat(engine, kv_hot_refs: Optional[int] = None) -> dict:
           "depth": engine.queue.depth, "live": engine.live_slots,
           "idle": engine.idle, "draining": engine.draining,
           "drained": engine.drained}
+    if crc_rejects:
+        # only when a corrupt frame was actually seen: a clean wire
+        # ships exactly the former heartbeat bytes
+        hb["crc_rejects"] = int(crc_rejects)
     # KV gen-2 directory: piggybacked on the heartbeat cadence (one
     # beat stale at the controller, which is fine — placement is a
     # heuristic, correctness never depends on the directory). Only when
@@ -959,10 +1357,20 @@ def worker(port: int, token: str, host: str = "127.0.0.1") -> None:
     sel.register(sock, selectors.EVENT_READ)
     send_lock = threading.Lock()
     link = {"sock": sock, "up": True}
+    # wire-hardening state: responses carry a child->parent sequence
+    # (the parent suppresses replays), recent response frames are
+    # retained for post-reconnect replay, and replies to already-seen
+    # rpc ids are answered from cache instead of re-executing the op
+    wire = {"resp_seq": 0, "recv_max": 0, "epoch": 0, "crc_rejects": 0}
+    reply_cache: OrderedDict = OrderedDict()
+    sent_responses = deque(maxlen=256)
 
     def resync(old: socket.socket) -> Optional[socket.socket]:
         """Reconnect loop: re-dial the parent's listener until it
-        answers or the window closes."""
+        answers or the window closes, then replay every retained
+        response frame — the parent's sequence dedup swallows the ones
+        it already took, so a response lost to a partition or a corrupt
+        frame is delivered exactly once."""
         sel.unregister(old)
         try:
             old.close()
@@ -976,9 +1384,32 @@ def worker(port: int, token: str, host: str = "127.0.0.1") -> None:
                 time.sleep(0.1)
                 continue
             sel.register(s, selectors.EVENT_READ)
-            link["sock"] = s
+            with send_lock:
+                link["sock"] = s
+                try:
+                    for frame in list(sent_responses):
+                        s.sendall(frame)
+                except OSError:
+                    pass     # the next recv failure re-enters resync
             return s
         return None
+
+    def ship_response(resp) -> None:
+        """Frame one terminal response with the next wire sequence and
+        retain it for replay. The frame is appended to the retained
+        window BEFORE the send, so a send that dies mid-frame still
+        replays after resync."""
+        msg = {"op": "response", "id": resp.request_id,
+               "tokens": list(map(int, resp.tokens)),
+               "status": resp.status,
+               "finish_reason": resp.finish_reason,
+               "prompt_len": resp.prompt_len,
+               "ttft": resp.ttft, "latency": resp.latency}
+        with send_lock:
+            wire["resp_seq"] += 1
+            frame = _frame(_pack(msg), wire["resp_seq"])
+            sent_responses.append(frame)
+            link["sock"].sendall(frame)
 
     obs_state = {"seq": 0, "base": {}, "dropped": 0}
     obs_lock = threading.Lock()
@@ -1022,7 +1453,13 @@ def worker(port: int, token: str, host: str = "127.0.0.1") -> None:
         while link["up"]:
             time.sleep(spec.heartbeat_interval_s)
             try:
-                send_frame(link["sock"], _heartbeat(engine, spec.kv_hot_refs),
+                # heartbeats are UNSEQUENCED (seq 0): they interleave
+                # with response frames on the wire, and advancing the
+                # parent's response-seq window from here would let a
+                # beat sent during a drop suppress a replayed response
+                send_frame(link["sock"],
+                           _heartbeat(engine, spec.kv_hot_refs,
+                                      wire["crc_rejects"]),
                            send_lock)
                 if spec.telemetry:
                     ship_obs()
@@ -1040,11 +1477,22 @@ def worker(port: int, token: str, host: str = "127.0.0.1") -> None:
                 msg = recv_frame(sock)
                 if msg is None:
                     raise OSError("EOF")
+            except FrameCorrupt:
+                # a frame that fails its checksum poisons the stream
+                # boundary — never parse past it. Count it (shipped on
+                # the next heartbeat) and resync on a fresh connection;
+                # the parent re-sends whatever the bad frame carried
+                wire["crc_rejects"] += 1
+                sock = resync(sock)
+                if sock is None:
+                    return
+                continue
             except OSError:
                 sock = resync(sock)
                 if sock is None:
                     return
                 continue
+            seq = int(msg.pop("_seq", 0))
             if msg.get("op") == "shutdown":
                 try:
                     if spec.telemetry:
@@ -1055,6 +1503,36 @@ def worker(port: int, token: str, host: str = "127.0.0.1") -> None:
                 except OSError:
                     pass
                 return
+            if seq:
+                # parent seqs = (epoch << 20) | counter. A fresh epoch
+                # is a NEW parent incarnation (controller restart):
+                # reset the dedup window and reply cache so the new
+                # parent's rpc ids are never mistaken for the dead
+                # parent's
+                ep, ctr = seq >> 20, seq & 0xFFFFF
+                if ep != wire["epoch"]:
+                    wire["epoch"] = ep
+                    wire["recv_max"] = 0
+                    reply_cache.clear()
+                if ctr <= wire["recv_max"]:
+                    # replayed or duplicated op frame (chaos wire_dup,
+                    # an rpc-timeout re-send, or the reconnect replay).
+                    # If the op already ran, re-ship its cached reply
+                    # rather than running it twice; an unseen rpc under
+                    # an old seq (post-corruption realignment) falls
+                    # through and runs normally — the parent's
+                    # reply/response dedup is the backstop
+                    cached = reply_cache.get(msg.get("rpc"))
+                    if cached is not None:
+                        try:
+                            send_frame(sock, cached, send_lock)
+                        except OSError:
+                            sock = resync(sock)
+                            if sock is None:
+                                return
+                        continue
+                else:
+                    wire["recv_max"] = ctr
             try:
                 value = _child_op(engine, msg, time.monotonic())
                 reply = {"op": "reply", "rpc": msg.get("rpc"),
@@ -1062,6 +1540,10 @@ def worker(port: int, token: str, host: str = "127.0.0.1") -> None:
             except Exception as e:                # noqa: BLE001 — wire it
                 reply = {"op": "reply", "rpc": msg.get("rpc"),
                          "error": [type(e).__name__, str(e)]}
+            if msg.get("rpc") is not None:
+                reply_cache[msg["rpc"]] = reply
+                while len(reply_cache) > 512:
+                    reply_cache.popitem(last=False)
             try:
                 send_frame(sock, reply, send_lock)
             except OSError:
@@ -1072,14 +1554,7 @@ def worker(port: int, token: str, host: str = "127.0.0.1") -> None:
         if busy:
             for resp in engine.tick():
                 try:
-                    send_frame(sock, {
-                        "op": "response", "id": resp.request_id,
-                        "tokens": list(map(int, resp.tokens)),
-                        "status": resp.status,
-                        "finish_reason": resp.finish_reason,
-                        "prompt_len": resp.prompt_len,
-                        "ttft": resp.ttft, "latency": resp.latency},
-                        send_lock)
+                    ship_response(resp)
                 except OSError:
                     sock = resync(sock)
                     if sock is None:

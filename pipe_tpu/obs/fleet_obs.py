@@ -249,6 +249,13 @@ class FleetObserver:
                     "blocks_free": d.get("blocks_free"),
                     "blocks_total": d.get("blocks_total"),
                 }
+            # durable-journal lag: seconds since the controller's last
+            # fsync'd lifecycle record (None for journal-less fleets) —
+            # fleet-wide, repeated per row so fleet_top can render it
+            jr = getattr(self.controller, "journal", None)
+            if jr is not None:
+                view["journal_lag_s"] = self._safe(
+                    lambda j=jr: j.fsync_age_s, None)
             out[rep.index] = view
         return out
 

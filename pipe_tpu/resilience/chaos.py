@@ -20,8 +20,21 @@ Injection sites, by fault kind:
                     wrapped ``pre_fn``; corrupts loss AND grads the way
                     a real numeric blowup does)
 ``data_raise``      :class:`ChaosError` raised from the data iterator
-``transport_drop``  a stage-boundary hop zeroed in the emulator executor
-``transport_corrupt`` the same hop scaled by NaN instead
+``transport_drop``  a stage-boundary hop zeroed in the EMULATOR executor
+                    (an in-array activation fault, despite the name —
+                    it never touches a real wire)
+``transport_corrupt`` the same emulator hop scaled by NaN instead
+``wire_partition``  fleet proc wire: the parent drops the covered
+                    outgoing frame, severs the connection and refuses
+                    the child's re-dial for ``magnitude`` seconds
+                    (capped 30s) — heals by reconnect + replay
+``wire_delay``      fleet proc wire: the covered outgoing frame is
+                    held ``magnitude`` seconds (capped 5s) before send
+``wire_corrupt``    fleet proc wire: the covered outgoing frame's last
+                    byte is flipped AFTER checksumming, so the
+                    receiver's CRC32 rejects it and forces a resync
+``wire_dup``        fleet proc wire: the covered outgoing frame is
+                    sent twice (sequence dedup must collapse them)
 ``stall_tick``      the serve engine sleeps ``magnitude`` seconds in-tick
 ``queue_flood``     the serve queue force-filled to capacity with junk
 ``backend_raise``   :class:`ChaosError` raised at the next backend
@@ -75,13 +88,18 @@ class ChaosError(RuntimeError):
 TRAIN_KINDS = ("nan_grads", "inf_grads", "nan_loss", "loss_spike",
                "nan_activations")
 DATA_KINDS = ("data_raise",)
+# "transport" faults reach the EMULATOR's stage-boundary hops only —
+# they corrupt activations in-array and never touch a real wire. The
+# fleet's actual socket wire is faulted by WIRE_KINDS below, routed
+# through pipe_tpu.fleet.proc.apply_wire_chaos at the framing layer.
 TRANSPORT_KINDS = ("transport_drop", "transport_corrupt",
                    "persistent_hop_drop")
+WIRE_KINDS = ("wire_partition", "wire_delay", "wire_corrupt", "wire_dup")
 SERVE_KINDS = ("stall_tick", "queue_flood", "backend_raise")
 REPLICA_KINDS = ("wedge_replica", "kill_replica", "slow_replica")
 STAGE_KINDS = ("kill_stage",)
-KINDS = TRAIN_KINDS + DATA_KINDS + TRANSPORT_KINDS + SERVE_KINDS \
-    + REPLICA_KINDS + STAGE_KINDS
+KINDS = TRAIN_KINDS + DATA_KINDS + TRANSPORT_KINDS + WIRE_KINDS \
+    + SERVE_KINDS + REPLICA_KINDS + STAGE_KINDS
 
 # Traced inject codes (the int32 scalar argument of the guarded step).
 INJECT_NONE = 0
@@ -213,6 +231,24 @@ class ChaosPlan:
                 return "drop"
             if f.microbatch == microbatch:
                 return "drop" if f.kind == "transport_drop" else "corrupt"
+        return None
+
+    # -- fleet proc wire ----------------------------------------------------
+
+    def wire_fault(self, kind: str, index: int,
+                   replica: int = 0) -> Optional[Fault]:
+        """The first ``kind`` wire fault hitting ``replica``'s proc
+        wire (addressed via ``Fault.stage``, like replica faults) at
+        outgoing frame ``index``. Consulted by
+        :func:`pipe_tpu.fleet.proc.apply_wire_chaos` per parent->child
+        frame — frame index, not tick, is the coverage key, so a drill
+        can corrupt exactly the Nth frame regardless of timing."""
+        if kind not in WIRE_KINDS:
+            raise ValueError(f"{kind!r} is not a wire fault kind; "
+                             f"one of {WIRE_KINDS}")
+        for f in self.faults:
+            if f.kind == kind and f.stage == replica and f.covers(index):
+                return f
         return None
 
     # -- serve tick ---------------------------------------------------------

@@ -1,0 +1,382 @@
+"""Crash-tolerant control plane: the durable request journal, the
+controller restart/rejoin recovery path, and the adversarial wire
+chaos injection (ISSUE 20).
+
+Tier-1 throughout: the journal writes to tmp_path, recovery runs over
+in-process transports (fresh FakeBackend engines standing in for
+surviving children), and the wire-chaos tests drive the framing layer
+over socketpairs — no child interpreters. The real SIGKILL-the-parent
+drills live in ``tools/fleet_bench.py`` (rev r20).
+"""
+
+import json
+import socket
+import struct
+
+import pytest
+
+from pipe_tpu.fleet import (DisaggController, FleetController,
+                            InProcessTransport, JournalState,
+                            RequestJournal, RouterPolicy)
+from pipe_tpu.fleet.proc import (FrameCorrupt, _pack, apply_wire_chaos,
+                                 recv_frame, send_frame)
+from pipe_tpu.resilience import ChaosPlan, Fault, TickWatchdog
+from pipe_tpu.serve import RequestQueue, ServeEngine
+from test_router import FakeBackend
+
+# ---------------------------------------------------------------------------
+# the journal: append, replay, torn lines
+
+
+def _journal(tmp_path, **kw):
+    kw.setdefault("fsync", False)          # tmpfs tests skip the fsync
+    return RequestJournal(str(tmp_path / "j"), **kw)
+
+
+def test_journal_replays_lifecycle_into_state(tmp_path):
+    j = _journal(tmp_path)
+    j.append("submit", request=0, prompt=[1, 2], max_new_tokens=8, seed=0)
+    j.append("submit", request=1, prompt=[3], max_new_tokens=4, seed=0)
+    j.append("place", request=0, replica=1, attempts=1)
+    j.append("place", request=1, replica=0, attempts=1)
+    j.append("park", request=1, attempts=1, delay_s=0.1)
+    j.append("place", request=1, replica=1, attempts=2)
+    j.append("deliver", request=0, status="ok", finish_reason="eos",
+             tokens=8)
+    j.close()
+    st = RequestJournal.recover(j.path)
+    assert sorted(st.requests) == [0, 1]
+    assert st.terminal.keys() == {0}
+    assert st.orphans == [1]               # submitted, never delivered
+    assert st.placed_on == {1: 1}          # the LAST un-consumed placement
+    assert st.attempts == {0: 1, 1: 2}     # parks don't refund attempts
+    assert st.max_request_id == 1
+    assert not st.clean
+
+
+def test_journal_clean_shutdown_is_only_clean_at_the_end(tmp_path):
+    j = _journal(tmp_path)
+    j.append("submit", request=0, prompt=[1], max_new_tokens=2, seed=0)
+    j.append("deliver", request=0, status="ok", finish_reason="eos",
+             tokens=2)
+    j.close(clean=True)
+    assert RequestJournal.recover(j.path).clean
+    # restart appends more work: the log no longer ENDS clean
+    j2 = RequestJournal(j.path, fsync=False)
+    j2.append("submit", request=1, prompt=[2], max_new_tokens=2, seed=0)
+    j2.close()
+    st = RequestJournal.recover(j.path)
+    assert not st.clean and st.orphans == [1]
+
+
+def test_journal_recover_tolerates_torn_final_line(tmp_path):
+    # mirror of the EventLog pin in test_fleet_obs.py: a crash can tear
+    # only the FINAL line, and recovery must replay everything before it
+    j = _journal(tmp_path)
+    j.append("submit", request=0, prompt=[1], max_new_tokens=2, seed=0)
+    j.append("place", request=0, replica=0, attempts=1)
+    j.close()
+    raw = open(j.path, "rb").read()
+    with open(j.path, "wb") as fh:
+        fh.write(raw[:-9])                 # tear the last record mid-JSON
+    st = RequestJournal.recover(j.path)
+    assert st.orphans == [0]
+    assert st.placed_on == {}              # the torn "place" never happened
+
+
+def test_journal_recover_refuses_torn_middle_line(tmp_path):
+    j = _journal(tmp_path)
+    j.append("submit", request=0, prompt=[1], max_new_tokens=2, seed=0)
+    j.close()
+    lines = open(j.path, "rb").read().splitlines()
+    lines.insert(1, b'{"kind": "place", "request')   # torn MIDDLE line
+    lines.append(json.dumps({"kind": "deliver", "request": 0,
+                             "status": "ok", "finish_reason": "eos",
+                             "tokens": 2}).encode())
+    with open(j.path, "wb") as fh:
+        fh.write(b"\n".join(lines) + b"\n")
+    with pytest.raises(json.JSONDecodeError):
+        RequestJournal.recover(j.path)     # corruption, not a crash: loud
+
+
+def test_journal_rejects_unknown_record_kind(tmp_path):
+    j = _journal(tmp_path)
+    with pytest.raises(ValueError, match="unknown journal record kind"):
+        j.append("frobnicate", request=0)
+    j.close()
+
+
+def test_journal_missing_file_recovers_empty(tmp_path):
+    st = RequestJournal.recover(str(tmp_path / "never-written"))
+    assert st.records == 0 and st.orphans == [] and not st.clean
+
+
+def test_record_replica_writes_rejoin_snapshot(tmp_path):
+    j = _journal(tmp_path)
+    j.record_replica(0, port=5001, token="t0", pid=123, role="mixed")
+    j.record_replica(1, port=5002, token="t1", pid=124, role="mixed")
+    j.record_replica(0, port=5003, token="t2", pid=125, role="mixed")
+    j.close()
+    st = RequestJournal.recover(j.path)
+    assert st.replicas[0]["port"] == 5003  # latest record wins
+    meta = json.load(open(str(tmp_path / "j" / "fleet.json")))
+    assert meta["replicas"]["1"]["token"] == "t1"
+
+
+def test_shadow_record_pops_placement_and_tags_state():
+    st = JournalState()
+    for rec in [
+        {"kind": "submit", "request": 3, "prompt": [1], "max_new_tokens": 9},
+        {"kind": "place", "request": 3, "replica": 0, "attempts": 1},
+        {"kind": "shadow", "request": 3, "src": 0, "max_new_tokens": 9},
+    ]:
+        st.apply(rec)
+    assert 3 in st.shadow and st.placed_on == {}
+    assert st.orphans == [3]
+
+
+# ---------------------------------------------------------------------------
+# RouterPolicy backoff: the doubling sequence and the cap, fake-clocked
+
+
+def _controller(n, journal=None, **policy_kw):
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    transports = [
+        InProcessTransport(
+            ServeEngine(FakeBackend(2),
+                        RequestQueue(capacity=32, clock=clock),
+                        watchdog=TickWatchdog(stuck_slack_ticks=None)))
+        for _ in range(n)]
+    ctl = FleetController(transports,
+                          RequestQueue(capacity=32, clock=clock),
+                          policy=RouterPolicy(**policy_kw),
+                          journal=journal)
+    return ctl, t
+
+
+def _run(ctl, t, max_ticks=300):
+    out = []
+    for _ in range(max_ticks):
+        if ctl.idle:
+            return out
+        t[0] += 0.01
+        out.extend(ctl.tick())
+    raise AssertionError(f"fleet not idle: {ctl.counts()}")
+
+
+def test_park_backoff_doubles_from_policy_base_and_caps(tmp_path):
+    # the parked delay is min(base * 2^(attempts-1), cap) — pin the
+    # whole sequence through the journal's park records, fake-clocked
+    j = _journal(tmp_path)
+    ctl, t = _controller(1, journal=j,
+                         backoff_base_s=0.05, backoff_max_s=0.2,
+                         retry_budget=8)
+    req = ctl.submit([1, 2], max_new_tokens=2)
+    delays = []
+    for attempts in (1, 2, 3, 4, 5):
+        req.attempts = attempts
+        ctl._park(req, t[0])
+        delays.append(ctl._parked.pop()[0] - t[0])
+    assert delays == [0.05, 0.1, 0.2, 0.2, 0.2]
+    j.close()
+    # journal carries the same delays (the WAL is written BEFORE the park)
+    journaled = [rec["delay_s"] for rec in map(json.loads,
+                 open(j.path)) if rec["kind"] == "park"]
+    assert journaled == [0.05, 0.1, 0.2, 0.2, 0.2]
+
+
+# ---------------------------------------------------------------------------
+# restart from the journal (in-process stand-ins for surviving children)
+
+
+def test_restart_from_journal_delivers_orphans_exactly_once(tmp_path):
+    j = _journal(tmp_path)
+    ctl, t = _controller(2, journal=j, backoff_base_s=0.0)
+    ids = [ctl.submit([3, 4, 5], max_new_tokens=4).id for _ in range(6)]
+    # run just far enough that SOME ids deliver, then "SIGKILL": drop
+    # the controller on the floor, journal un-closed (no clean record)
+    delivered_pre = []
+    for _ in range(200):
+        t[0] += 0.01
+        delivered_pre.extend(r.request_id for r in ctl.tick())
+        if len(delivered_pre) >= 2:
+            break
+    assert delivered_pre, "drill needs at least one pre-crash terminal"
+    in_flight = [i for i in ids if i not in delivered_pre]
+    assert in_flight, "drill needs work in flight at the crash"
+
+    st = RequestJournal.recover(j.path)
+    assert sorted(st.orphans) == sorted(in_flight)
+    # fresh life: new engines (the in-process "children" died with the
+    # parent — process children would be re-dialed instead)
+    ctl2, t2 = _controller(2, backoff_base_s=0.0)
+    ctl2 = FleetController.from_journal(
+        st, [r.transport for r in ctl2.replicas],
+        RequestQueue(capacity=32, clock=lambda: t2[0]),
+        policy=RouterPolicy(backoff_base_s=0.0))
+    out = _run(ctl2, t2)
+    assert sorted(r.request_id for r in out) == sorted(in_flight)
+    # the exactly-once ledger came back: pre-crash terminals are
+    # stubbed, and a replica replaying one must trip the raise
+    from pipe_tpu.serve.queue import Response
+    with pytest.raises(RuntimeError, match="exactly-once"):
+        ctl2._deliver(Response(request_id=delivered_pre[0], tokens=[],
+                               status="ok", finish_reason="eos",
+                               prompt_len=0, ttft=None, latency=0.0))
+    # new submissions never reuse a journaled id
+    assert ctl2.submit([1], max_new_tokens=1).id > max(ids)
+
+
+def test_restart_on_clean_log_skips_reconciliation(tmp_path):
+    j = _journal(tmp_path)
+    ctl, t = _controller(1, journal=j, backoff_base_s=0.0)
+    rid = ctl.submit([1, 2], max_new_tokens=2).id
+    _run(ctl, t)
+    j.close(clean=True)
+    st = RequestJournal.recover(j.path)
+    assert st.clean and st.orphans == []
+    ctl2, t2 = _controller(1, backoff_base_s=0.0)
+    ctl2 = FleetController.from_journal(
+        st, [r.transport for r in ctl2.replicas],
+        RequestQueue(capacity=32, clock=lambda: t2[0]))
+    assert ctl2.idle                       # nothing parked, nothing tracked
+    assert rid in ctl2._responses          # but the ledger stub is there
+
+
+def test_disagg_restore_rebuilds_phase_tags(tmp_path):
+    st = JournalState()
+    for rec in [
+        # id 0 crossed the prefill->decode hinge (shadow journaled)
+        {"kind": "submit", "request": 0, "prompt": [1, 2],
+         "max_new_tokens": 9, "seed": 0},
+        {"kind": "place", "request": 0, "replica": 0, "attempts": 1},
+        {"kind": "shadow", "request": 0, "src": 0, "max_new_tokens": 9},
+        # id 1 never finished its prefill
+        {"kind": "submit", "request": 1, "prompt": [3],
+         "max_new_tokens": 7, "seed": 0},
+        {"kind": "place", "request": 1, "replica": 1, "attempts": 1},
+    ]:
+        st.apply(rec)
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    transports = [
+        InProcessTransport(
+            ServeEngine(FakeBackend(2),
+                        RequestQueue(capacity=32, clock=clock),
+                        watchdog=TickWatchdog(stuck_slack_ticks=None)))
+        for _ in range(2)]
+    ctl = DisaggController.from_journal(
+        st, transports, RequestQueue(capacity=32, clock=clock),
+        policy=RouterPolicy(backoff_base_s=0.0), clock=clock)
+    req0 = ctl._tracked[0]
+    assert req0.phase == "decode"
+    assert req0.max_new_tokens == 9        # full budget restored
+    assert ctl._prefill_on[0] == 0         # prefix source remembered
+    req1 = ctl._tracked[1]
+    assert req1.phase == "prefill"
+    assert req1.max_new_tokens == 1        # re-clamped for the replay
+    assert ctl._orig_max_new[1] == 7       # the real budget is stashed
+
+
+# ---------------------------------------------------------------------------
+# adversarial wire chaos at the framing layer
+
+
+def test_frames_carry_crc_and_seq_in_the_header():
+    a, b = socket.socketpair()
+    try:
+        frame = send_frame(a, {"op": "response", "id": 1}, seq=7)
+        (n,) = struct.unpack(">I", frame[:4])
+        assert n == len(frame) - 4         # length still covers the body
+        import zlib
+        (crc,) = struct.unpack(">I", frame[4:8])
+        assert crc == zlib.crc32(frame[8:]) & 0xFFFFFFFF
+        assert struct.unpack(">I", frame[8:12]) == (7,)
+        msg = recv_frame(b)
+        assert msg["_seq"] == 7 and msg["id"] == 1
+        # unsequenced frames surface no _seq key at all
+        send_frame(a, {"op": "hb"})
+        assert "_seq" not in recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.chaos
+def test_wire_corrupt_frame_is_rejected_whole_never_half_parsed():
+    plan = ChaosPlan([Fault("wire_corrupt", step=0, count=1)])
+    a, b = socket.socketpair()
+    try:
+        from pipe_tpu.fleet.proc import _frame
+        frame = _frame(_pack({"op": "place", "id": 9}), 1)
+        frames, hold = apply_wire_chaos(plan, 0, frame)
+        assert hold == 0.0 and len(frames) == 1 and frames[0] != frame
+        a.sendall(frames[0])
+        with pytest.raises(FrameCorrupt):
+            recv_frame(b)                  # rejected whole, not half-parsed
+        # the NEXT frame (index 1, uncovered) passes untouched
+        frames2, _ = apply_wire_chaos(plan, 1, frame)
+        assert frames2 == [frame]
+        a.sendall(frames2[0])
+        assert recv_frame(b)["id"] == 9
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.chaos
+def test_wire_dup_frames_collapse_under_seq_dedup():
+    plan = ChaosPlan([Fault("wire_dup", step=0, count=1)])
+    a, b = socket.socketpair()
+    try:
+        from pipe_tpu.fleet.proc import _frame
+        frame = _frame(_pack({"op": "response", "id": 4, "tokens": [1]}), 3)
+        frames, _ = apply_wire_chaos(plan, 0, frame)
+        assert frames == [frame, frame]    # duplicated on the wire
+        for f in frames:
+            a.sendall(f)
+        recv_max, taken = 0, []
+        for _ in frames:                   # the receiver's dedup discipline
+            msg = recv_frame(b)
+            seq = msg.pop("_seq")
+            if seq <= recv_max:
+                continue
+            recv_max = seq
+            taken.append(msg["id"])
+        assert taken == [4]                # exactly once
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.chaos
+def test_wire_partition_drops_frame_and_returns_hold():
+    plan = ChaosPlan([Fault("wire_partition", step=2, count=1,
+                            magnitude=2.0)])
+    frame = b"\x00\x00\x00\x08" + b"x" * 8
+    assert apply_wire_chaos(plan, 0, frame) == ([frame], 0.0)
+    frames, hold = apply_wire_chaos(plan, 2, frame)
+    assert frames == [] and hold == 2.0    # lost with the connection
+    # magnitude is capped so a typo can't hold the wire forever
+    big = ChaosPlan([Fault("wire_partition", step=0, count=1,
+                           magnitude=1e9)])
+    assert apply_wire_chaos(big, 0, frame)[1] == 30.0
+
+
+@pytest.mark.chaos
+def test_wire_faults_address_replicas_via_stage():
+    plan = ChaosPlan([Fault("wire_corrupt", step=0, count=5, stage=1)])
+    frame = b"\x00\x00\x00\x08" + b"y" * 8
+    # replica 0's wire is untouched; replica 1's frame is corrupted
+    assert apply_wire_chaos(plan, 0, frame, replica=0) == ([frame], 0.0)
+    corrupted, _ = apply_wire_chaos(plan, 0, frame, replica=1)
+    assert corrupted[0] != frame
+
+
+def test_wire_fault_accessor_rejects_non_wire_kinds():
+    plan = ChaosPlan([Fault("wire_delay", step=0, count=1, magnitude=0.01)])
+    with pytest.raises(ValueError, match="not a wire fault kind"):
+        plan.wire_fault("stall_tick", 0)
+    assert plan.wire_fault("wire_delay", 0) is not None
+    assert plan.wire_fault("wire_delay", 1) is None

@@ -1,7 +1,7 @@
 """Fleet bench: goodput, failover, async ticks, KV handoff, disagg.
 
-Seven questions, answered with the tiny LM on whatever backend is
-available (the numbers of record are the committed ``FLEET_r19.json``):
+Nine questions, answered with the tiny LM on whatever backend is
+available (the numbers of record are the committed ``FLEET_r20.json``):
 
 1. **Scaling** — saturated fleet goodput (ok tokens/s through the
    controller's exactly-once ledger) at N = 1, 2, 3 replicas, over the
@@ -51,7 +51,27 @@ available (the numbers of record are the committed ``FLEET_r19.json``):
    the one park-or-finish reclaim gate and every submitted id still
    yields exactly one terminal — the exactly-once ledger, across the
    phase boundary.
-7. **Saturation sweep** — steady-state goodput at N = 1..K replicas
+7. **Wire chaos drills** — adversarial faults at the proc framing
+   layer (:func:`pipe_tpu.fleet.proc.apply_wire_chaos`). A 2 s
+   ``wire_partition`` on one replica's wire must heal losslessly: the
+   child's re-dial lands in the listener's kernel backlog, retained
+   response frames replay, the parent's sequence dedup swallows the
+   duplicates — every id exactly one terminal. A ``wire_corrupt``
+   storm (15 consecutive parent->child frames) must never half-parse:
+   each bad frame is a CRC reject + connection drop + re-dial +
+   replay, and the drill asserts the reject counters actually fired.
+8. **Controller SIGKILL + restart** — the round-20 tentpole. The
+   controller runs in a SEPARATE process (hidden ``--_ctl-worker``
+   mode of this script) journaling every lifecycle transition to a
+   :class:`~pipe_tpu.fleet.journal.RequestJournal`; the bench SIGKILLs
+   it mid-stream (no goodbye, fsync'd WAL is all that survives), then
+   replays the journal, re-dials the orphaned children in rejoin mode
+   and rebuilds the controller with
+   :meth:`~pipe_tpu.fleet.control.FleetController.from_journal`.
+   Run twice — a mixed 3-replica fleet and a 2 prefill + 2 decode
+   disagg fleet — and both times every submitted id must end with
+   exactly one terminal response across the two controller lives.
+9. **Saturation sweep** — steady-state goodput at N = 1..K replicas
    over the chosen transport; reports the front-queue bottleneck N
    (the smallest fleet within 10% of the sweep's best goodput) —
    past it, added replicas buy nothing because the shared host / the
@@ -73,7 +93,7 @@ on a contended host the absolute numbers are noise — the flag says so
 instead of letting the artifact lie.
 
 Usage:
-  python tools/fleet_bench.py                 # full run -> FLEET_r19.json
+  python tools/fleet_bench.py                 # full run -> FLEET_r20.json
   python tools/fleet_bench.py --quick --fleet proc   # bench.py embed
 Progress goes to stderr; the last stdout line is always the summary
 object, so ``bench.py`` embeds the --quick summary.
@@ -85,7 +105,13 @@ import argparse
 import dataclasses
 import json
 import os
+import queue as queue_mod
+import shutil
+import signal
+import subprocess
 import sys
+import tempfile
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -96,7 +122,7 @@ import numpy as np  # noqa: E402
 
 from pipe_tpu.fleet import (DisaggController, FleetController,  # noqa: E402
                             InProcessTransport, ProcessReplicaTransport,
-                            ReplicaSpec)
+                            ReplicaSpec, RequestJournal)
 from pipe_tpu.inference import GenerationConfig  # noqa: E402
 from pipe_tpu.models.transformer_lm import LMConfig, PipelinedLM  # noqa: E402
 from pipe_tpu.obs.fleet_obs import (FleetObserver, SloMonitor,  # noqa: E402
@@ -1000,6 +1026,217 @@ def disagg_kill_trial_proc(kill_role, seed, kill_after_s=2.0,
     }
 
 
+def wire_chaos_trial(kind, seed, step=4, count=1, magnitude=2.0,
+                     n_requests=16):
+    """Adversarial faults on ONE replica's proc wire, full workload
+    through the exactly-once ledger. ``wire_partition``: the covered
+    outgoing frame is dropped and the wire goes dark for ``magnitude``
+    seconds — the heal must lose nothing (retained-frame replay,
+    sequence dedup) and duplicate nothing (a dup would trip the
+    ledger's exactly-once raise and fail the drill loudly).
+    ``wire_corrupt``: ``count`` consecutive frames are bit-flipped
+    post-checksum — every one must be rejected WHOLE (CRC mismatch ->
+    drop connection -> re-dial -> replay), never half-parsed into the
+    dispatcher."""
+    plan = ChaosPlan([Fault(kind, step=step, count=count, stage=1,
+                            magnitude=magnitude)])
+    transports = []
+    for i in range(2):
+        kw = dict(reconnect_timeout_s=15.0)
+        if i == 1:
+            kw.update(chaos=plan, chaos_replica=1)
+        transports.append(ProcessReplicaTransport(proc_spec(), **kw))
+    # heartbeat timeout ABOVE the partition hold: the drill is about
+    # the wire healing under the health machine's nose, not failover
+    ctl = FleetController(transports, RequestQueue(capacity=256),
+                          policy=RouterPolicy(backoff_base_s=0.0,
+                                              heartbeat_timeout_s=10.0))
+    rng = np.random.RandomState(seed)
+    work = make_workload(n_requests, rng)
+    responses = {}
+    try:
+        warm(ctl, 2)
+        t0 = time.monotonic()
+        ids = [ctl.submit(p, max_new_tokens=m, seed=i).id
+               for i, (p, m) in enumerate(work)]
+        deadline = time.monotonic() + 120.0
+        while not ctl.idle:
+            for r in ctl.tick():
+                assert r.request_id not in responses, \
+                    f"duplicate terminal for {r.request_id}"
+                responses[r.request_id] = r
+            time.sleep(0.005)
+            assert time.monotonic() < deadline, \
+                f"{kind} drill never drained"
+        elapsed = time.monotonic() - t0
+        # one more heartbeat interval so the child's final counter
+        # ship (crc rejects ride the hb frame) lands before we read it
+        time.sleep(0.2)
+        tr = transports[1]
+        wire = {
+            "resends": tr.wire_resends,
+            "dup_suppressed": tr.wire_dup_suppressed,
+            "crc_rejects_total": tr.crc_rejects_total,
+        }
+        fired = (tr._partition_until > 0.0 if kind == "wire_partition"
+                 else wire["crc_rejects_total"] > 0)
+        missing = [x for x in ids if x not in responses]
+    finally:
+        ctl.close()
+    assert not missing, f"{kind}: requests with no terminal: {missing}"
+    return {
+        "kind": kind,
+        "fault": {"step": step, "count": count, "magnitude": magnitude,
+                  "replica": 1},
+        "requests": len(ids),
+        "elapsed_s": round(elapsed, 3),
+        "fired": bool(fired),
+        "wire": wire,
+        "exactly_once": len(responses) == len(ids),
+    }
+
+
+def _ctl_worker_main(journal_dir, mode, seed, n_requests=40):
+    """The controller half of the SIGKILL-restart drill, run as a
+    child process of the bench. Builds a proc fleet journaling every
+    lifecycle transition to ``journal_dir``, submits a workload,
+    prints the submitted ids and a mid-flight marker on stdout, then
+    ticks forever — the bench SIGKILLs this process and recovers from
+    nothing but the journal plus the orphaned children."""
+    journal = RequestJournal(journal_dir)
+    policy = RouterPolicy(backoff_base_s=0.0, heartbeat_timeout_s=10.0)
+    if mode == "disagg":
+        roles = ("prefill", "prefill", "decode", "decode")
+        ctl = DisaggController(
+            [ProcessReplicaTransport(dataclasses.replace(proc_spec(),
+                                                         role=r))
+             for r in roles],
+            RequestQueue(capacity=256), policy=policy, journal=journal)
+    else:
+        ctl = FleetController(
+            [ProcessReplicaTransport(proc_spec()) for _ in range(3)],
+            RequestQueue(capacity=256), policy=policy, journal=journal)
+    for rep in ctl.replicas:
+        journal.record_replica(rep.index, **rep.transport.rejoin_info())
+    warm(ctl, len(ctl.replicas))
+    rng = np.random.RandomState(seed)
+    work = make_workload(n_requests, rng)
+    ids = [ctl.submit(p, max_new_tokens=m, seed=i).id
+           for i, (p, m) in enumerate(work)]
+    print(json.dumps({"event": "submitted", "ids": ids}), flush=True)
+    delivered = 0
+    announced = False
+    while True:
+        delivered += len(ctl.tick())
+        if not announced and delivered >= 2:
+            # some terminals journaled, plenty still in flight: tell
+            # the bench this is the adversarial moment to pull the plug
+            print(json.dumps({"event": "midflight",
+                              "delivered": delivered}), flush=True)
+            announced = True
+        time.sleep(0.002)
+
+
+def ctl_restart_trial(mode, seed):
+    """SIGKILL the CONTROLLER mid-stream, rebuild it from the journal.
+    The controller (plus its journal WAL) lives in a separate process;
+    its replica children survive the kill as orphans re-dialing the
+    dead listener. The bench replays the WAL, re-binds the recorded
+    ports in rejoin mode (re-registering the RUNNING children instead
+    of spawning), reconciles placements against what each child still
+    holds, and drains. Exactly-once across the two controller lives:
+    pre-crash terminals (journaled) and post-recovery deliveries must
+    partition the submitted id set — no id lost, none answered
+    twice."""
+    tmpdir = tempfile.mkdtemp(prefix="fleet-ctl-journal-")
+    worker = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--_ctl-worker", tmpdir, "--_ctl-mode", mode,
+         "--seed", str(seed)],
+        stdout=subprocess.PIPE, text=True)
+    lines: "queue_mod.Queue[str]" = queue_mod.Queue()
+    threading.Thread(target=lambda: [lines.put(ln) for ln in worker.stdout],
+                     daemon=True).start()
+
+    def next_event(timeout_s):
+        line = lines.get(timeout=timeout_s)
+        return json.loads(line)
+
+    state = None
+    ctl2 = None
+    recovered = []
+    try:
+        sub = next_event(300.0)
+        assert sub["event"] == "submitted", sub
+        mid = next_event(120.0)
+        assert mid["event"] == "midflight", mid
+        os.kill(worker.pid, signal.SIGKILL)      # no goodbye
+        worker.wait(timeout=30)
+        t0 = time.monotonic()
+        state = RequestJournal.recover(tmpdir)
+        assert not state.clean, "a SIGKILL cannot leave a clean log"
+        assert state.orphans, \
+            "kill landed after the drain — nothing was in flight"
+        assert sorted(state.replicas) == list(range(len(state.replicas)))
+        transports = [
+            ProcessReplicaTransport(
+                ReplicaSpec(**state.replicas[i]["spec"]),
+                rejoin=state.replicas[i])
+            for i in sorted(state.replicas)]
+        journal2 = RequestJournal(tmpdir)        # the WAL keeps growing
+        cls = DisaggController if mode == "disagg" else FleetController
+        ctl2 = cls.from_journal(
+            state, transports, RequestQueue(capacity=256),
+            journal=journal2,
+            policy=RouterPolicy(backoff_base_s=0.0,
+                                heartbeat_timeout_s=10.0))
+        deadline = time.monotonic() + 180.0
+        while not ctl2.idle:
+            recovered.extend(ctl2.tick())
+            time.sleep(0.005)
+            assert time.monotonic() < deadline, \
+                "recovered fleet never drained"
+        elapsed = time.monotonic() - t0
+        ctl2.close()
+        ctl2 = None                              # closed cleanly
+        journal2.close(clean=True)
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+        if ctl2 is not None:
+            try:
+                ctl2.close()
+            except Exception:
+                pass
+        # belt and braces: no orphaned replica child outlives the drill
+        if state is not None:
+            for rec in state.replicas.values():
+                pid = rec.get("pid")
+                if pid:
+                    try:
+                        os.kill(int(pid), signal.SIGKILL)
+                    except (OSError, ProcessLookupError):
+                        pass
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    all_ids = sorted(state.requests)
+    pre = set(state.terminal)
+    post = [r.request_id for r in recovered]
+    exactly_once = (sorted(pre | set(post)) == all_ids
+                    and len(post) == len(set(post))
+                    and not (pre & set(post)))
+    return {
+        "mode": mode,
+        "kill_mode": "sigkill_controller",
+        "requests": len(all_ids),
+        "pre_crash_terminal": len(pre),
+        "orphans_at_crash": len(state.orphans),
+        "recovered_delivered": len(post),
+        "journal_records": state.records,
+        "recover_s": round(elapsed, 3),
+        "exactly_once": bool(exactly_once),
+    }
+
+
 def saturation_trial(model, params, fleet, counts, seed,
                      duration_s=3.0, max_outstanding=12):
     """Steady-state goodput at N = counts[0]..counts[-1] replicas over
@@ -1055,7 +1292,14 @@ def main():
     ap.add_argument("--out", default=None,
                     help="also write the summary JSON here")
     ap.add_argument("--seed", type=int, default=0)
+    # hidden: the controller half of the SIGKILL-restart drill
+    ap.add_argument("--_ctl-worker", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--_ctl-mode", default="mixed", help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args._ctl_worker:
+        return _ctl_worker_main(args._ctl_worker, args._ctl_mode,
+                                args.seed)
 
     t0 = time.perf_counter()
     model = PipelinedLM(CFG, 1)
@@ -1101,6 +1345,20 @@ def main():
         disagg_kills[role] = disagg_kill_trial_proc(role, args.seed + 4)
         log(f"   kill {role}: {disagg_kills[role]}")
 
+    log("== wire chaos drills: 2s partition, corruption storm (proc)")
+    partition = wire_chaos_trial("wire_partition", args.seed + 6,
+                                 magnitude=2.0)
+    log(f"   partition: {partition}")
+    corrupt = wire_chaos_trial("wire_corrupt", args.seed + 7, step=3,
+                               count=15)
+    log(f"   corrupt storm: {corrupt}")
+
+    log("== controller SIGKILL + journal restart drills (proc)")
+    ctl_restart = {}
+    for mode in ("mixed", "disagg"):
+        ctl_restart[mode] = ctl_restart_trial(mode, args.seed + 8)
+        log(f"   {mode}: {ctl_restart[mode]}")
+
     log(f"== saturation sweep [{args.fleet}]: front-queue bottleneck")
     saturation = saturation_trial(
         model, params, args.fleet, (1, 2, 3) if args.quick
@@ -1115,6 +1373,10 @@ def main():
         k["exactly_once"] and k["survived_failover"]
         and k["obs"]["reconcile"]["reconciled"]
         for k in disagg_kills.values())
+    wire_ok = bool(partition["exactly_once"] and partition["fired"]
+                   and corrupt["exactly_once"] and corrupt["fired"]
+                   and corrupt["wire"]["crc_rejects_total"] > 0)
+    restart_ok = all(r["exactly_once"] for r in ctl_restart.values())
     ok = bool(kill["exactly_once"] and kill["survived_failover"]
               and kill["recovered_frac"] > 0.3
               and straggler["async_beats_serial"]
@@ -1124,11 +1386,12 @@ def main():
               and placement["hot_chain_replicated"]
               and disagg["disagg_beats_mixed"]
               and disagg_kills_ok
+              and wire_ok and restart_ok
               and kill["obs"]["reconcile"]["reconciled"]
               and stitch["frac"] == 1.0
               and stitch["exactly_once"])
     summary = {
-        "bench": "fleet", "rev": "r19",
+        "bench": "fleet", "rev": "r20",
         "quick": bool(args.quick),
         "fleet": args.fleet,
         "platform": jax.default_backend(),
@@ -1144,6 +1407,8 @@ def main():
         "kv_prefix_placement": placement,
         "disagg_vs_mixed": disagg,
         "disagg_kill_drills": disagg_kills,
+        "wire_chaos": {"partition": partition, "corrupt_storm": corrupt},
+        "ctl_restart": ctl_restart,
         "saturation": saturation,
         "fleet_ok": ok,
         "wall_s": round(time.perf_counter() - t0, 1),
@@ -1176,6 +1441,17 @@ def main():
                 disagg_kills["prefill"]["exactly_once"],
             "disagg_kill_decode_exactly_once":
                 disagg_kills["decode"]["exactly_once"],
+            "partition_heals_exactly_once":
+                partition["exactly_once"] and partition["fired"],
+            "partition_dup_suppressed":
+                partition["wire"]["dup_suppressed"],
+            "corrupt_storm_ok":
+                corrupt["exactly_once"] and corrupt["fired"],
+            "wire_crc_rejects": corrupt["wire"]["crc_rejects_total"],
+            "ctl_restart_exactly_once":
+                ctl_restart["mixed"]["exactly_once"],
+            "ctl_restart_disagg_exactly_once":
+                ctl_restart["disagg"]["exactly_once"],
             "saturation_n": saturation["saturation_n"],
             "placement_ttft_win_s": placement["ttft_win_s"],
             "placement_found_prefix":

@@ -5,8 +5,10 @@ Polls the ``/fleet`` and ``/slo`` endpoints that
 "Fleet observability") and renders a top(1)-style screen: one row per
 replica — health state, queue depth, live slots, the
 delivery-synchronized ``tokens_out``/``responses_out`` counters, obs
-frame seq and metric staleness — plus the fleet SLO verdict line with
-any violations called out.
+frame seq, metric staleness and the durable-journal lag (``jlag``:
+seconds since the controller's last fsync'd lifecycle record, "-" for
+journal-less fleets) — plus the fleet SLO verdict line with any
+violations called out.
 
 The screen is produced by the pure :func:`render` (fleet dict + slo
 verdict in, string out) so tests exercise the layout without a server
@@ -30,7 +32,7 @@ import urllib.request
 __all__ = ["render", "fetch"]
 
 _COLS = ("replica", "role", "state", "depth", "live", "tokens_out",
-         "responses", "obs_seq", "stale")
+         "responses", "obs_seq", "stale", "jlag")
 
 
 def fetch(base_url: str, timeout_s: float = 2.0):
@@ -75,7 +77,10 @@ def render(fleet, slo, title: str = "fleet_top") -> str:
                      str(v.get("responses_out", 0)),
                      "-" if v.get("obs_seq") is None
                      else str(v["obs_seq"]),
-                     _fmt_stale(v.get("staleness_s"))))
+                     _fmt_stale(v.get("staleness_s")),
+                     # durable-journal lag: seconds since the last
+                     # fsync'd lifecycle record; "-" = journal-less
+                     _fmt_stale(v.get("journal_lag_s"))))
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
               for i, c in enumerate(_COLS)]
     ok = bool(slo.get("ok", True))
